@@ -1,0 +1,27 @@
+"""paddlebox_tpu — a TPU-native large-scale sparse recommender training framework.
+
+A brand-new framework with the capabilities of PaddleBox / BoxPS (Baidu's GPU
+parameter-server stack for ultra-large-scale CTR training), designed TPU-first:
+
+- pass-based streaming data pipeline over slot-formatted instance data
+  (reference: paddle/fluid/framework/data_feed.h, data_set.cc)
+- HBM-resident sparse embedding table with pass-scoped working sets
+  (reference: the closed libbox_ps.so API, see SURVEY.md §2.7)
+- pull/push (gather / scatter-add + sparse optimizer) as JAX primitives,
+  fused seqpool+CVM lowered through XLA
+  (reference: paddle/fluid/operators/pull_box_sparse_op.*, fused/fused_seqpool_cvm_op.*)
+- data-parallel dense training via pjit/shard_map over a jax.sharding.Mesh with
+  ICI/DCN collectives (reference: NCCL dense sync in boxps_worker.cc:481-521)
+- on-device streaming AUC (reference: BasicAucCalculator, fleet/box_wrapper.h:61-138)
+- base/delta pass-boundary checkpoints (reference: box_wrapper.cc:1411-1460)
+"""
+
+__version__ = "0.1.0"
+
+from paddlebox_tpu.config import (  # noqa: F401
+    SlotConfig,
+    DataFeedConfig,
+    SparseTableConfig,
+    TrainerConfig,
+    flags,
+)

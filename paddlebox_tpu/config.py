@@ -1,0 +1,171 @@
+"""Configuration system.
+
+The reference uses three config tiers (SURVEY.md §5.6): env-settable gflags
+(paddle/fluid/platform/flags.cc), protobuf descriptors (data_feed.proto,
+trainer_desc.proto), and an opaque BoxPS conf file. Here that collapses into
+plain dataclasses plus a small env-var flag shim (`flags`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+
+# --------------------------------------------------------------------------- #
+# Flag shim — replaces gflags FLAGS_* (reference: platform/flags.cc).
+# Flags are read from the environment as PBOX_<NAME>, with typed defaults.
+# --------------------------------------------------------------------------- #
+class _Flags:
+    _DEFAULTS = {
+        # reference: FLAGS_padbox_record_pool_max_size (flags.cc:478)
+        "record_pool_max_size": 2_000_000,
+        # reference: FLAGS_padbox_dataset_shuffle_thread_num (flags.cc:483)
+        "dataset_shuffle_thread_num": 10,
+        # reference: FLAGS_padbox_dataset_merge_thread_num
+        "dataset_merge_thread_num": 10,
+        # reference: FLAGS_enable_pullpush_dedup_keys (flags.cc:603)
+        "enable_pullpush_dedup_keys": True,
+        # reference: FLAGS_check_nan_inf (boxps_worker.cc:575-581)
+        "check_nan_inf": False,
+        # reference: FLAGS_enable_pull_box_padding_zero (pull_box_sparse_op.h)
+        "enable_pull_box_padding_zero": True,
+        # use pallas kernels for sparse gather/scatter where available
+        "use_pallas_sparse": False,
+        # reference: FLAGS_padbox_auc_runner_mode (flags.cc:495)
+        "auc_runner_mode": False,
+        # preferred device compute dtype for dense towers
+        "compute_dtype": "float32",
+    }
+
+    def __getattr__(self, name: str):
+        if name not in self._DEFAULTS:
+            raise AttributeError(f"unknown flag {name!r}")
+        default = self._DEFAULTS[name]
+        env = os.environ.get("PBOX_" + name.upper())
+        if env is None:
+            return default
+        if isinstance(default, bool):
+            return env.lower() in ("1", "true", "yes", "on")
+        return type(default)(env)
+
+    def set(self, name: str, value) -> None:
+        if name not in self._DEFAULTS:
+            raise AttributeError(f"unknown flag {name!r}")
+        os.environ["PBOX_" + name.upper()] = str(value)
+
+
+flags = _Flags()
+
+
+# --------------------------------------------------------------------------- #
+# Slot / data-feed config — replaces data_feed.proto (reference:
+# paddle/fluid/framework/data_feed.proto:17-38: Slot{name,type,is_dense,
+# is_used,shape}, pipe_command, batch_size, pv_batch_size, rank_offset).
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class SlotConfig:
+    """One feature slot.
+
+    sparse slots hold uint64 feature signs (variable count per instance);
+    dense slots hold a fixed-shape float vector.
+    """
+
+    name: str
+    type: str = "uint64"  # "uint64" (sparse) | "float" (dense)
+    is_dense: bool = False
+    is_used: bool = True
+    shape: Sequence[int] = (1,)
+
+    def __post_init__(self):
+        if self.type not in ("uint64", "float"):
+            raise ValueError(f"slot {self.name}: bad type {self.type}")
+        if self.is_dense and self.type != "float":
+            raise ValueError(f"dense slot {self.name} must be float")
+
+
+@dataclasses.dataclass
+class DataFeedConfig:
+    """Reader configuration (DataFeedDesc equivalent)."""
+
+    slots: Sequence[SlotConfig] = ()
+    batch_size: int = 64
+    pipe_command: str = ""  # optional shell preprocessor, like reference pipe_command
+    pv_batch_size: int = 32  # page-view batches (PV merge mode)
+    enable_pv_merge: bool = False
+    rank_offset: str = ""  # name of the rank-offset tensor for rank_attention
+    rank_offset_cols: int = 7  # reference: data_feed.cc max_rank 3 -> 7 cols
+    parse_ins_id: bool = False
+    parse_logkey: bool = False  # search_id / rank / cmatch packed key
+    label_slot: str = "click"  # float slot whose first value is the label
+
+    # fixed device-batch capacities (XLA static shapes): max total feasigns per
+    # batch per sparse slot group.  Host feed pads/clips to these.
+    max_feasigns_per_ins: int = 256
+    # total key capacity of one device batch; None -> batch_size * max_feasigns_per_ins
+    batch_key_capacity: Optional[int] = None
+
+    def used_slots(self) -> list[SlotConfig]:
+        return [s for s in self.slots if s.is_used]
+
+    def sparse_slots(self) -> list[SlotConfig]:
+        return [s for s in self.slots if s.is_used and not s.is_dense]
+
+    def dense_slots(self) -> list[SlotConfig]:
+        return [s for s in self.slots if s.is_used and s.is_dense]
+
+
+# --------------------------------------------------------------------------- #
+# Sparse table config — replaces the BoxPS side conf + embedding dims dispatch
+# (reference: box_wrapper.cc:404-566 compile-time dims; box_wrapper.h:523-534
+# feature types; the closed-lib optimizer semantics chosen per SURVEY.md §7).
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass
+class SparseTableConfig:
+    embedding_dim: int = 8  # embedx dim (excludes show/clk/embed_w companions)
+    expand_dim: int = 0  # extended embedding (pull_box_extended_sparse)
+
+    # sparse optimizer: adagrad with scalar g2sum (Baidu abacus-style)
+    learning_rate: float = 0.05
+    initial_g2sum: float = 3.0
+    initial_range: float = 0.02  # uniform init range for new features
+    # feature admission / eviction (reference: ShrinkTable semantics)
+    create_threshold: float = 0.0  # min show count to materialize embedx
+    delete_threshold: float = 0.0  # evict rows below this show at shrink
+    show_decay_rate: float = 0.98  # per-day show/clk decay at shrink time
+    # gradient clip per element
+    grad_clip: float = 10.0
+
+    # CVM companions stored per row ahead of the embedding: [show, clk]
+    cvm_offset: int = 2
+
+    @property
+    def row_width(self) -> int:
+        """Width of a pulled value row: [show, clk, embed...(, expand...)]."""
+        return self.cvm_offset + self.embedding_dim + self.expand_dim
+
+
+# --------------------------------------------------------------------------- #
+# Trainer config — replaces trainer_desc.proto (reference:
+# trainer_desc.proto:21-66,100-108 BoxPSWorkerParameter).
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass
+class TrainerConfig:
+    # dense sync cadence: psum gradients every step (sync_dense_mode="step"),
+    # or average params every K steps ("kstep", reference DenseKStepNode)
+    sync_dense_mode: str = "step"
+    sync_weight_step: int = 1
+    # dense optimizer
+    dense_lr: float = 1e-3
+    dense_optimizer: str = "adam"
+    # metrics
+    auc_buckets: int = 1 << 20  # reference: 1M-bucket BasicAucCalculator
+    # dump (reference: trainer dump_fields/dump_param)
+    dump_fields: Sequence[str] = ()
+    dump_fields_path: str = ""
+    dump_param: Sequence[str] = ()
+    need_dump_field: bool = False
+    need_dump_param: bool = False
+    # nan check after each batch (reference: FLAGS_check_nan_inf)
+    check_nan_inf: bool = False

@@ -1,0 +1,4 @@
+from paddlebox_tpu.data.record import RecordBlock  # noqa: F401
+from paddlebox_tpu.data.slot_parser import SlotParser  # noqa: F401
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset, DatasetFactory  # noqa: F401
+from paddlebox_tpu.data.feed import HostBatch, BatchBuilder  # noqa: F401

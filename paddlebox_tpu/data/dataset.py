@@ -1,0 +1,187 @@
+"""Pass-scoped in-memory dataset with the BoxPS pass lifecycle.
+
+Replaces ``PadBoxSlotDataset`` / ``BoxPSDataset`` (reference:
+framework/data_set.h:348-474, python/paddle/fluid/dataset.py:1081-1302) and the
+feed-pass half of ``BoxHelper`` (reference: fleet/box_wrapper.h:815-1084):
+
+    ds.set_date(...)
+    ds.preload_into_memory()        # parallel read, overlaps prior pass train
+    ds.wait_preload_done()          # join + merge + key census
+    table.begin_pass(ds.unique_keys())
+    for batch in ds.batches(): train_step(...)
+    table.end_pass()
+    ds.release_memory()
+
+Multi-node global shuffle (reference: data_set.cc:1916-2090 via
+boxps::PaddleShuffler) plugs in through the ``shuffler`` hook — see
+paddlebox_tpu/data/shuffle.py.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as futures
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.config import DataFeedConfig, flags
+from paddlebox_tpu.data.feed import BatchBuilder, HostBatch
+from paddlebox_tpu.data.record import RecordBlock
+from paddlebox_tpu.data.slot_parser import SlotParser
+from paddlebox_tpu.utils.timer import Timer
+
+
+class PadBoxSlotDataset:
+    def __init__(self, conf: DataFeedConfig, read_threads: Optional[int] = None):
+        self.conf = conf
+        self.parser = SlotParser(conf)
+        self.builder = BatchBuilder(conf)
+        self.read_threads = read_threads or flags.dataset_shuffle_thread_num
+        self.filelist: list[str] = []
+        self.date: Optional[str] = None
+        self._block: Optional[RecordBlock] = None
+        self._order: Optional[np.ndarray] = None
+        self._preload: Optional[futures.Future] = None
+        self._pool = futures.ThreadPoolExecutor(max_workers=self.read_threads)
+        self._rng = np.random.default_rng(0)
+        self.shuffler = None  # optional multi-host shuffler (data/shuffle.py)
+        self.read_timer = Timer()
+
+    # -- filelist / date ------------------------------------------------ #
+    def set_filelist(self, files: Sequence[str]) -> None:
+        self.filelist = list(files)
+
+    def set_date(self, date: str) -> None:
+        """Reference: BoxHelper::SetDate -> day-granular model/pass keying."""
+        self.date = date
+
+    # -- load ----------------------------------------------------------- #
+    def _read_all(self) -> RecordBlock:
+        self.read_timer.resume()
+        try:
+            if not self.filelist:
+                raise RuntimeError("set_filelist before loading")
+            blocks = list(self._pool.map(self.parser.parse_file, self.filelist))
+            block = RecordBlock.concat(blocks)
+            if self.shuffler is not None:
+                block = self.shuffler.exchange(block)
+            return block
+        finally:
+            self.read_timer.pause()
+
+    def load_into_memory(self) -> None:
+        self._block = self._read_all()
+        self._order = np.arange(self._block.n_ins)
+
+    def preload_into_memory(self) -> None:
+        """Overlap next-pass reading with current-pass training (reference:
+        BoxHelper::PreLoadIntoMemory, box_wrapper.h:921-941)."""
+        if self._preload is not None:
+            raise RuntimeError("preload already in flight")
+        self._preload = futures.ThreadPoolExecutor(max_workers=1).submit(self._read_all)
+
+    def wait_preload_done(self) -> None:
+        if self._preload is None:
+            raise RuntimeError("no preload in flight")
+        self._block = self._preload.result()
+        self._order = np.arange(self._block.n_ins)
+        self._preload = None
+
+    def release_memory(self) -> None:
+        self._block = None
+        self._order = None
+
+    # -- shuffle -------------------------------------------------------- #
+    def local_shuffle(self, seed: Optional[int] = None) -> None:
+        if self._block is None:
+            raise RuntimeError("load before shuffle")
+        rng = np.random.default_rng(seed) if seed is not None else self._rng
+        self._order = rng.permutation(self._block.n_ins)
+
+    def global_shuffle(self, seed: Optional[int] = None) -> None:
+        """Single-host degenerate case == local shuffle; with a shuffler
+        attached, records were already exchanged at load time (reference:
+        ShuffleData routes by search_id/ins_id/random, data_set.cc:1934-1942)."""
+        self.local_shuffle(seed)
+
+    def slots_shuffle(self, slot_names: Sequence[str], seed: int = 0) -> None:
+        """Shuffle the given sparse slots' values across instances, keeping all
+        other slots fixed (AUC-runner feature-importance mode; reference:
+        SlotsShuffle box_wrapper.h:1077, data_set.h slots_shuffle)."""
+        if self._block is None:
+            raise RuntimeError("load before slots_shuffle")
+        names = [s.name for s in self.conf.sparse_slots()]
+        idxs = [names.index(n) for n in slot_names]
+        self._block = _shuffle_slots(self._block, idxs, np.random.default_rng(seed))
+
+    # -- pass / batches -------------------------------------------------- #
+    def get_memory_data_size(self) -> int:
+        return 0 if self._block is None else self._block.n_ins
+
+    def unique_keys(self) -> np.ndarray:
+        if self._block is None:
+            raise RuntimeError("load before key census")
+        return self._block.unique_keys()
+
+    def batches(self, drop_last: bool = False) -> Iterator[HostBatch]:
+        if self._block is None:
+            raise RuntimeError("load before iterating")
+        B = self.conf.batch_size
+        n = self._block.n_ins
+        for lo in range(0, n, B):
+            ids = self._order[lo : lo + B]
+            if drop_last and ids.shape[0] < B:
+                return
+            yield self.builder.build(self._block, ids)
+
+
+def _shuffle_slots(block: RecordBlock, slot_idxs, rng) -> RecordBlock:
+    s = block.n_sparse_slots
+    lens = np.diff(block.key_offsets).reshape(block.n_ins, s).copy()
+    # per shuffled slot: permute the (length, values) pairs across instances
+    new_vals = {}
+    for si in slot_idxs:
+        perm = rng.permutation(block.n_ins)
+        rows = np.arange(block.n_ins) * s + si
+        starts = block.key_offsets[rows][perm]
+        plens = lens[:, si][perm]
+        new_vals[si] = (starts, plens)
+        lens[:, si] = plens
+    new_offsets = np.zeros(block.n_ins * s + 1, dtype=np.int64)
+    np.cumsum(lens.reshape(-1), out=new_offsets[1:])
+    total = int(new_offsets[-1])
+    keys = np.empty(total, dtype=np.uint64)
+    for i in range(block.n_ins):
+        for si in range(s):
+            r = i * s + si
+            lo, hi = new_offsets[r], new_offsets[r + 1]
+            if si in new_vals:
+                st, pl = new_vals[si]
+                keys[lo:hi] = block.keys[st[i] : st[i] + pl[i]]
+            else:
+                olo = block.key_offsets[r]
+                keys[lo:hi] = block.keys[olo : olo + (hi - lo)]
+    return RecordBlock(
+        n_ins=block.n_ins,
+        n_sparse_slots=s,
+        keys=keys,
+        key_offsets=new_offsets,
+        dense=block.dense,
+        labels=block.labels,
+        ins_ids=block.ins_ids,
+        search_ids=block.search_ids,
+        ranks=block.ranks,
+        cmatches=block.cmatches,
+    )
+
+
+class DatasetFactory:
+    """Reference: framework/dataset_factory.cc:61-64 + python dataset.py:65."""
+
+    _KINDS = {"PadBoxSlotDataset": PadBoxSlotDataset, "BoxPSDataset": PadBoxSlotDataset}
+
+    def create_dataset(self, kind: str, conf: DataFeedConfig, **kw) -> PadBoxSlotDataset:
+        if kind not in self._KINDS:
+            raise ValueError(f"unknown dataset kind {kind!r}")
+        return self._KINDS[kind](conf, **kw)

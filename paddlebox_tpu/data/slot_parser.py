@@ -1,0 +1,164 @@
+"""Slot-formatted text parsing into columnar RecordBlocks.
+
+Canonical text format (one instance per line, slots in config order), the
+equivalent of the reference MultiSlot format parsed by
+``SlotPaddleBoxDataFeed::ParseOneInstance`` (reference: framework/data_feed.cc:3202):
+
+    [ins_id] [search_id:rank:cmatch] <n> v1 ... vn  <n> v1 ... vn  ...
+
+Each used slot contributes ``<count> <values...>``; uint64 slots hold feature
+signs, float slots hold floats.  The slot named ``label_slot`` supplies the
+per-instance label (its first value) and is not replicated into the dense
+features.  Dense (fixed-shape) float slots must supply exactly
+``prod(shape)`` values; variable-count float slots are not yet supported.
+
+A C++ parser with the same contract replaces this module on the hot path
+(see paddlebox_tpu/_native); this is the reference implementation and fallback.
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import subprocess
+from typing import Iterable, Optional
+
+import numpy as np
+
+from paddlebox_tpu.config import DataFeedConfig
+
+
+class SlotParser:
+    def __init__(self, conf: DataFeedConfig):
+        self.conf = conf
+        self.sparse_slots = conf.sparse_slots()
+        used = conf.used_slots()
+        # precompute walk order over all slots present in the file: ALL slots
+        # appear in the line (used or not); unused are skipped (reference:
+        # DataFeedDesc is_used handling in data_feed.cc).
+        self._walk = []  # (kind, width_or_-1, sparse_idx_or_dense_col)
+        dense_col = 0
+        sparse_idx = 0
+        self._dense_width = 0
+        for s in conf.slots:
+            is_label = s.name == conf.label_slot
+            if not s.is_used and not is_label:
+                self._walk.append(("skip", -1, -1, s.type))
+                continue
+            if s.is_dense or s.type == "float":
+                w = int(np.prod(s.shape))
+                if is_label:
+                    self._walk.append(("label", w, -1, s.type))
+                else:
+                    self._walk.append(("dense", w, dense_col, s.type))
+                    dense_col += w
+            else:
+                self._walk.append(("sparse", -1, sparse_idx, s.type))
+                sparse_idx += 1
+        self._dense_width = dense_col
+        self.n_sparse = sparse_idx
+
+    @property
+    def dense_width(self) -> int:
+        return self._dense_width
+
+    # ------------------------------------------------------------------ #
+    def parse_lines(self, lines: Iterable[str]) -> "RecordBlock":
+        from paddlebox_tpu.data.record import RecordBlock
+
+        conf = self.conf
+        keys: list[int] = []
+        offsets: list[int] = [0]
+        dense_rows: list[list[float]] = []
+        labels: list[float] = []
+        ins_ids: Optional[list[str]] = [] if conf.parse_ins_id else None
+        search_ids: Optional[list[int]] = [] if conf.parse_logkey else None
+        ranks: Optional[list[int]] = [] if conf.parse_logkey else None
+        cmatches: Optional[list[int]] = [] if conf.parse_logkey else None
+
+        n_ins = 0
+        for line in lines:
+            toks = line.split()
+            if not toks:
+                continue
+            p = 0
+            if conf.parse_ins_id:
+                ins_ids.append(toks[p])
+                p += 1
+            if conf.parse_logkey:
+                sid, rk, cm = toks[p].split(":")
+                search_ids.append(int(sid))
+                ranks.append(int(rk))
+                cmatches.append(int(cm))
+                p += 1
+            drow = [0.0] * self._dense_width
+            label = 0.0
+            per_slot_counts = []
+            for kind, width, col, typ in self._walk:
+                n = int(toks[p])
+                p += 1
+                if kind == "skip":
+                    p += n
+                elif kind == "label":
+                    if n != width:
+                        raise ValueError(
+                            f"label slot expected {width} values, got {n}"
+                        )
+                    label = float(toks[p])
+                    p += n
+                elif kind == "dense":
+                    if n != width:
+                        raise ValueError(
+                            f"dense slot expected {width} values, got {n}"
+                        )
+                    for j in range(n):
+                        drow[col + j] = float(toks[p + j])
+                    p += n
+                else:  # sparse
+                    for j in range(n):
+                        keys.append(int(toks[p + j]))
+                    p += n
+                    per_slot_counts.append(n)
+            # offsets for this instance's sparse slots
+            for c in per_slot_counts:
+                offsets.append(offsets[-1] + c)
+            dense_rows.append(drow)
+            labels.append(label)
+            n_ins += 1
+
+        return RecordBlock(
+            n_ins=n_ins,
+            n_sparse_slots=self.n_sparse,
+            keys=np.asarray(keys, dtype=np.uint64),
+            key_offsets=np.asarray(offsets, dtype=np.int64),
+            dense=np.asarray(dense_rows, dtype=np.float32).reshape(
+                n_ins, self._dense_width
+            ),
+            labels=np.asarray(labels, dtype=np.float32),
+            ins_ids=ins_ids,
+            search_ids=np.asarray(search_ids, dtype=np.uint64) if search_ids is not None else None,
+            ranks=np.asarray(ranks, dtype=np.int32) if ranks is not None else None,
+            cmatches=np.asarray(cmatches, dtype=np.int32) if cmatches is not None else None,
+        )
+
+    # ------------------------------------------------------------------ #
+    def parse_file(self, path: str) -> "RecordBlock":
+        """Read one file, honoring pipe_command and .gz, and parse it.
+
+        Reference: LoadIntoMemoryByLine forks ``pipe_command`` over the file
+        (data_feed.cc:2854; framework/io/shell.cc popen discipline).
+        """
+        if self.conf.pipe_command:
+            proc = subprocess.run(
+                f"cat {path} | {self.conf.pipe_command}",
+                shell=True,
+                check=True,
+                capture_output=True,
+            )
+            text = proc.stdout.decode()
+            return self.parse_lines(io.StringIO(text))
+        if path.endswith(".gz"):
+            with gzip.open(path, "rt") as f:
+                return self.parse_lines(f)
+        with open(path, "r") as f:
+            return self.parse_lines(f)

@@ -1,0 +1,2 @@
+from paddlebox_tpu.utils.timer import Timer  # noqa: F401
+from paddlebox_tpu.utils.monitor import StatRegistry, stats  # noqa: F401

@@ -1,0 +1,39 @@
+"""Named stat registry for counters/gauges.
+
+Reference: paddle/fluid/platform/monitor.{h,cc} — lock-free StatRegistry<T>
+with STAT_INT_ADD macros (monitor.h:76,133). Python GIL makes a plain dict
+with a lock sufficient here; hot-path counters live in C++ (_native)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class StatRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, float] = {}
+
+    def add(self, name: str, value: float = 1) -> None:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + value
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._stats[name] = value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._stats.get(name, 0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._stats)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+stats = StatRegistry()

@@ -1,0 +1,43 @@
+"""Pause/resume wall timers for host-pipeline perf accounting.
+
+Reference: paddle/fluid/platform/timer.{h,cc} — the production observability
+surface (pull/push/nccl timers in DeviceBoxData, reader pack timers)."""
+
+from __future__ import annotations
+
+import time
+
+
+class Timer:
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._elapsed = 0.0
+        self._start = None
+        self._count = 0
+
+    def resume(self) -> None:
+        if self._start is None:
+            self._start = time.perf_counter()
+
+    def pause(self) -> None:
+        if self._start is not None:
+            self._elapsed += time.perf_counter() - self._start
+            self._start = None
+            self._count += 1
+
+    def elapsed_sec(self) -> float:
+        extra = 0.0 if self._start is None else time.perf_counter() - self._start
+        return self._elapsed + extra
+
+    def count(self) -> int:
+        return self._count
+
+    def __enter__(self):
+        self.resume()
+        return self
+
+    def __exit__(self, *exc):
+        self.pause()
+        return False

@@ -1,0 +1,14 @@
+"""Test env: force an 8-device virtual CPU mesh before jax import.
+
+This is the TPU analog of the reference's localhost-subprocess distributed
+tests (SURVEY.md §4): multi-chip sharding is exercised on a fake CPU mesh."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
