@@ -9,6 +9,7 @@ plain dataclasses plus a small env-var flag shim (`flags`).
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 from typing import Optional, Sequence
 
@@ -83,6 +84,13 @@ class SlotConfig:
             raise ValueError(f"slot {self.name}: bad type {self.type}")
         if self.is_dense and self.type != "float":
             raise ValueError(f"dense slot {self.name} must be float")
+        if self.type == "float" and not self.is_dense:
+            # variable-count float slots are not supported yet; requiring
+            # is_dense keeps config and parser classification identical.
+            raise ValueError(
+                f"float slot {self.name} must be is_dense=True "
+                "(variable-count float slots are unsupported)"
+            )
 
 
 @dataclasses.dataclass
@@ -110,10 +118,33 @@ class DataFeedConfig:
         return [s for s in self.slots if s.is_used]
 
     def sparse_slots(self) -> list[SlotConfig]:
-        return [s for s in self.slots if s.is_used and not s.is_dense]
+        """Used uint64 slots, in file order.  Single source of truth for the
+        sparse slot index used by the parser, batcher and slots_shuffle."""
+        return [
+            s
+            for s in self.slots
+            if s.is_used and s.type == "uint64" and s.name != self.label_slot
+        ]
 
     def dense_slots(self) -> list[SlotConfig]:
-        return [s for s in self.slots if s.is_used and s.is_dense]
+        """Used dense float slots excluding the label slot, in file order.
+        Matches the RecordBlock dense-matrix column layout exactly."""
+        return [
+            s
+            for s in self.slots
+            if s.is_used and s.is_dense and s.name != self.label_slot
+        ]
+
+    def dense_width(self) -> int:
+        return sum(int(math.prod(s.shape)) for s in self.dense_slots())
+
+    def __post_init__(self):
+        for s in self.slots:
+            if s.name == self.label_slot and s.type != "float":
+                raise ValueError(
+                    f"label slot {s.name!r} must be a float slot, "
+                    f"got type={s.type!r}"
+                )
 
 
 # --------------------------------------------------------------------------- #

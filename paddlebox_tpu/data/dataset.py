@@ -44,6 +44,7 @@ class PadBoxSlotDataset:
         self._order: Optional[np.ndarray] = None
         self._preload: Optional[futures.Future] = None
         self._pool = futures.ThreadPoolExecutor(max_workers=self.read_threads)
+        self._preload_pool = futures.ThreadPoolExecutor(max_workers=1)
         self._rng = np.random.default_rng(0)
         self.shuffler = None  # optional multi-host shuffler (data/shuffle.py)
         self.read_timer = Timer()
@@ -79,7 +80,7 @@ class PadBoxSlotDataset:
         BoxHelper::PreLoadIntoMemory, box_wrapper.h:921-941)."""
         if self._preload is not None:
             raise RuntimeError("preload already in flight")
-        self._preload = futures.ThreadPoolExecutor(max_workers=1).submit(self._read_all)
+        self._preload = self._preload_pool.submit(self._read_all)
 
     def wait_preload_done(self) -> None:
         if self._preload is None:
@@ -91,6 +92,18 @@ class PadBoxSlotDataset:
     def release_memory(self) -> None:
         self._block = None
         self._order = None
+
+    def close(self) -> None:
+        """Shut down reader threads; the dataset stays usable for in-memory
+        iteration but can no longer load."""
+        self._pool.shutdown(wait=True)
+        self._preload_pool.shutdown(wait=True)
+
+    def __enter__(self) -> "PadBoxSlotDataset":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     # -- shuffle -------------------------------------------------------- #
     def local_shuffle(self, seed: Optional[int] = None) -> None:

@@ -12,14 +12,16 @@ per-instance label (its first value) and is not replicated into the dense
 features.  Dense (fixed-shape) float slots must supply exactly
 ``prod(shape)`` values; variable-count float slots are not yet supported.
 
-A C++ parser with the same contract replaces this module on the hot path
-(see paddlebox_tpu/_native); this is the reference implementation and fallback.
+The label slot is always consumed (even if declared is_used=False) because
+every instance must carry a label; it never appears in the dense matrix.
+
+This is the reference implementation; a vectorized / native parser may
+replace it on the hot path once bench.py quantifies the gap.
 """
 
 from __future__ import annotations
 
 import gzip
-import io
 import subprocess
 from typing import Iterable, Optional
 
@@ -32,38 +34,38 @@ class SlotParser:
     def __init__(self, conf: DataFeedConfig):
         self.conf = conf
         self.sparse_slots = conf.sparse_slots()
-        used = conf.used_slots()
         # precompute walk order over all slots present in the file: ALL slots
         # appear in the line (used or not); unused are skipped (reference:
-        # DataFeedDesc is_used handling in data_feed.cc).
+        # DataFeedDesc is_used handling in data_feed.cc).  Classification is
+        # delegated to DataFeedConfig so every consumer (batcher,
+        # slots_shuffle, model layers) sees the same slot indexing.
+        sparse_names = {s.name: i for i, s in enumerate(self.sparse_slots)}
+        dense_cols = {}
+        col = 0
+        for s in conf.dense_slots():
+            dense_cols[s.name] = col
+            col += int(np.prod(s.shape))
         self._walk = []  # (kind, width_or_-1, sparse_idx_or_dense_col)
-        dense_col = 0
-        sparse_idx = 0
-        self._dense_width = 0
         for s in conf.slots:
             is_label = s.name == conf.label_slot
-            if not s.is_used and not is_label:
-                self._walk.append(("skip", -1, -1, s.type))
-                continue
-            if s.is_dense or s.type == "float":
-                w = int(np.prod(s.shape))
-                if is_label:
-                    self._walk.append(("label", w, -1, s.type))
-                else:
-                    self._walk.append(("dense", w, dense_col, s.type))
-                    dense_col += w
+            if is_label:
+                self._walk.append(("label", int(np.prod(s.shape)), -1, s.type))
+            elif s.name in sparse_names:
+                self._walk.append(("sparse", -1, sparse_names[s.name], s.type))
+            elif s.name in dense_cols:
+                self._walk.append(("dense", int(np.prod(s.shape)), dense_cols[s.name], s.type))
             else:
-                self._walk.append(("sparse", -1, sparse_idx, s.type))
-                sparse_idx += 1
-        self._dense_width = dense_col
-        self.n_sparse = sparse_idx
+                self._walk.append(("skip", -1, -1, s.type))
+        self._dense_width = col
+        assert col == conf.dense_width()
+        self.n_sparse = len(self.sparse_slots)
 
     @property
     def dense_width(self) -> int:
         return self._dense_width
 
     # ------------------------------------------------------------------ #
-    def parse_lines(self, lines: Iterable[str]) -> "RecordBlock":
+    def parse_lines(self, lines: Iterable[str], path: str = "<lines>") -> "RecordBlock":
         from paddlebox_tpu.data.record import RecordBlock
 
         conf = self.conf
@@ -77,53 +79,19 @@ class SlotParser:
         cmatches: Optional[list[int]] = [] if conf.parse_logkey else None
 
         n_ins = 0
-        for line in lines:
+        for lineno, line in enumerate(lines, start=1):
             toks = line.split()
             if not toks:
                 continue
-            p = 0
-            if conf.parse_ins_id:
-                ins_ids.append(toks[p])
-                p += 1
-            if conf.parse_logkey:
-                sid, rk, cm = toks[p].split(":")
-                search_ids.append(int(sid))
-                ranks.append(int(rk))
-                cmatches.append(int(cm))
-                p += 1
-            drow = [0.0] * self._dense_width
-            label = 0.0
-            per_slot_counts = []
-            for kind, width, col, typ in self._walk:
-                n = int(toks[p])
-                p += 1
-                if kind == "skip":
-                    p += n
-                elif kind == "label":
-                    if n != width:
-                        raise ValueError(
-                            f"label slot expected {width} values, got {n}"
-                        )
-                    label = float(toks[p])
-                    p += n
-                elif kind == "dense":
-                    if n != width:
-                        raise ValueError(
-                            f"dense slot expected {width} values, got {n}"
-                        )
-                    for j in range(n):
-                        drow[col + j] = float(toks[p + j])
-                    p += n
-                else:  # sparse
-                    for j in range(n):
-                        keys.append(int(toks[p + j]))
-                    p += n
-                    per_slot_counts.append(n)
-            # offsets for this instance's sparse slots
-            for c in per_slot_counts:
-                offsets.append(offsets[-1] + c)
-            dense_rows.append(drow)
-            labels.append(label)
+            try:
+                p = self._parse_one(
+                    toks, keys, offsets, dense_rows, labels,
+                    ins_ids, search_ids, ranks, cmatches,
+                )
+            except (IndexError, ValueError) as e:
+                raise ValueError(
+                    f"{path}:{lineno}: malformed instance ({e})"
+                ) from e
             n_ins += 1
 
         return RecordBlock(
@@ -141,24 +109,89 @@ class SlotParser:
             cmatches=np.asarray(cmatches, dtype=np.int32) if cmatches is not None else None,
         )
 
+    def _parse_one(self, toks, keys, offsets, dense_rows, labels,
+                   ins_ids, search_ids, ranks, cmatches) -> int:
+        """Parse one tokenized instance into the accumulator lists."""
+        conf = self.conf
+        p = 0
+        if conf.parse_ins_id:
+            ins_ids.append(toks[p])
+            p += 1
+        if conf.parse_logkey:
+            sid, rk, cm = toks[p].split(":")
+            search_ids.append(int(sid))
+            ranks.append(int(rk))
+            cmatches.append(int(cm))
+            p += 1
+        drow = [0.0] * self._dense_width
+        label = 0.0
+        per_slot_counts = []
+        for kind, width, col, typ in self._walk:
+            n = int(toks[p])
+            p += 1
+            if kind == "skip":
+                p += n
+            elif kind == "label":
+                if n != width:
+                    raise ValueError(
+                        f"label slot expected {width} values, got {n}"
+                    )
+                label = float(toks[p])
+                p += n
+            elif kind == "dense":
+                if n != width:
+                    raise ValueError(
+                        f"dense slot expected {width} values, got {n}"
+                    )
+                for j in range(n):
+                    drow[col + j] = float(toks[p + j])
+                p += n
+            else:  # sparse
+                for j in range(n):
+                    keys.append(int(toks[p + j]))
+                p += n
+                per_slot_counts.append(n)
+        if p < len(toks):
+            raise ValueError(f"{len(toks) - p} trailing tokens")
+        # offsets for this instance's sparse slots
+        for c in per_slot_counts:
+            offsets.append(offsets[-1] + c)
+        dense_rows.append(drow)
+        labels.append(label)
+        return p
+
     # ------------------------------------------------------------------ #
     def parse_file(self, path: str) -> "RecordBlock":
         """Read one file, honoring pipe_command and .gz, and parse it.
 
         Reference: LoadIntoMemoryByLine forks ``pipe_command`` over the file
-        (data_feed.cc:2854; framework/io/shell.cc popen discipline).
+        (data_feed.cc:2854; framework/io/shell.cc popen discipline).  The pipe
+        command streams: the file is handed to the subprocess as stdin and
+        stdout is consumed line-by-line, never buffering the whole output.
         """
         if self.conf.pipe_command:
-            proc = subprocess.run(
-                f"cat {path} | {self.conf.pipe_command}",
-                shell=True,
-                check=True,
-                capture_output=True,
-            )
-            text = proc.stdout.decode()
-            return self.parse_lines(io.StringIO(text))
+            with open(path, "rb") as src:
+                proc = subprocess.Popen(
+                    self.conf.pipe_command,
+                    shell=True,
+                    stdin=src,
+                    stdout=subprocess.PIPE,
+                    text=True,
+                    encoding="utf-8",
+                )
+                try:
+                    block = self.parse_lines(proc.stdout, path=path)
+                finally:
+                    proc.stdout.close()
+                    ret = proc.wait()
+                if ret != 0:
+                    raise RuntimeError(
+                        f"pipe_command {self.conf.pipe_command!r} on {path} "
+                        f"exited {ret}"
+                    )
+                return block
         if path.endswith(".gz"):
             with gzip.open(path, "rt") as f:
-                return self.parse_lines(f)
+                return self.parse_lines(f, path=path)
         with open(path, "r") as f:
-            return self.parse_lines(f)
+            return self.parse_lines(f, path=path)
