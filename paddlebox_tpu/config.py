@@ -139,12 +139,21 @@ class DataFeedConfig:
         return sum(int(math.prod(s.shape)) for s in self.dense_slots())
 
     def __post_init__(self):
+        seen = set()
         for s in self.slots:
+            if s.name in seen:
+                raise ValueError(f"duplicate slot name {s.name!r}")
+            seen.add(s.name)
             if s.name == self.label_slot and s.type != "float":
                 raise ValueError(
                     f"label slot {s.name!r} must be a float slot, "
                     f"got type={s.type!r}"
                 )
+        if self.slots and self.label_slot not in seen:
+            raise ValueError(
+                f"label slot {self.label_slot!r} is not among the configured "
+                "slots; every instance must carry a label"
+            )
 
 
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,19 @@
+"""Training metrics computed on-device (SURVEY.md §5.5)."""
+
+from paddlebox_tpu.metrics.auc import (
+    AucState,
+    compute_metrics,
+    init_auc_state,
+    merge_auc_states,
+    psum_auc_state,
+    update_auc_state,
+)
+
+__all__ = [
+    "AucState",
+    "compute_metrics",
+    "init_auc_state",
+    "merge_auc_states",
+    "psum_auc_state",
+    "update_auc_state",
+]

@@ -1,0 +1,6 @@
+"""Example CTR model family (SURVEY.md §7 stage 7)."""
+
+from paddlebox_tpu.models.ctr_dnn import CtrDnn
+from paddlebox_tpu.models.layers import bce_with_logits, init_mlp, linear, mlp
+
+__all__ = ["CtrDnn", "bce_with_logits", "init_mlp", "linear", "mlp"]

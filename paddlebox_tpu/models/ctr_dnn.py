@@ -1,0 +1,60 @@
+"""CTR-DNN: the canonical BoxPS benchmark model.
+
+The reference ships no model zoo (SURVEY.md §1): CTR-DNN is the user program
+built from ``_pull_box_sparse`` + ``fused_seqpool_cvm`` + ``fc`` layers
+(template: python/paddle/fluid/tests/unittests/test_paddlebox_datafeed.py:22-120).
+Here it is a first-class model: sparse slots are pooled through
+fused_seqpool_cvm, concatenated with dense features, and fed to a bf16/f32
+ReLU tower — one big MXU-friendly matmul chain.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_mlp, mlp
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class CtrDnn:
+    """params-in/params-out model; apply() is pure and jittable."""
+
+    def __init__(
+        self,
+        n_sparse_slots: int,
+        emb_width: int,  # pulled row width (cvm_offset + embedding_dim)
+        dense_dim: int = 0,
+        hidden: Sequence[int] = (512, 256, 128),
+        use_cvm: bool = True,
+        cvm_offset: int = 2,
+    ):
+        self.n_sparse_slots = n_sparse_slots
+        self.emb_width = emb_width
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        pooled_w = emb_width if use_cvm else emb_width - cvm_offset
+        self.input_dim = n_sparse_slots * pooled_w + dense_dim
+
+    def init(self, key: jax.Array) -> dict:
+        return {"tower": init_mlp(key, self.input_dim, self.hidden, 1)}
+
+    def apply(
+        self,
+        params: dict,
+        rows: jax.Array,  # [K, emb_width] pulled rows
+        key_segments: jax.Array,  # [K]
+        dense: jax.Array,  # [B, dense_dim]
+        batch_size: int,
+    ) -> jax.Array:
+        """Returns logits [B]."""
+        pooled = fused_seqpool_cvm(
+            rows, key_segments, batch_size, self.n_sparse_slots,
+            use_cvm=self.use_cvm, cvm_offset=self.cvm_offset,
+        )
+        x = jnp.concatenate([pooled, dense], axis=1) if self.dense_dim else pooled
+        return mlp(params["tower"], x)[:, 0]

@@ -1,0 +1,52 @@
+"""Minimal dense layer library (pure-JAX pytrees).
+
+The reference's dense side is the full fluid layer lib (SURVEY.md §2.8
+"General NN ops"); a TPU-native CTR framework needs only a handful of
+MXU-friendly primitives — everything else is jnp.  Params are plain dicts so
+they checkpoint and psum trivially.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init_linear(key: jax.Array, in_dim: int, out_dim: int, scale: str = "xavier"):
+    wkey, _ = jax.random.split(key)
+    if scale == "xavier":
+        bound = jnp.sqrt(6.0 / (in_dim + out_dim))
+    else:
+        bound = 1.0 / jnp.sqrt(in_dim)
+    return {
+        "w": jax.random.uniform(wkey, (in_dim, out_dim), minval=-bound, maxval=bound),
+        "b": jnp.zeros(out_dim),
+    }
+
+
+def linear(params: dict, x: jax.Array) -> jax.Array:
+    return x @ params["w"] + params["b"]
+
+
+def init_mlp(key: jax.Array, in_dim: int, hidden: Sequence[int], out_dim: int = 1):
+    dims = [in_dim, *hidden, out_dim]
+    keys = jax.random.split(key, len(dims) - 1)
+    return [init_linear(k, dims[i], dims[i + 1]) for i, k in enumerate(keys)]
+
+
+def mlp(params: list, x: jax.Array) -> jax.Array:
+    """ReLU MLP; final layer linear.  Returns [..., out_dim]."""
+    for layer in params[:-1]:
+        x = jax.nn.relu(linear(layer, x))
+    return linear(params[-1], x)
+
+
+def bce_with_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Numerically stable sigmoid cross-entropy (per element)."""
+    return (
+        jnp.maximum(logits, 0.0)
+        - logits * labels
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )

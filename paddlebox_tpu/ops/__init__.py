@@ -1,0 +1,11 @@
+"""TPU-native CTR operator set.
+
+Replaces the reference's fused CUDA CTR ops (SURVEY.md §2.8:
+operators/fused/fused_seqpool_cvm_op.cu, operators/cvm_op.cu,
+operators/pull_box_sparse_op.*) with jittable JAX functions that XLA fuses.
+"""
+
+from paddlebox_tpu.ops.cvm import cvm, cvm_decayed_show
+from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm, seqpool
+
+__all__ = ["cvm", "cvm_decayed_show", "fused_seqpool_cvm", "seqpool"]

@@ -1,0 +1,37 @@
+"""Standalone CVM op (continuous-value model transform).
+
+Reference: paddle/fluid/operators/cvm_op.{cc,cu,h} — input X [B, W] whose
+first two columns are (show, click); with use_cvm the columns become
+(log(show+1), log(click+1)-log(show+1)); without, they are removed.
+Counters carry no gradient (reference cvm_grad fills the show/click grad
+columns with the CVM values themselves rather than differentiating the log).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cvm(x: jax.Array, use_cvm: bool = True) -> jax.Array:
+    """x: [..., W] with x[..., 0]=show, x[..., 1]=click."""
+    show = jax.lax.stop_gradient(x[..., 0:1])
+    click = jax.lax.stop_gradient(x[..., 1:2])
+    if not use_cvm:
+        return x[..., 2:]
+    log_show = jnp.log(show + 1.0)
+    return jnp.concatenate(
+        [log_show, jnp.log(click + 1.0) - log_show, x[..., 2:]], axis=-1
+    )
+
+
+def cvm_decayed_show(x: jax.Array, decay: float) -> jax.Array:
+    """CVM variant applying a show decay before the log transform — used by
+    AUC-runner style evaluation (reference keeps decayed show in the value
+    itself; exposed here for parity with per-day decay semantics)."""
+    show = jax.lax.stop_gradient(x[..., 0:1]) * decay
+    click = jax.lax.stop_gradient(x[..., 1:2]) * decay
+    log_show = jnp.log(show + 1.0)
+    return jnp.concatenate(
+        [log_show, jnp.log(click + 1.0) - log_show, x[..., 2:]], axis=-1
+    )

@@ -1,0 +1,90 @@
+"""Fused sequence sum-pool + CVM transform.
+
+TPU-native redesign of ``fused_seqpool_cvm`` (reference:
+paddle/fluid/operators/fused/fused_seqpool_cvm_op.cu:34-369, Python wrapper
+python/paddle/fluid/contrib/layers/nn.py:1580): the reference launches one
+CUDA kernel that walks N per-slot ragged LoDTensors.  Here the host feed
+already packed the whole batch as one padded CSR (HostBatch.key_segments,
+segment id = ins * S + slot, padding -> B*S overflow bin), so pooling over
+*all* slots is a single ``jax.ops.segment_sum`` — a static-shape op XLA maps
+onto the MXU/VPU and fuses with the CVM log transform.  No per-slot loop, no
+ragged shapes, no kernel zoo.
+
+Row layout of a pulled value (reference CVM layout, box_wrapper.cu PullCopy*):
+``[show, click, embed...]`` with ``cvm_offset = 2``.
+
+CVM transform (reference fused_seqpool_cvm_op.cu:168-191):
+    out[0] = log(show + 1)
+    out[1] = log(click + 1) - log(show + 1)
+    out[2:] = pass-through (pooled embedding)
+With ``use_cvm=False`` the show/click columns are dropped instead
+(reference: CVMOp with use_cvm=false keeps only x[2:]).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def seqpool(rows: jax.Array, key_segments: jax.Array, batch_size: int,
+            n_slots: int) -> jax.Array:
+    """Sum-pool pulled rows into per-(instance, slot) vectors.
+
+    rows: [K, W] pulled value rows, one per feasign occurrence.
+    key_segments: int32 [K]; segment id = ins * n_slots + slot; padding keys
+        carry segment id batch_size * n_slots and fall into an overflow bin
+        that is dropped, so padding contributes nothing (and receives zero
+        gradient, which keeps the dead table row clean).
+    Returns [batch_size, n_slots, W].
+    """
+    pooled = jax.ops.segment_sum(
+        rows, key_segments, num_segments=batch_size * n_slots + 1
+    )
+    return pooled[: batch_size * n_slots].reshape(batch_size, n_slots, -1)
+
+
+def _cvm_transform(pooled: jax.Array, cvm_offset: int) -> jax.Array:
+    """log-CVM on the pooled show/click columns; counters carry no gradient
+    (the reference's cvm_grad writes the CVM values, not d/dshow of the log,
+    into the show/click grad slots — i.e. counters are not learned)."""
+    show = jax.lax.stop_gradient(pooled[..., 0:1])
+    click = jax.lax.stop_gradient(pooled[..., 1:2])
+    log_show = jnp.log(show + 1.0)
+    ctr = jnp.log(click + 1.0) - log_show
+    return jnp.concatenate([log_show, ctr, pooled[..., cvm_offset:]], axis=-1)
+
+
+def fused_seqpool_cvm(
+    rows: jax.Array,
+    key_segments: jax.Array,
+    batch_size: int,
+    n_slots: int,
+    use_cvm: bool = True,
+    cvm_offset: int = 2,
+    clk_coeff: float = 1.0,
+    need_filter: bool = False,
+    show_coeff: float = 0.2,
+    embed_threshold: float = 0.0,
+) -> jax.Array:
+    """Pool + CVM for all slots at once; returns [B, n_slots * out_width].
+
+    out_width = W with use_cvm else W - cvm_offset (show/click dropped).
+    need_filter (reference fused_seqpool_cvm_op.cu EmbedFilter): zero a
+    pooled slot-vector whose show*show_coeff + click*clk_coeff falls below
+    embed_threshold — low-frequency feature suppression.
+    """
+    pooled = seqpool(rows, key_segments, batch_size, n_slots)
+    if need_filter:
+        score = (
+            pooled[..., 0:1] * show_coeff + pooled[..., 1:2] * clk_coeff
+        )
+        keep = (score >= embed_threshold).astype(pooled.dtype)
+        pooled = jnp.concatenate(
+            [pooled[..., :cvm_offset], pooled[..., cvm_offset:] * keep], axis=-1
+        )
+    if use_cvm:
+        out = _cvm_transform(pooled, cvm_offset)
+    else:
+        out = pooled[..., cvm_offset:]
+    return out.reshape(batch_size, -1)

@@ -1,0 +1,20 @@
+"""TPU-native sparse parameter server.
+
+Replaces the reference's closed-source ``libbox_ps.so`` HBM embedding cache +
+the BoxWrapper glue (SURVEY.md §2.6/§2.7) with a pass-scoped working-set
+design: the pass's key census is known in advance (the BeginFeedPass /
+EndFeedPass trick, SURVEY.md §3.4), so key->row resolution is a host-side
+sorted lookup and the device never hashes — pull is a static-shape gather,
+push is a deduped scatter-add + fused sparse adagrad.
+"""
+
+from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
+from paddlebox_tpu.sparse.table import BatchPlan, SparseTable, pull_rows, push_and_update
+
+__all__ = [
+    "BatchPlan",
+    "SparseTable",
+    "pull_rows",
+    "push_and_update",
+    "sparse_adagrad_update",
+]

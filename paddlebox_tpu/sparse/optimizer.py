@@ -1,0 +1,41 @@
+"""Sparse optimizer: per-feature adagrad with a scalar accumulator.
+
+The reference's sparse update runs inside the closed ``libbox_ps.so``
+(``PushSparseGPU``, SURVEY.md §2.7) so its exact rule is unobservable; per
+SURVEY.md §7 ("Hard parts") we adopt the published Baidu abacus/PS-lib sparse
+adagrad semantics:
+
+    g            <- clip(g, ±grad_clip)
+    g2sum        += mean(g^2)                       (one scalar per row)
+    w            -= lr * sqrt(g2sum0 / (g2sum0 + g2sum)) * g
+
+where ``g2sum0`` (SparseTableConfig.initial_g2sum) softens the schedule the
+way adagrad's epsilon does.  Show/click companions are plain counters updated
+by push, not by the optimizer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sparse_adagrad_update(
+    g2sum: jax.Array,
+    grad: jax.Array,
+    learning_rate: float,
+    initial_g2sum: float,
+    grad_clip: float,
+):
+    """One adagrad step for a batch of rows.
+
+    g2sum: [U] accumulators; grad: [U, D].
+    Returns (w_delta, g2sum_delta) — *deltas*, so callers can scatter-add
+    them into the full table (padding rows with zero grads produce exactly
+    zero deltas and leave the table untouched).
+    """
+    g = jnp.clip(grad, -grad_clip, grad_clip)
+    add_g2 = jnp.mean(g * g, axis=-1)
+    new_g2 = g2sum + add_g2
+    scale = learning_rate * jnp.sqrt(initial_g2sum / (initial_g2sum + new_g2))
+    return -scale[:, None] * g, add_g2

@@ -1,0 +1,325 @@
+"""Single-chip pass-scoped sparse embedding table.
+
+TPU-native redesign of the BoxPS sparse PS core (reference:
+fleet/box_wrapper_impl.h:24-255 PullSparseCase/PushSparseGradCase, pass
+lifecycle box_wrapper.cc:609-673, persistence cc:1329-1460 — all backed by
+the closed ``libbox_ps.so`` HBM hash table, SURVEY.md §2.7).
+
+Design (SURVEY.md §7): instead of a device-side hash table, exploit the fact
+that a pass's key census is known before training starts (the
+BeginFeedPass/EndFeedPass trick, §3.4):
+
+  * host store  — all features ever seen: sorted uint64 keys + value rows
+    ``[show, clk, embed..., g2sum]`` (float32).  The CPU/SSD tier analog.
+  * begin_pass(keys) — promote the pass working set to device: one dense
+    ``values [P, W]`` array (P = padded capacity, last row = dead row held
+    at zero) + ``g2sum [P]``.  New keys get uniform(-initial_range,
+    initial_range) embeddings.  The HBM tier analog.
+  * plan_batch(batch) — host-side key->row resolution: ``searchsorted`` into
+    the sorted pass keys, plus batch dedup (np.unique) so push merges
+    duplicate keys exactly like the reference's ``DedupKeysAndFillIdx`` +
+    ``PushMergeCopy`` (box_wrapper.cu:457-1034), but on the host where
+    dynamic shapes are free.  Everything handed to the device has a static
+    shape.
+  * pull_rows / push_and_update — pure jittable functions: gather, and
+    segment-sum merge + sparse adagrad + show/clk counter scatter-add.
+  * end_pass() — write the working set back into the host store.
+
+The dead row (index P-1) serves padding keys and keys missing from the pass
+census: pulls read zeros (reference FLAGS_enable_pull_box_padding_zero), and
+it is re-zeroed after every push so stray gradients cannot leak into it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.config import SparseTableConfig
+from paddlebox_tpu.data.feed import HostBatch
+from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    """Host-resolved device indices for one batch (all static shapes).
+
+    idx:      int32 [K] — table row per key occurrence (dead row for padding
+              or keys absent from the pass census).
+    uniq_idx: int32 [U] — table row per *unique* batch key (U == K capacity;
+              tail padded with the dead row).
+    inverse:  int32 [K] — position of each occurrence in uniq_idx (padding
+              occurrences point at slot U-1).
+    key_mask: float32 [K] — 1.0 for real key occurrences.
+    n_missing: keys that were not in the pass census (observability).
+    """
+
+    idx: np.ndarray
+    uniq_idx: np.ndarray
+    inverse: np.ndarray
+    key_mask: np.ndarray
+    n_missing: int = 0
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(10, (n - 1).bit_length())
+
+
+class SparseTable:
+    def __init__(self, conf: SparseTableConfig, seed: int = 0):
+        self.conf = conf
+        self._rng = np.random.default_rng(seed)
+        w = conf.row_width  # [show, clk, embed...(, expand...)]
+        self._store_keys = np.empty(0, dtype=np.uint64)
+        self._store_vals = np.empty((0, w + 1), dtype=np.float32)  # +g2sum
+        # pass-scoped device state
+        self.values: Optional[jax.Array] = None  # [P, w]
+        self.g2sum: Optional[jax.Array] = None  # [P]
+        self._pass_keys: Optional[np.ndarray] = None  # sorted
+        self._in_pass = False
+        # delta tracking for SaveDelta-style incremental checkpoints
+        self._delta_keys: list[np.ndarray] = []
+        # stats
+        self.missing_key_count = 0
+
+    # -- introspection --------------------------------------------------- #
+    @property
+    def n_features(self) -> int:
+        return int(self._store_keys.shape[0])
+
+    @property
+    def capacity(self) -> int:
+        return 0 if self.values is None else int(self.values.shape[0])
+
+    @property
+    def dead_row(self) -> int:
+        return self.capacity - 1
+
+    # -- pass lifecycle --------------------------------------------------- #
+    def begin_pass(self, pass_keys: np.ndarray) -> None:
+        """Promote the pass working set to device (reference: EndFeedPass
+        SSD->CPU->HBM promote + BeginPass, box_wrapper.cc:630-659)."""
+        if self._in_pass:
+            raise RuntimeError("end_pass the previous pass first")
+        pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
+        w = self.conf.row_width
+        cap = _next_pow2(pk.shape[0] + 1)
+        vals = np.zeros((cap, w + 1), dtype=np.float32)
+        n = pk.shape[0]
+        if n:
+            # resolve against the host store
+            pos = np.searchsorted(self._store_keys, pk)
+            pos_c = np.minimum(pos, max(self.n_features - 1, 0))
+            found = (
+                (self._store_keys[pos_c] == pk)
+                if self.n_features
+                else np.zeros(n, dtype=bool)
+            )
+            vals[:n][found] = self._store_vals[pos_c[found]]
+            n_new = int((~found).sum())
+            if n_new:
+                init = np.zeros((n_new, w + 1), dtype=np.float32)
+                init[:, self.conf.cvm_offset : w] = self._rng.uniform(
+                    -self.conf.initial_range,
+                    self.conf.initial_range,
+                    size=(n_new, w - self.conf.cvm_offset),
+                ).astype(np.float32)
+                vals[:n][~found] = init
+        self.values = jnp.asarray(vals[:, :w])
+        self.g2sum = jnp.asarray(vals[:, w])
+        self._pass_keys = pk
+        self._in_pass = True
+        self._delta_keys.append(pk)
+
+    def end_pass(self) -> None:
+        """Write the working set back to the host store (reference: EndPass
+        HBM->CPU/SSD write-back, box_wrapper.cc:660-673)."""
+        if not self._in_pass:
+            raise RuntimeError("no pass in flight")
+        pk = self._pass_keys
+        n = pk.shape[0]
+        vals = np.concatenate(
+            [np.asarray(self.values), np.asarray(self.g2sum)[:, None]], axis=1
+        )[:n]
+        self._merge_into_store(pk, vals)
+        self.values = None
+        self.g2sum = None
+        self._pass_keys = None
+        self._in_pass = False
+
+    def _merge_into_store(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        if self.n_features == 0:
+            self._store_keys, self._store_vals = keys, vals
+            return
+        pos = np.searchsorted(self._store_keys, keys)
+        pos_c = np.minimum(pos, self.n_features - 1)
+        found = self._store_keys[pos_c] == keys
+        self._store_vals[pos_c[found]] = vals[found]
+        if (~found).any():
+            all_keys = np.concatenate([self._store_keys, keys[~found]])
+            all_vals = np.concatenate([self._store_vals, vals[~found]])
+            order = np.argsort(all_keys, kind="stable")
+            self._store_keys = all_keys[order]
+            self._store_vals = all_vals[order]
+
+    # -- batch planning (host) ------------------------------------------- #
+    def plan_batch(self, batch: HostBatch) -> BatchPlan:
+        return self.plan_keys(batch.keys, batch.n_keys)
+
+    def plan_keys(self, keys: np.ndarray, n_real: int) -> BatchPlan:
+        """Resolve a padded key buffer to device row indices + dedup maps."""
+        if not self._in_pass:
+            raise RuntimeError("begin_pass before planning batches")
+        K = keys.shape[0]
+        dead = self.dead_row
+        idx = np.full(K, dead, dtype=np.int32)
+        uniq_idx = np.full(K, dead, dtype=np.int32)
+        inverse = np.full(K, K - 1, dtype=np.int32)
+        mask = np.zeros(K, dtype=np.float32)
+        n_missing = 0
+        if n_real:
+            real = keys[:n_real]
+            uk, inv = np.unique(real, return_inverse=True)
+            pos = np.searchsorted(self._pass_keys, uk)
+            npk = self._pass_keys.shape[0]
+            pos_c = np.minimum(pos, max(npk - 1, 0))
+            found = (self._pass_keys[pos_c] == uk) if npk else np.zeros(uk.shape[0], bool)
+            rows = np.where(found, pos_c, dead).astype(np.int32)
+            n_missing = int((~found).sum())
+            uniq_idx[: uk.shape[0]] = rows
+            idx[:n_real] = rows[inv]
+            inverse[:n_real] = inv
+            mask[:n_real] = 1.0
+        self.missing_key_count += n_missing
+        return BatchPlan(idx, uniq_idx, inverse, mask, n_missing)
+
+    # -- maintenance (day boundary) --------------------------------------- #
+    def shrink(self) -> int:
+        """Decay show/clk and evict cold features (reference: ShrinkTable +
+        per-day decay, box_wrapper.cc:496-499; semantics per SURVEY.md §7).
+        Returns the number of evicted rows."""
+        if self._in_pass:
+            raise RuntimeError("shrink between passes, not inside one")
+        if self.n_features == 0:
+            return 0
+        self._store_vals[:, 0] *= self.conf.show_decay_rate
+        self._store_vals[:, 1] *= self.conf.show_decay_rate
+        keep = self._store_vals[:, 0] >= self.conf.delete_threshold
+        evicted = int((~keep).sum())
+        if evicted:
+            self._store_keys = self._store_keys[keep]
+            self._store_vals = self._store_vals[keep]
+        return evicted
+
+    # -- persistence ------------------------------------------------------ #
+    def state_dict(self) -> dict:
+        if self._in_pass:
+            raise RuntimeError("end_pass before checkpointing")
+        return {"keys": self._store_keys, "values": self._store_vals}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._store_keys = np.asarray(state["keys"], dtype=np.uint64)
+        self._store_vals = np.asarray(state["values"], dtype=np.float32)
+
+    def delta_state_dict(self) -> dict:
+        """Rows touched since the last pop — SaveDelta's xbox-delta analog
+        (reference: box_wrapper.cc:1411-1460)."""
+        if self._in_pass:
+            raise RuntimeError("end_pass before checkpointing")
+        if not self._delta_keys:
+            return {
+                "keys": np.empty(0, np.uint64),
+                "values": np.empty((0, self.conf.row_width + 1), np.float32),
+            }
+        dk = np.unique(np.concatenate(self._delta_keys))
+        pos = np.searchsorted(self._store_keys, dk)
+        pos_c = np.minimum(pos, max(self.n_features - 1, 0))
+        found = (self._store_keys[pos_c] == dk) if self.n_features else np.zeros(0, bool)
+        dk = dk[found]  # evicted-since keys drop out of the delta
+        return {"keys": dk, "values": self._store_vals[pos_c[found]]}
+
+    def pop_delta(self) -> dict:
+        state = self.delta_state_dict()
+        self._delta_keys = []
+        return state
+
+    def apply_delta(self, state: dict) -> None:
+        keys = np.asarray(state["keys"], dtype=np.uint64)
+        if keys.shape[0]:
+            self._merge_into_store(keys, np.asarray(state["values"], np.float32))
+
+
+# ------------------------------------------------------------------------- #
+# Pure device functions (jit these, or call them inside a larger train_step)
+# ------------------------------------------------------------------------- #
+def pull_rows(
+    values: jax.Array,
+    idx: jax.Array,
+    create_threshold: float = 0.0,
+    cvm_offset: int = 2,
+) -> jax.Array:
+    """Gather pulled value rows [K, W] (reference: PullSparseCase +
+    PullCopy kernels).  With create_threshold > 0, embeddings of rows whose
+    show count is below it read as zero (feature admission: embedx is not
+    materialized until the feature is frequent enough)."""
+    rows = jnp.take(values, idx, axis=0)
+    if create_threshold > 0.0:
+        visible = (rows[..., 0:1] >= create_threshold).astype(rows.dtype)
+        rows = jnp.concatenate(
+            [rows[..., :cvm_offset], rows[..., cvm_offset:] * visible], axis=-1
+        )
+    return rows
+
+
+def push_and_update(
+    values: jax.Array,
+    g2sum: jax.Array,
+    row_grads: jax.Array,
+    plan_idx: jax.Array,
+    plan_uniq_idx: jax.Array,
+    plan_inverse: jax.Array,
+    key_mask: jax.Array,
+    key_clicks: jax.Array,
+    conf: SparseTableConfig,
+):
+    """Merge per-occurrence gradients by unique key and apply the sparse
+    optimizer + show/clk counter update (reference: PushSparseGradCase,
+    box_wrapper_impl.h:165-255 — CopyForPush merge of duplicate keys +
+    closed-lib optimizer; semantics per sparse/optimizer.py).
+
+    row_grads: [K, W] cotangent of the pulled rows (show/clk columns are
+        zero thanks to stop_gradient in the CVM transform).
+    key_clicks: [K] click/label of each occurrence's instance (masked).
+    Returns (values, g2sum) updated.
+    """
+    del plan_idx  # pull-side only; kept in the signature for symmetry
+    U = plan_uniq_idx.shape[0]
+    co = conf.cvm_offset
+    # merge duplicate keys: [K, W] -> [U, W]
+    merged = jax.ops.segment_sum(row_grads, plan_inverse, num_segments=U)
+    show_inc = jax.ops.segment_sum(key_mask, plan_inverse, num_segments=U)
+    clk_inc = jax.ops.segment_sum(key_clicks, plan_inverse, num_segments=U)
+    # sparse adagrad on the embedding columns
+    g = merged[:, co:]
+    g2_rows = jnp.take(g2sum, plan_uniq_idx)
+    w_delta, g2_delta = sparse_adagrad_update(
+        g2_rows, g, conf.learning_rate, conf.initial_g2sum, conf.grad_clip,
+    )
+    counter_delta = jnp.stack([show_inc, clk_inc], axis=1)
+    if co > 2:
+        counter_delta = jnp.concatenate(
+            [counter_delta, jnp.zeros((U, co - 2), counter_delta.dtype)], axis=1
+        )
+    delta = jnp.concatenate([counter_delta, w_delta], axis=1)
+    values = values.at[plan_uniq_idx].add(delta)
+    g2sum = g2sum.at[plan_uniq_idx].add(g2_delta)
+    # the dead row must stay zero: padding slots scatter only zeros, but keys
+    # missing from the pass census carry real grads — scrub them.
+    dead = values.shape[0] - 1
+    values = values.at[dead].set(0.0)
+    g2sum = g2sum.at[dead].set(0.0)
+    return values, g2sum

@@ -1,0 +1,5 @@
+"""Training runtime (SURVEY.md §2.5 analog)."""
+
+from paddlebox_tpu.train.trainer import Trainer, TrainState
+
+__all__ = ["Trainer", "TrainState"]

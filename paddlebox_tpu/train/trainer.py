@@ -1,0 +1,177 @@
+"""Single-chip training loop.
+
+TPU-native redesign of ``BoxPSWorker::TrainFiles`` (reference:
+framework/boxps_worker.cc:542-598) + ``Executor.train_from_dataset``
+(python/paddle/fluid/executor.py:1643): instead of an op-by-op graph
+interpreter, the whole step — pull (gather) -> fused_seqpool_cvm -> dense
+tower -> logloss -> push (scatter + sparse adagrad) -> dense adam -> AUC
+histogram — is ONE jitted function with donated state buffers, so XLA fuses
+everything between the two table scatters and nothing syncs with the host
+inside a step.  Host work per batch is only the numpy key->row planning
+(plan_batch), the analog of the reference's CopyKeys/Dedup staging.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.feed import HostBatch
+from paddlebox_tpu.metrics.auc import AucState, compute_metrics, init_auc_state, update_auc_state
+from paddlebox_tpu.models.layers import bce_with_logits
+from paddlebox_tpu.sparse.table import SparseTable, pull_rows, push_and_update
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Everything the jitted step reads and writes."""
+
+    params: Any  # dense model params (pytree)
+    opt_state: Any  # optax state
+    values: jax.Array  # sparse table working set [P, W]
+    g2sum: jax.Array  # [P]
+    auc: AucState
+
+
+def _device_batch(batch: HostBatch, plan, n_slots: int) -> dict:
+    """Assemble the static-shape device feed from a HostBatch + BatchPlan."""
+    ins = np.minimum(batch.key_segments // n_slots, batch.batch_size - 1)
+    key_clicks = batch.labels[ins] * plan.key_mask
+    return {
+        "idx": jnp.asarray(plan.idx),
+        "uniq_idx": jnp.asarray(plan.uniq_idx),
+        "inverse": jnp.asarray(plan.inverse),
+        "key_mask": jnp.asarray(plan.key_mask),
+        "key_clicks": jnp.asarray(key_clicks),
+        "key_segments": jnp.asarray(batch.key_segments),
+        "dense": jnp.asarray(batch.dense),
+        "labels": jnp.asarray(batch.labels),
+        "ins_mask": jnp.asarray(batch.ins_mask),
+    }
+
+
+class Trainer:
+    """Drives model + SparseTable over a dataset's batches."""
+
+    def __init__(
+        self,
+        model,
+        table_conf: SparseTableConfig,
+        trainer_conf: Optional[TrainerConfig] = None,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.table_conf = table_conf
+        self.conf = trainer_conf or TrainerConfig()
+        if self.conf.dense_optimizer == "adam":
+            self.optimizer = optax.adam(self.conf.dense_lr)
+        elif self.conf.dense_optimizer == "sgd":
+            self.optimizer = optax.sgd(self.conf.dense_lr)
+        else:
+            raise ValueError(f"unknown dense optimizer {self.conf.dense_optimizer!r}")
+        self.params = model.init(jax.random.PRNGKey(seed))
+        self.opt_state = self.optimizer.init(self.params)
+        self._step_fn = None
+        self.global_step = 0
+
+    # -- the fused step ---------------------------------------------------- #
+    def _build_step(self):
+        model = self.model
+        tconf = self.table_conf
+        optimizer = self.optimizer
+        check_nan = self.conf.check_nan_inf
+        B = None  # bound at trace time from batch shapes
+
+        def step(params, opt_state, values, g2sum, auc, batch):
+            rows = pull_rows(
+                values, batch["idx"],
+                create_threshold=tconf.create_threshold,
+                cvm_offset=tconf.cvm_offset,
+            )
+            bsz = batch["labels"].shape[0]
+
+            def loss_fn(p, r):
+                logits = model.apply(p, r, batch["key_segments"], batch["dense"], bsz)
+                per_ins = bce_with_logits(logits, batch["labels"]) * batch["ins_mask"]
+                denom = jnp.maximum(batch["ins_mask"].sum(), 1.0)
+                return per_ins.sum() / denom, jax.nn.sigmoid(logits)
+
+            (loss, preds), (pgrads, row_grads) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(params, rows)
+
+            updates, opt_state = optimizer.update(pgrads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            values, g2sum = push_and_update(
+                values, g2sum, row_grads, batch["idx"], batch["uniq_idx"],
+                batch["inverse"], batch["key_mask"], batch["key_clicks"], tconf,
+            )
+            auc = update_auc_state(auc, preds, batch["labels"], batch["ins_mask"])
+            if check_nan:
+                finite = jnp.isfinite(loss)
+                for leaf in jax.tree.leaves(pgrads):
+                    finite &= jnp.isfinite(leaf).all()
+                finite &= jnp.isfinite(row_grads).all()
+            else:
+                finite = jnp.array(True)
+            return params, opt_state, values, g2sum, auc, loss, finite
+
+        del B
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
+
+    # -- public API --------------------------------------------------------- #
+    def train_from_dataset(
+        self,
+        dataset,
+        table: SparseTable,
+        auc_state: Optional[AucState] = None,
+        drop_last: bool = False,
+    ) -> dict:
+        """Run one pass over the dataset's batches (the TrainFiles analog).
+
+        The caller owns the pass lifecycle: table.begin_pass() before,
+        table.end_pass() after.  Returns the pass metrics.
+        """
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        auc = auc_state if auc_state is not None else init_auc_state(self.conf.auc_buckets)
+        values, g2sum = table.values, table.g2sum
+        losses, n_steps = [], 0
+        for batch in dataset.batches(drop_last=drop_last):
+            plan = table.plan_batch(batch)
+            dev = _device_batch(batch, plan, batch.n_sparse_slots)
+            (self.params, self.opt_state, values, g2sum, auc, loss, finite) = (
+                self._step_fn(self.params, self.opt_state, values, g2sum, auc, dev)
+            )
+            if self.conf.check_nan_inf and not bool(finite):
+                raise FloatingPointError(
+                    f"non-finite loss/grad at step {self.global_step} "
+                    "(FLAGS_check_nan_inf analog)"
+                )
+            losses.append(loss)  # device scalars; synced once at pass end
+            n_steps += 1
+            self.global_step += 1
+        table.values, table.g2sum = values, g2sum
+        metrics = compute_metrics(auc)
+        metrics["loss"] = float(jnp.stack(losses).mean()) if losses else 0.0
+        metrics["steps"] = n_steps
+        self.last_auc_state = auc
+        return metrics
+
+    def train_steps(self, table: SparseTable, batches: Iterable[HostBatch]) -> dict:
+        """Lower-level entry: train over an explicit batch iterable."""
+
+        class _Wrapper:
+            def __init__(self, it):
+                self._it = it
+
+            def batches(self, drop_last=False):
+                return iter(self._it)
+
+        return self.train_from_dataset(_Wrapper(batches), table)

@@ -1,14 +1,26 @@
-"""Test env: force an 8-device virtual CPU mesh before jax import.
+"""Test env: force an 8-device virtual CPU mesh before any backend init.
 
 This is the TPU analog of the reference's localhost-subprocess distributed
-tests (SURVEY.md §4): multi-chip sharding is exercised on a fake CPU mesh."""
+tests (SURVEY.md §4): multi-chip sharding is exercised on a fake CPU mesh.
+
+Note: this image's sitecustomize registers an ``axon`` PJRT backend (the
+real-TPU tunnel) in every Python process and forces
+``jax_platforms="axon,cpu"`` via ``jax.config.update`` — which outranks the
+``JAX_PLATFORMS`` env var.  Unit tests must never touch the tunnel (it is a
+single-client resource reserved for bench.py), so the *config* is overridden
+here, before any test initializes a backend.
+"""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,124 @@
+"""Parity tests for the CTR op set vs numpy oracles.
+
+Mirrors the reference op tests (python/paddle/fluid/tests/unittests/
+test_cvm_op.py, test_fusion_seqpool_cvm_concat_op.py) — SURVEY.md §4 tier 1.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddlebox_tpu.ops import cvm, fused_seqpool_cvm, seqpool
+
+
+def _make_batch(rng, B=4, S=3, W=6, max_len=5):
+    """Random padded-CSR batch like HostBatch: rows per occurrence + segs."""
+    lens = rng.integers(0, max_len, size=(B, S))
+    K_real = int(lens.sum())
+    K = B * S * max_len  # capacity with padding tail
+    rows = rng.normal(size=(K, W)).astype(np.float32)
+    rows[:, 0] = rng.integers(1, 10, size=K)  # show
+    rows[:, 1] = rng.integers(0, 5, size=K)  # clk
+    segs = np.full(K, B * S, dtype=np.int32)
+    seg_ids = np.repeat(np.arange(B * S), lens.reshape(-1))
+    segs[:K_real] = seg_ids
+    rows[K_real:] = 0.0  # padding rows read zeros (dead table row)
+    return rows, segs, lens
+
+
+def _oracle_pool(rows, segs, B, S, W):
+    out = np.zeros((B, S, W), dtype=np.float64)
+    for k in range(rows.shape[0]):
+        if segs[k] < B * S:
+            out[segs[k] // S, segs[k] % S] += rows[k]
+    return out
+
+
+def test_seqpool_matches_oracle():
+    rng = np.random.default_rng(0)
+    B, S, W = 4, 3, 6
+    rows, segs, _ = _make_batch(rng, B, S, W)
+    got = np.asarray(seqpool(jnp.asarray(rows), jnp.asarray(segs), B, S))
+    np.testing.assert_allclose(got, _oracle_pool(rows, segs, B, S, W), rtol=1e-5)
+
+
+def test_fused_seqpool_cvm_use_cvm():
+    rng = np.random.default_rng(1)
+    B, S, W = 4, 3, 6
+    rows, segs, _ = _make_batch(rng, B, S, W)
+    got = np.asarray(
+        fused_seqpool_cvm(jnp.asarray(rows), jnp.asarray(segs), B, S, use_cvm=True)
+    )
+    pooled = _oracle_pool(rows, segs, B, S, W)
+    exp = pooled.copy()
+    exp[..., 0] = np.log(pooled[..., 0] + 1)
+    exp[..., 1] = np.log(pooled[..., 1] + 1) - np.log(pooled[..., 0] + 1)
+    np.testing.assert_allclose(got, exp.reshape(B, -1), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_seqpool_cvm_no_cvm_drops_counters():
+    rng = np.random.default_rng(2)
+    B, S, W = 2, 2, 5
+    rows, segs, _ = _make_batch(rng, B, S, W)
+    got = np.asarray(
+        fused_seqpool_cvm(jnp.asarray(rows), jnp.asarray(segs), B, S, use_cvm=False)
+    )
+    exp = _oracle_pool(rows, segs, B, S, W)[..., 2:].reshape(B, -1)
+    assert got.shape == (B, S * (W - 2))
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_seqpool_cvm_embed_filter():
+    """need_filter zeroes the embedding of low show/clk slots, keeps counters."""
+    B, S, W = 1, 2, 4
+    rows = np.zeros((4, W), dtype=np.float32)
+    rows[0] = [1, 0, 5.0, 5.0]  # slot 0: show 1 -> score 0.2 < 1.0 -> filtered
+    rows[1] = [10, 3, 2.0, 2.0]  # slot 1: score 10*0.2+3 = 5 >= 1.0 -> kept
+    segs = np.array([0, 1, B * S, B * S], dtype=np.int32)
+    got = np.asarray(
+        fused_seqpool_cvm(
+            jnp.asarray(rows), jnp.asarray(segs), B, S,
+            use_cvm=False, need_filter=True, show_coeff=0.2, clk_coeff=1.0,
+            embed_threshold=1.0,
+        )
+    ).reshape(B, S, W - 2)
+    np.testing.assert_allclose(got[0, 0], [0.0, 0.0])
+    np.testing.assert_allclose(got[0, 1], [2.0, 2.0])
+
+
+def test_cvm_forward_and_no_counter_grad():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(5, 7)).astype(np.float32)
+    x[:, 0] = np.abs(x[:, 0]) + 1
+    x[:, 1] = np.abs(x[:, 1])
+    got = np.asarray(cvm(jnp.asarray(x)))
+    exp = x.copy()
+    exp[:, 0] = np.log(x[:, 0] + 1)
+    exp[:, 1] = np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1)
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+    # counters carry no gradient; pass-through columns carry identity grad
+    g = jax.grad(lambda v: cvm(v).sum())(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g[:, :2]), 0.0)
+    np.testing.assert_allclose(np.asarray(g[:, 2:]), 1.0)
+
+
+def test_cvm_use_cvm_false():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    got = np.asarray(cvm(jnp.asarray(x), use_cvm=False))
+    np.testing.assert_allclose(got, x[:, 2:])
+
+
+def test_seqpool_padding_gets_zero_grad():
+    """Gradient wrt padding rows is exactly zero (dead-row hygiene)."""
+    rng = np.random.default_rng(4)
+    B, S, W = 3, 2, 4
+    rows, segs, lens = _make_batch(rng, B, S, W)
+    K_real = int(lens.sum())
+
+    def f(r):
+        return fused_seqpool_cvm(r, jnp.asarray(segs), B, S).sum()
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(rows)))
+    np.testing.assert_allclose(g[K_real:], 0.0)
+    # counters never receive gradient either
+    np.testing.assert_allclose(g[:, :2], 0.0)
