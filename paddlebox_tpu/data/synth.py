@@ -62,14 +62,12 @@ def write_synth_files(
             for _ in range(ins_per_file):
                 logit = 0.0
                 slot_keys: list[np.ndarray] = []
-                n_total = 0
                 for s in range(n_sparse_slots):
                     n = int(rng.integers(1, max_keys_per_slot + 1))
                     local = rng.integers(0, vocab_per_slot, size=n)
                     # globally unique feasign: slot s owns [s*vocab, (s+1)*vocab)
                     slot_keys.append(local + s * vocab_per_slot + 1)
                     logit += key_w[s, local].mean()
-                    n_total += n
                 logit /= n_sparse_slots
                 p = 1.0 / (1.0 + np.exp(-logit))
                 label = int(rng.random() < p)

@@ -1,0 +1,11 @@
+from paddlebox_tpu.parallel.mesh import make_mesh, initialize_distributed
+from paddlebox_tpu.parallel.sharded_table import ShardedSparseTable, ShardedBatchPlan
+from paddlebox_tpu.parallel.trainer import MultiChipTrainer
+
+__all__ = [
+    "make_mesh",
+    "initialize_distributed",
+    "ShardedSparseTable",
+    "ShardedBatchPlan",
+    "MultiChipTrainer",
+]

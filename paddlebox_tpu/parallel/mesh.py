@@ -1,0 +1,76 @@
+"""Device mesh + multi-host bootstrap.
+
+TPU-native replacement for the reference's communication bootstrap zoo —
+NCCL-id TCP rendezvous (operators/collective/gen_nccl_id_op_helper.cc), MPI
+cluster membership inside libbox_ps (box_wrapper.h:415,537), and Gloo
+HDFS/HTTP KV rendezvous (fleet/gloo_wrapper.h:136-150).  On TPU all of it
+collapses into the JAX coordination service (`jax.distributed.initialize`)
+plus one `jax.sharding.Mesh` whose single "data" axis carries data
+parallelism AND the key-sharded sparse table; collectives ride ICI inside a
+slice and DCN across slices with no further configuration (SURVEY.md §2.10).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bootstrap (reference: MPICluster::Ins / gen_nccl_id TCP
+    rendezvous).  No-op for single-process runs; on a multi-host TPU pod the
+    launcher provides the coordinator address (or JAX infers it from the TPU
+    metadata service when all args are None)."""
+    if jax.distributed.is_initialized():
+        return
+    if coordinator_address is None and num_processes is None:
+        # Single-process default: JAX infers cluster membership from the TPU
+        # metadata service when present; a true single-host run raises
+        # because there is no cluster to join, which is fine to ignore —
+        # but only that specific case.  NOTE: must be called before any
+        # backend-initializing JAX call (jax.devices(), process_count(), ...).
+        try:
+            jax.distributed.initialize()
+        except RuntimeError as e:
+            if "backend" in str(e).lower():
+                raise  # called too late — a real bug, do not mask it
+        except ValueError:
+            pass  # no coordinator discoverable: single-process run
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = DATA_AXIS,
+) -> Mesh:
+    """One-axis mesh over the job's devices.
+
+    CTR sparse-PS training is data-parallel with a key-sharded table; both
+    map onto a single mesh axis (the reference's one NCCL ring,
+    collective_helper.h:63).  Model-parallel axes are not needed for this
+    workload (SURVEY.md §5.7).
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"need {n_devices} devices, have {len(devices)}"
+                )
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))

@@ -1,0 +1,235 @@
+"""Multi-chip sparse table: the working set sharded by ``key % n_shards``.
+
+This is the TPU-native answer to the reference's multi-GPU sparse PS
+(reference: per-GPU HBM caches inside ``libbox_ps.so`` behind
+``PullSparseGPU/PushSparseGPU``, fleet/box_wrapper_impl.h:24-255 and
+SURVEY.md §2.7): every chip owns the embedding rows whose key hashes to it,
+a pull becomes all_to_all(row requests) -> local gather -> all_to_all(rows),
+and a push is the exact transpose with a scatter-add accumulation before one
+fused sparse-adagrad update (see parallel/trainer.py for the device side).
+
+The host half here mirrors the single-chip ``SparseTable`` (same host store,
+same pass lifecycle) but materializes the pass working set as one stacked
+``[n_shards, cap, W]`` array laid out for a ``NamedSharding(mesh, P('data'))``
+placement, and resolves batches into *per-owner bucketed* row indices — the
+static-shape plan the all_to_all needs.
+
+Because the host plans every device's batch in one place, it also knows what
+every shard will be asked to *serve* — so the device step needs no key
+exchange at all (the reference pays a CopyKeys + DedupKeysAndFillIdx round
+trip per batch, box_wrapper_impl.h:95-122): just two all_to_alls total, one
+returning pulled rows, one delivering pushed gradients.
+
+Plan layout over n shards, per-device key capacity K, bucket capacity C,
+US = n * C:
+
+    serve_rows [D, n, C] int32  rows shard D must serve: serve_rows[o, d, c]
+                                is requester d's c-th row owned by o
+                                (dead-row padded).
+    occ_flat   [D, K]    int32  o * C + c for each key occurrence of device
+                                d's batch (points into its [n, C] pull
+                                response); padding/overflow -> n * C, which
+                                reads an appended all-zero row.
+    serve_map  [D, n, C] int32  dedup: position of (requester, slot) in
+                                serve_uniq[D] — the same table row requested
+                                by several devices folds into one segment, so
+                                the push-side optimizer update touches each
+                                row exactly once.
+    serve_uniq [D, US]   int32  deduped rows served by shard D (dead padded).
+    key_mask   [D, K]    f32    1.0 for real occurrences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlebox_tpu.config import SparseTableConfig
+from paddlebox_tpu.data.feed import HostBatch
+from paddlebox_tpu.parallel.mesh import DATA_AXIS
+from paddlebox_tpu.sparse.table import SparseTable, _next_pow2
+
+
+@dataclasses.dataclass
+class ShardedBatchPlan:
+    """Stacked host plans for one group of per-device batches.
+
+    Leading axis D == n_shards (one batch per device); sharded over the mesh.
+    """
+
+    serve_rows: np.ndarray  # int32 [D, n, C]
+    occ_flat: np.ndarray  # int32 [D, K]
+    serve_map: np.ndarray  # int32 [D, n, C]
+    serve_uniq: np.ndarray  # int32 [D, n*C]
+    key_mask: np.ndarray  # f32 [D, K]
+    n_missing: int = 0  # keys absent from the pass census
+    n_overflow: int = 0  # unique keys dropped by bucket-capacity overflow
+
+
+class ShardedSparseTable(SparseTable):
+    """Same host store / persistence / shrink as SparseTable; the pass
+    working set lives as one stacked, mesh-sharded array."""
+
+    def __init__(
+        self,
+        conf: SparseTableConfig,
+        mesh: Mesh,
+        seed: int = 0,
+        bucket_slack: float = 2.0,
+    ):
+        super().__init__(conf, seed)
+        self.mesh = mesh
+        self.n_shards = int(mesh.devices.size)
+        # all_to_all bucket capacity multiplier over the uniform-hash
+        # expectation K / n_shards; overflowing keys read zeros and push
+        # nothing (counted in plan.n_overflow).
+        self.bucket_slack = float(bucket_slack)
+        self._shard_keys: Optional[list[np.ndarray]] = None
+        self.overflow_key_count = 0  # unique keys dropped by bucket overflow
+
+    # -- pass lifecycle --------------------------------------------------- #
+    def begin_pass(self, pass_keys: np.ndarray) -> None:
+        if self._in_pass:
+            raise RuntimeError("end_pass the previous pass first")
+        pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
+        n = self.n_shards
+        owner = (pk % np.uint64(n)).astype(np.int64)
+        shard_keys = [pk[owner == o] for o in range(n)]  # each stays sorted
+        w = self.conf.row_width
+        cap = _next_pow2(max((sk.shape[0] for sk in shard_keys), default=0) + 1)
+        vals = np.zeros((n, cap, w + 1), dtype=np.float32)
+        for o, sk in enumerate(shard_keys):
+            vals[o, : sk.shape[0]] = self._resolve_or_init(sk)
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.values = jax.device_put(jnp.asarray(vals[:, :, :w]), sharding)
+        self.g2sum = jax.device_put(jnp.asarray(vals[:, :, w]), sharding)
+        self._shard_keys = shard_keys
+        self._pass_keys = pk
+        self._in_pass = True
+        self._delta_keys.append(pk)
+
+    def end_pass(self) -> None:
+        if not self._in_pass:
+            raise RuntimeError("no pass in flight")
+        vals = np.asarray(self.values)  # [n, cap, W]
+        g2 = np.asarray(self.g2sum)  # [n, cap]
+        for o, sk in enumerate(self._shard_keys):
+            m = sk.shape[0]
+            if m:
+                merged = np.concatenate([vals[o, :m], g2[o, :m, None]], axis=1)
+                self._merge_into_store(sk, merged)
+        self.values = None
+        self.g2sum = None
+        self._shard_keys = None
+        self._pass_keys = None
+        self._in_pass = False
+
+    # -- planning --------------------------------------------------------- #
+    @property
+    def shard_capacity(self) -> int:
+        return 0 if self.values is None else int(self.values.shape[1])
+
+    def plan_batch(self, batch):  # pragma: no cover - guard
+        raise TypeError(
+            "ShardedSparseTable plans whole device groups: use "
+            "plan_group([batch_per_device, ...]) with MultiChipTrainer "
+            "(the single-chip plan_batch would index the stacked layout wrong)"
+        )
+
+    def plan_keys(self, keys, n_real):  # pragma: no cover - guard
+        raise TypeError(
+            "ShardedSparseTable plans whole device groups: use plan_group()"
+        )
+
+    def bucket_capacity(self, key_capacity: int) -> int:
+        n = self.n_shards
+        c = int(np.ceil(key_capacity * self.bucket_slack / n / 8.0)) * 8
+        return min(key_capacity, max(c, 8))
+
+    def plan_group(
+        self, batches: Sequence[HostBatch], bucket_capacity: Optional[int] = None
+    ) -> ShardedBatchPlan:
+        """Resolve one per-device batch group into the stacked a2a plan."""
+        if not self._in_pass:
+            raise RuntimeError("begin_pass before planning batches")
+        if len(batches) != self.n_shards:
+            raise ValueError(
+                f"need {self.n_shards} batches (one per device), got {len(batches)}"
+            )
+        K = batches[0].keys.shape[0]
+        C = bucket_capacity or self.bucket_capacity(K)
+        n = self.n_shards
+        D = len(batches)
+        dead = self.shard_capacity - 1
+        want = np.full((D, n, C), dead, dtype=np.int32)
+        occ = np.full((D, K), n * C, dtype=np.int32)
+        mask = np.zeros((D, K), dtype=np.float32)
+        n_missing = n_overflow = 0
+        for d, b in enumerate(batches):
+            if b.n_keys == 0:
+                continue
+            real = b.keys[: b.n_keys]
+            uk, inv = np.unique(real, return_inverse=True)
+            rows, owner, miss = self._resolve_shard_rows(uk)
+            slot = _rank_within_group(owner, n)
+            ok = slot < C
+            n_missing += miss
+            n_overflow += int((~ok).sum())
+            want[d, owner[ok], slot[ok]] = rows[ok]
+            flat = np.where(ok, owner * C + slot, n * C).astype(np.int32)
+            occ[d, : b.n_keys] = flat[inv]
+            mask[d, : b.n_keys] = 1.0
+        # the serve side: shard o serves want[:, o, :]; dedup rows so the
+        # push-side optimizer touches each row once (dead row shares one
+        # segment — it is scrubbed after every push anyway)
+        serve_rows = np.ascontiguousarray(want.transpose(1, 0, 2))  # [D, n, C]
+        serve_map = np.empty((D, n, C), dtype=np.int32)
+        serve_uniq = np.full((D, n * C), dead, dtype=np.int32)
+        for o in range(D):
+            uq, inv = np.unique(serve_rows[o].reshape(-1), return_inverse=True)
+            serve_uniq[o, : uq.shape[0]] = uq
+            serve_map[o] = inv.reshape(n, C).astype(np.int32)
+        self.missing_key_count += n_missing
+        self.overflow_key_count += n_overflow
+        return ShardedBatchPlan(
+            serve_rows, occ, serve_map, serve_uniq, mask, n_missing, n_overflow
+        )
+
+    def _resolve_shard_rows(self, uk: np.ndarray):
+        """Owner shard + row-within-shard for sorted unique keys (dead row
+        when absent from the pass census)."""
+        n = self.n_shards
+        dead = self.shard_capacity - 1
+        owner = (uk % np.uint64(n)).astype(np.int64)
+        rows = np.full(uk.shape[0], dead, dtype=np.int32)
+        missing = 0
+        for o in range(n):
+            m = owner == o
+            if not m.any():
+                continue
+            sk = self._shard_keys[o]
+            if sk.shape[0] == 0:
+                missing += int(m.sum())
+                continue
+            pos = np.searchsorted(sk, uk[m])
+            pos_c = np.minimum(pos, sk.shape[0] - 1)
+            found = sk[pos_c] == uk[m]
+            rows[m] = np.where(found, pos_c, dead).astype(np.int32)
+            missing += int((~found).sum())
+        return rows, owner, missing
+
+
+def _rank_within_group(group: np.ndarray, n_groups: int) -> np.ndarray:
+    """rank_within_group([2,0,2,1]) -> [0,0,1,0]: occurrence index of each
+    element within its group, preserving order."""
+    order = np.argsort(group, kind="stable")
+    sorted_g = group[order]
+    starts = np.searchsorted(sorted_g, np.arange(n_groups))
+    ranks = np.empty_like(group)
+    ranks[order] = np.arange(group.shape[0]) - starts[sorted_g]
+    return ranks

@@ -1,0 +1,386 @@
+"""Multi-chip training: data parallel over the mesh, sparse pull/push via
+all_to_all against the key-sharded table.
+
+TPU-native redesign of the reference's multi-GPU path (SURVEY.md §2.9/§3.2):
+
+  * sparse pull  — the reference calls ``boxps_ptr_->PullSparseGPU`` whose
+    closed lib resolves remote shards over NVLink/MPI.  Here the host plan
+    (sharded_table.plan_group) already bucketed row requests per owner, so
+    the device does: all_to_all(requested rows) -> local HBM gather ->
+    all_to_all(rows back) -> occurrence scatter.  All static shapes, all on
+    ICI.
+  * sparse push  — transpose of pull: segment-sum per-occurrence grads into
+    per-owner buckets, all_to_all, scatter-add into the local shard's
+    accumulator, then ONE vectorized sparse-adagrad update over the shard
+    (rows untouched this batch see zero grad and are left exactly unchanged).
+    Duplicate keys across chips merge in the accumulator — same semantics as
+    the reference's ``PushMergeCopy`` + closed-lib update
+    (box_wrapper_impl.h:165-255).
+  * dense sync   — ``sync_dense_mode="step"``: psum gradients every step (the
+    allreduce path, transpiler/collective.py:196-287); ``"kstep"``: local
+    updates + param pmean every ``sync_weight_step`` steps (the reference's
+    DenseKStep sync, boxps_worker.cc:481-521).
+  * metrics      — per-device AUC histograms, merged at read time
+    (box_wrapper.cc:230-273 collect_data_nccl analog is a host-side sum here;
+    use metrics.auc.psum_auc_state to fold it into the step if desired).
+
+The whole step runs under one jit(shard_map(...)) with donated state, so XLA
+overlaps the all_to_alls with the dense tower compute where possible.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.feed import HostBatch, empty_like
+from paddlebox_tpu.metrics.auc import (
+    AucState,
+    compute_metrics,
+    init_auc_state,
+    update_auc_state,
+)
+from paddlebox_tpu.models.layers import bce_with_logits
+from paddlebox_tpu.parallel.mesh import DATA_AXIS
+from paddlebox_tpu.parallel.sharded_table import ShardedBatchPlan, ShardedSparseTable
+from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
+
+shard_map = jax.shard_map
+
+
+def _stack_group(
+    batches: Sequence[HostBatch], plan: ShardedBatchPlan, n_slots: int
+) -> dict:
+    """Stack per-device batches + plan into [D, ...] arrays (numpy)."""
+    key_clicks = []
+    for b, m in zip(batches, plan.key_mask):
+        ins = np.minimum(b.key_segments // n_slots, b.batch_size - 1)
+        key_clicks.append(b.labels[ins] * m)
+    return {
+        "serve_rows": plan.serve_rows,
+        "occ_flat": plan.occ_flat,
+        "serve_map": plan.serve_map,
+        "serve_uniq": plan.serve_uniq,
+        "key_mask": plan.key_mask,
+        "key_clicks": np.stack(key_clicks),
+        "key_segments": np.stack([b.key_segments for b in batches]),
+        "dense": np.stack([b.dense for b in batches]),
+        "labels": np.stack([b.labels for b in batches]),
+        "ins_mask": np.stack([b.ins_mask for b in batches]),
+    }
+
+
+def sharded_pull(values: jax.Array, serve_rows: jax.Array, occ_flat: jax.Array,
+                 create_threshold: float, cvm_offset: int) -> jax.Array:
+    """Device-local half of a cross-chip pull (call inside shard_map).
+
+    The host plan already told this shard which rows to serve, so there is no
+    key-exchange round trip (reference pays CopyKeys + DedupKeysAndFillIdx,
+    box_wrapper_impl.h:95-122): local gather -> ONE all_to_all -> occurrence
+    scatter.
+
+    values: [cap, W] local shard; serve_rows: [n, C] rows this shard serves
+    to each requester; occ_flat: [K] into the received [n, C] response.
+    Returns pulled rows [K, W].
+    """
+    n, C = serve_rows.shape
+    W = values.shape[1]
+    served = jnp.take(values, serve_rows.reshape(-1), axis=0)  # [n*C, W]
+    got = jax.lax.all_to_all(served.reshape(n, C, W), DATA_AXIS, 0, 0)
+    got_flat = jnp.concatenate(
+        [got.reshape(n * C, W), jnp.zeros((1, W), values.dtype)]
+    )
+    rows = jnp.take(got_flat, occ_flat, axis=0)  # [K, W]
+    if create_threshold > 0.0:
+        visible = (rows[..., 0:1] >= create_threshold).astype(rows.dtype)
+        rows = jnp.concatenate(
+            [rows[..., :cvm_offset], rows[..., cvm_offset:] * visible], axis=-1
+        )
+    return rows
+
+
+def sharded_push_and_update(
+    values: jax.Array,
+    g2sum: jax.Array,
+    row_grads: jax.Array,
+    occ_flat: jax.Array,
+    serve_map: jax.Array,
+    serve_uniq: jax.Array,
+    key_mask: jax.Array,
+    key_clicks: jax.Array,
+    conf: SparseTableConfig,
+):
+    """Device-local half of a cross-chip push (call inside shard_map).
+
+    Merges occurrence grads into per-owner buckets, exchanges them (the one
+    push all_to_all), folds contributions from all requesters of the same row
+    into one segment via the host-precomputed dedup (serve_map/serve_uniq),
+    and applies show/clk counters + sparse adagrad to exactly the touched
+    rows — O(batch), not O(shard).
+    """
+    n, C = serve_map.shape
+    co = conf.cvm_offset
+    cap, W = values.shape
+    US = serve_uniq.shape[0]
+    nseg = n * C + 1  # last segment = padding/overflow sink, dropped
+    merged = jax.ops.segment_sum(row_grads, occ_flat, num_segments=nseg)[: n * C]
+    show_m = jax.ops.segment_sum(key_mask, occ_flat, num_segments=nseg)[: n * C]
+    clk_m = jax.ops.segment_sum(key_clicks, occ_flat, num_segments=nseg)[: n * C]
+    counters = jnp.stack([show_m, clk_m], axis=1)
+    if co > 2:
+        counters = jnp.concatenate(
+            [counters, jnp.zeros((n * C, co - 2), counters.dtype)], axis=1
+        )
+    send = jnp.concatenate([counters, merged[:, co:]], axis=1).reshape(n, C, W)
+    recv = jax.lax.all_to_all(send, DATA_AXIS, 0, 0)  # [n, C, W]
+    # cross-requester merge: duplicate rows across devices fold together
+    acc = jax.ops.segment_sum(
+        recv.reshape(n * C, W), serve_map.reshape(-1), num_segments=US
+    )  # [US, W]
+    g2_rows = jnp.take(g2sum, serve_uniq)
+    w_delta, g2_delta = sparse_adagrad_update(
+        g2_rows, acc[:, co:], conf.learning_rate, conf.initial_g2sum,
+        conf.grad_clip,
+    )
+    delta = jnp.concatenate([acc[:, :co], w_delta], axis=1)
+    values = values.at[serve_uniq].add(delta)
+    g2sum = g2sum.at[serve_uniq].add(g2_delta)
+    # scrub the dead row: padding requests and census-missing keys land there
+    values = values.at[cap - 1].set(0.0)
+    g2sum = g2sum.at[cap - 1].set(0.0)
+    return values, g2sum
+
+
+class MultiChipTrainer:
+    """Drives model + ShardedSparseTable over a mesh (BoxPSTrainer analog:
+    one worker per device — here, one shard_map body per device)."""
+
+    def __init__(
+        self,
+        model,
+        table_conf: SparseTableConfig,
+        mesh: Mesh,
+        trainer_conf: Optional[TrainerConfig] = None,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.table_conf = table_conf
+        self.mesh = mesh
+        self.n_dev = int(mesh.devices.size)
+        self.conf = trainer_conf or TrainerConfig()
+        if self.conf.dense_optimizer == "adam":
+            self.optimizer = optax.adam(self.conf.dense_lr)
+        elif self.conf.dense_optimizer == "sgd":
+            self.optimizer = optax.sgd(self.conf.dense_lr)
+        else:
+            raise ValueError(f"unknown dense optimizer {self.conf.dense_optimizer!r}")
+        # params/opt_state are stored stacked [D, ...] and mesh-sharded: in
+        # "step" mode every device holds an identical copy (grads are
+        # psummed); in "kstep" mode copies drift and sync_params() re-averages
+        # them (the reference's CopyParameters broadcast + K-step SyncParam).
+        p0 = model.init(jax.random.PRNGKey(seed))
+        o0 = self.optimizer.init(p0)
+        self._sharding = NamedSharding(mesh, P(DATA_AXIS))
+        self._replicate = NamedSharding(mesh, P())
+        stack = lambda t: jax.device_put(
+            jax.tree.map(lambda x: jnp.stack([x] * self.n_dev), t), self._sharding
+        )
+        self.params = stack(p0)
+        self.opt_state = stack(o0)
+        self._step_fn = None
+        self._sync_fn = None
+        self.global_step = 0
+
+    # -- jitted bodies ----------------------------------------------------- #
+    def _build_step(self):
+        model = self.model
+        tconf = self.table_conf
+        optimizer = self.optimizer
+        conf = self.conf
+        sync_step = conf.sync_dense_mode == "step"
+        check_nan = conf.check_nan_inf
+
+        def body(params, opt_state, values, g2sum, auc, batch):
+            # local blocks all carry a leading device axis of size 1
+            unstack = lambda t: jax.tree.map(lambda x: x[0], t)
+            params, opt_state, auc = unstack(params), unstack(opt_state), unstack(auc)
+            values, g2sum = values[0], g2sum[0]
+            batch = unstack(batch)
+
+            rows = sharded_pull(
+                values, batch["serve_rows"], batch["occ_flat"],
+                tconf.create_threshold, tconf.cvm_offset,
+            )
+            bsz = batch["labels"].shape[0]
+
+            def loss_fn(p, r):
+                logits = model.apply(p, r, batch["key_segments"], batch["dense"], bsz)
+                per_ins = bce_with_logits(logits, batch["labels"]) * batch["ins_mask"]
+                local_cnt = batch["ins_mask"].sum()
+                if sync_step:
+                    denom = jnp.maximum(jax.lax.psum(local_cnt, DATA_AXIS), 1.0)
+                else:
+                    denom = jnp.maximum(local_cnt, 1.0)
+                return per_ins.sum() / denom, jax.nn.sigmoid(logits)
+
+            (loss, preds), (pgrads, row_grads) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(params, rows)
+            if sync_step:
+                pgrads = jax.lax.psum(pgrads, DATA_AXIS)
+                loss = jax.lax.psum(loss, DATA_AXIS)
+
+            updates, opt_state = optimizer.update(pgrads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            values, g2sum = sharded_push_and_update(
+                values, g2sum, row_grads, batch["occ_flat"], batch["serve_map"],
+                batch["serve_uniq"], batch["key_mask"], batch["key_clicks"], tconf,
+            )
+            auc = update_auc_state(auc, preds, batch["labels"], batch["ins_mask"])
+            if check_nan:
+                finite = jnp.isfinite(loss)
+                for leaf in jax.tree.leaves(pgrads):
+                    finite &= jnp.isfinite(leaf).all()
+                finite &= jnp.isfinite(row_grads).all()
+            else:
+                finite = jnp.array(True)
+            restack = lambda t: jax.tree.map(lambda x: x[None], t)
+            cnt = batch["ins_mask"].sum()
+            return (
+                restack(params), restack(opt_state), values[None], g2sum[None],
+                restack(auc), loss[None], cnt[None], finite[None],
+            )
+
+        spec = P(DATA_AXIS)
+        mapped = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(spec, spec, spec, spec, spec, spec),
+            out_specs=(spec, spec, spec, spec, spec, spec, spec, spec),
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1, 2, 3, 4))
+
+    def _build_sync(self):
+        """K-step param sync: average drifted replicas (reference: SyncParam
+        ncclAllReduce / reduce-scatter+allgather then scale, boxps_worker.cc:481-521)."""
+
+        def body(params, opt_state):
+            pm = jax.tree.map(
+                lambda x: jax.lax.pmean(x[0], DATA_AXIS)[None], params
+            )
+            om = jax.tree.map(
+                lambda x: jax.lax.pmean(x[0], DATA_AXIS)[None], opt_state
+            )
+            return pm, om
+
+        spec = P(DATA_AXIS)
+        mapped = shard_map(
+            body, mesh=self.mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+        )
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    # -- public API --------------------------------------------------------- #
+    def init_auc(self) -> AucState:
+        auc = init_auc_state(self.conf.auc_buckets)
+        return jax.device_put(
+            jax.tree.map(lambda x: jnp.stack([x] * self.n_dev), auc), self._sharding
+        )
+
+    def train_from_dataset(
+        self,
+        dataset,
+        table: ShardedSparseTable,
+        auc_state: Optional[AucState] = None,
+        drop_last: bool = False,
+    ) -> dict:
+        """One pass over the dataset, n_dev batches at a time (the caller owns
+        begin_pass/end_pass, as in the single-chip Trainer)."""
+        return self.train_groups(
+            table, _group_batches(dataset.batches(drop_last=drop_last), self.n_dev),
+            auc_state=auc_state,
+        )
+
+    def train_groups(
+        self,
+        table: ShardedSparseTable,
+        groups: Iterator[Sequence[HostBatch]],
+        auc_state: Optional[AucState] = None,
+    ) -> dict:
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        if self._sync_fn is None and self.conf.sync_dense_mode == "kstep":
+            self._sync_fn = self._build_sync()
+        auc = auc_state if auc_state is not None else self.init_auc()
+        values, g2sum = table.values, table.g2sum
+        losses, counts, n_steps = [], [], 0
+        n_slots = None
+        for group in groups:
+            if n_slots is None:
+                n_slots = group[0].n_sparse_slots
+            plan = table.plan_group(group)
+            feed = _stack_group(group, plan, n_slots)
+            feed = jax.device_put(feed, self._sharding)
+            (self.params, self.opt_state, values, g2sum, auc, loss, cnt, finite) = (
+                self._step_fn(self.params, self.opt_state, values, g2sum, auc, feed)
+            )
+            if self.conf.check_nan_inf and not bool(np.asarray(finite).all()):
+                raise FloatingPointError(
+                    f"non-finite loss/grad at step {self.global_step} "
+                    "(FLAGS_check_nan_inf analog)"
+                )
+            losses.append(loss)
+            counts.append(cnt)
+            n_steps += 1
+            self.global_step += 1
+            if (
+                self.conf.sync_dense_mode == "kstep"
+                and self.global_step % max(self.conf.sync_weight_step, 1) == 0
+            ):
+                self.params, self.opt_state = self._sync_fn(
+                    self.params, self.opt_state
+                )
+        table.values, table.g2sum = values, g2sum
+        merged = jax.tree.map(lambda x: np.asarray(x).sum(0), auc)
+        metrics = compute_metrics(merged)
+        if losses:
+            per_step = np.stack([np.asarray(l) for l in losses])  # [T, D]
+            if self.conf.sync_dense_mode == "kstep":
+                # local losses are local means: recombine weighted by real
+                # instance counts so padded empty batches don't bias the pass
+                cnts = np.stack([np.asarray(c) for c in counts])  # [T, D]
+                num = (per_step * cnts).sum(axis=1)
+                den = np.maximum(cnts.sum(axis=1), 1.0)
+                metrics["loss"] = float((num / den).mean())
+            else:
+                # psummed loss is replicated across the axis
+                metrics["loss"] = float(per_step[:, 0].mean())
+        else:
+            metrics["loss"] = 0.0
+        metrics["steps"] = n_steps
+        metrics["missing_keys"] = table.missing_key_count
+        metrics["overflow_keys"] = table.overflow_key_count
+        self.last_auc_state = auc
+        return metrics
+
+
+def _group_batches(
+    batches: Iterator[HostBatch], n: int
+) -> Iterator[list[HostBatch]]:
+    """Yield n batches at a time; a ragged tail is padded with empty batches
+    (ins_mask all zero) so every device always receives a feed."""
+    group: list[HostBatch] = []
+    for b in batches:
+        group.append(b)
+        if len(group) == n:
+            yield group
+            group = []
+    if group:
+        pad = empty_like(group[0])
+        group += [pad] * (n - len(group))
+        yield group

@@ -69,10 +69,34 @@ def _next_pow2(n: int) -> int:
     return 1 << max(10, (n - 1).bit_length())
 
 
+_SM64_1 = np.uint64(0x9E3779B97F4A7C15)
+_SM64_2 = np.uint64(0xBF58476D1CE4E5B9)
+_SM64_3 = np.uint64(0x94D049BB133111EB)
+
+
+def _key_uniform(keys: np.ndarray, seed: int, n_cols: int, rng_range: float) -> np.ndarray:
+    """Deterministic per-(key, seed, column) uniform(-range, range) init via a
+    splitmix64 hash.  Independent of table sharding and of the order keys are
+    first seen, so single-chip and key-sharded multi-chip tables initialize
+    any feature identically (and a rebuilt table reproduces a lost one)."""
+    with np.errstate(over="ignore"):
+        x = (
+            keys[:, None].astype(np.uint64)
+            + np.uint64(seed + 1) * _SM64_1
+            + np.arange(1, n_cols + 1, dtype=np.uint64)[None, :] * _SM64_2
+        )
+        z = (x + _SM64_1)
+        z = (z ^ (z >> np.uint64(30))) * _SM64_2
+        z = (z ^ (z >> np.uint64(27))) * _SM64_3
+        z = z ^ (z >> np.uint64(31))
+    u = (z >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))  # [0, 1)
+    return ((u * 2.0 - 1.0) * rng_range).astype(np.float32)
+
+
 class SparseTable:
     def __init__(self, conf: SparseTableConfig, seed: int = 0):
         self.conf = conf
-        self._rng = np.random.default_rng(seed)
+        self._seed = seed
         w = conf.row_width  # [show, clk, embed...(, expand...)]
         self._store_keys = np.empty(0, dtype=np.uint64)
         self._store_vals = np.empty((0, w + 1), dtype=np.float32)  # +g2sum
@@ -100,6 +124,31 @@ class SparseTable:
         return self.capacity - 1
 
     # -- pass lifecycle --------------------------------------------------- #
+    def _resolve_or_init(self, pk: np.ndarray) -> np.ndarray:
+        """Rows for sorted unique keys ``pk``: fetched from the host store
+        when present, freshly initialized otherwise.  Returns [n, W+1]."""
+        w = self.conf.row_width
+        n = pk.shape[0]
+        vals = np.zeros((n, w + 1), dtype=np.float32)
+        if n:
+            pos = np.searchsorted(self._store_keys, pk)
+            pos_c = np.minimum(pos, max(self.n_features - 1, 0))
+            found = (
+                (self._store_keys[pos_c] == pk)
+                if self.n_features
+                else np.zeros(n, dtype=bool)
+            )
+            vals[found] = self._store_vals[pos_c[found]]
+            n_new = int((~found).sum())
+            if n_new:
+                init = np.zeros((n_new, w + 1), dtype=np.float32)
+                init[:, self.conf.cvm_offset : w] = _key_uniform(
+                    pk[~found], self._seed, w - self.conf.cvm_offset,
+                    self.conf.initial_range,
+                )
+                vals[~found] = init
+        return vals
+
     def begin_pass(self, pass_keys: np.ndarray) -> None:
         """Promote the pass working set to device (reference: EndFeedPass
         SSD->CPU->HBM promote + BeginPass, box_wrapper.cc:630-659)."""
@@ -110,25 +159,7 @@ class SparseTable:
         cap = _next_pow2(pk.shape[0] + 1)
         vals = np.zeros((cap, w + 1), dtype=np.float32)
         n = pk.shape[0]
-        if n:
-            # resolve against the host store
-            pos = np.searchsorted(self._store_keys, pk)
-            pos_c = np.minimum(pos, max(self.n_features - 1, 0))
-            found = (
-                (self._store_keys[pos_c] == pk)
-                if self.n_features
-                else np.zeros(n, dtype=bool)
-            )
-            vals[:n][found] = self._store_vals[pos_c[found]]
-            n_new = int((~found).sum())
-            if n_new:
-                init = np.zeros((n_new, w + 1), dtype=np.float32)
-                init[:, self.conf.cvm_offset : w] = self._rng.uniform(
-                    -self.conf.initial_range,
-                    self.conf.initial_range,
-                    size=(n_new, w - self.conf.cvm_offset),
-                ).astype(np.float32)
-                vals[:n][~found] = init
+        vals[:n] = self._resolve_or_init(pk)
         self.values = jnp.asarray(vals[:, :w])
         self.g2sum = jnp.asarray(vals[:, w])
         self._pass_keys = pk

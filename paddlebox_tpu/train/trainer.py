@@ -86,7 +86,6 @@ class Trainer:
         tconf = self.table_conf
         optimizer = self.optimizer
         check_nan = self.conf.check_nan_inf
-        B = None  # bound at trace time from batch shapes
 
         def step(params, opt_state, values, g2sum, auc, batch):
             rows = pull_rows(
@@ -122,7 +121,6 @@ class Trainer:
                 finite = jnp.array(True)
             return params, opt_state, values, g2sum, auc, loss, finite
 
-        del B
         return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
 
     # -- public API --------------------------------------------------------- #

@@ -1,0 +1,188 @@
+"""Multi-chip correctness on the 8-device virtual CPU mesh (SURVEY.md §4
+tier 3 — the TPU analog of the reference's localhost-subprocess distributed
+tests, test_dist_base.py:642: distributed loss must equal local loss)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models.ctr_dnn import CtrDnn
+from paddlebox_tpu.parallel import (
+    MultiChipTrainer,
+    ShardedSparseTable,
+    make_mesh,
+)
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train.trainer import Trainer
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= N_DEV, "conftest must force 8 CPU devices"
+    return make_mesh(N_DEV)
+
+
+def _make_data(tmp_path, n_ins, batch_size, **kw):
+    conf = make_synth_config(
+        n_sparse_slots=3, dense_dim=2, batch_size=batch_size,
+        max_feasigns_per_ins=16, **kw,
+    )
+    files = write_synth_files(
+        str(tmp_path), n_files=2, ins_per_file=n_ins // 2,
+        n_sparse_slots=3, vocab_per_slot=50, dense_dim=2, seed=7,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=2)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    return conf, ds
+
+
+# --------------------------------------------------------------------------- #
+# Sharded table unit behavior
+# --------------------------------------------------------------------------- #
+class TestShardedTable:
+    def test_begin_pass_shards_by_mod(self, mesh):
+        tconf = SparseTableConfig(embedding_dim=4)
+        table = ShardedSparseTable(tconf, mesh, seed=0)
+        keys = np.arange(1, 100, dtype=np.uint64)
+        table.begin_pass(keys)
+        assert table.values.shape[0] == N_DEV
+        for o, sk in enumerate(table._shard_keys):
+            assert (sk % np.uint64(N_DEV) == o).all()
+        assert sum(len(sk) for sk in table._shard_keys) == 99
+        table.end_pass()
+        assert table.n_features == 99
+
+    def test_roundtrip_preserves_rows(self, mesh):
+        tconf = SparseTableConfig(embedding_dim=4, initial_range=0.1)
+        table = ShardedSparseTable(tconf, mesh, seed=0)
+        keys = np.array([3, 11, 19, 27, 64, 123], dtype=np.uint64)
+        table.begin_pass(keys)
+        table.end_pass()
+        st = table.state_dict()
+        # second pass must resolve the same rows back
+        table.begin_pass(keys)
+        vals = np.asarray(table.values)
+        for o, sk in enumerate(table._shard_keys):
+            for i, k in enumerate(sk):
+                row_in_store = st["values"][np.searchsorted(st["keys"], k)]
+                np.testing.assert_allclose(
+                    vals[o, i], row_in_store[:-1], rtol=1e-6
+                )
+        table.end_pass()
+
+    def test_plan_routes_to_owner(self, mesh):
+        tconf = SparseTableConfig(embedding_dim=4)
+        table = ShardedSparseTable(tconf, mesh, seed=0, bucket_slack=8.0)
+        keys = np.arange(1, 65, dtype=np.uint64)
+        table.begin_pass(keys)
+        from paddlebox_tpu.data.feed import HostBatch
+
+        K = 16
+        batches = []
+        for d in range(N_DEV):
+            kb = np.zeros(K, dtype=np.uint64)
+            kb[:4] = [d * 4 + 1, d * 4 + 2, d * 4 + 3, d * 4 + 4]
+            batches.append(HostBatch(
+                keys=kb, key_segments=np.zeros(K, np.int32), n_keys=4,
+                dense=np.zeros((2, 1), np.float32), labels=np.zeros(2, np.float32),
+                ins_mask=np.ones(2, np.float32), batch_size=2, n_sparse_slots=2,
+            ))
+        plan = table.plan_group(batches)
+        assert plan.n_missing == 0 and plan.n_overflow == 0
+        for d in range(N_DEV):
+            for k in batches[d].keys[:4]:
+                o = int(k % N_DEV)
+                sk = table._shard_keys[o]
+                row = int(np.searchsorted(sk, k))
+                # shard o must serve that row to requester d, and the dedup
+                # map must point the pair at it
+                assert row in plan.serve_rows[o, d], (d, k, o)
+                assert row in plan.serve_uniq[o], (d, k, o)
+        # single-chip plan entry points must be refused on the sharded table
+        with pytest.raises(TypeError):
+            table.plan_batch(batches[0])
+        table.end_pass()
+
+
+# --------------------------------------------------------------------------- #
+# The tier-3 gate: multi-chip == single-chip
+# --------------------------------------------------------------------------- #
+class TestMultiChipEqualsSingleChip:
+    def test_loss_and_table_match(self, mesh, tmp_path):
+        n_ins = 256
+        B = 16  # per-device batch; single-chip uses B * N_DEV
+        tconf = SparseTableConfig(embedding_dim=8, learning_rate=0.05)
+        trconf = TrainerConfig(dense_lr=1e-3, sync_dense_mode="step",
+                               auc_buckets=1 << 12)
+
+        # ---- single chip on the concatenated global batch ----
+        conf1, ds1 = _make_data(tmp_path / "a", n_ins, B * N_DEV)
+        model1 = CtrDnn(3, tconf.row_width, dense_dim=2, hidden=(32, 16))
+        t1 = Trainer(model1, tconf, trconf, seed=3)
+        table1 = SparseTable(tconf, seed=5)
+        table1.begin_pass(ds1.unique_keys())
+        m1 = t1.train_from_dataset(ds1, table1)
+        table1.end_pass()
+
+        # ---- multi chip: same instances split into per-device batches ----
+        conf8, ds8 = _make_data(tmp_path / "b", n_ins, B)
+        model8 = CtrDnn(3, tconf.row_width, dense_dim=2, hidden=(32, 16))
+        t8 = MultiChipTrainer(model8, tconf, mesh, trconf, seed=3)
+        table8 = ShardedSparseTable(tconf, mesh, seed=5, bucket_slack=float(N_DEV))
+        table8.begin_pass(ds8.unique_keys())
+        m8 = t8.train_from_dataset(ds8, table8)
+        table8.end_pass()
+
+        assert m8["steps"] * N_DEV == m1["steps"] * N_DEV  # same data volume
+        # losses are means over the same instances -> must match closely
+        assert abs(m1["loss"] - m8["loss"]) < 2e-4, (m1["loss"], m8["loss"])
+        assert abs(m1["auc"] - m8["auc"]) < 5e-3, (m1["auc"], m8["auc"])
+        assert m1["count"] == m8["count"] == n_ins
+
+        # ---- the sparse tables must agree feature-by-feature ----
+        s1, s8 = table1.state_dict(), table8.state_dict()
+        np.testing.assert_array_equal(s1["keys"], s8["keys"])
+        np.testing.assert_allclose(s1["values"], s8["values"], atol=2e-4)
+
+    def test_kstep_sync_runs_and_learns(self, mesh, tmp_path):
+        tconf = SparseTableConfig(
+            embedding_dim=8, learning_rate=0.5, initial_range=0.05
+        )
+        trconf = TrainerConfig(sync_dense_mode="kstep", sync_weight_step=4,
+                               dense_lr=3e-3, auc_buckets=1 << 12)
+        conf, ds = _make_data(tmp_path / "k", 512, 16)
+        model = CtrDnn(3, tconf.row_width, dense_dim=2, hidden=(32, 16))
+        tr = MultiChipTrainer(model, tconf, mesh, trconf, seed=0)
+        table = ShardedSparseTable(tconf, mesh, seed=0)
+        results = []
+        for _ in range(4):
+            table.begin_pass(ds.unique_keys())
+            results.append(tr.train_from_dataset(ds, table))
+            table.end_pass()
+        assert results[-1]["loss"] < results[0]["loss"]
+        assert results[-1]["auc"] > 0.6
+        # after a sync step the replicas must be identical
+        p = jax.tree.leaves(tr.params)[0]
+        np.testing.assert_allclose(np.asarray(p)[0], np.asarray(p)[-1], rtol=1e-6)
+
+    def test_ragged_tail_padding(self, mesh, tmp_path):
+        """Instance count not divisible by n_dev * B: padded empty batches
+        must contribute nothing."""
+        tconf = SparseTableConfig(embedding_dim=4)
+        trconf = TrainerConfig(auc_buckets=1 << 10)
+        conf, ds = _make_data(tmp_path / "r", 150, 16)  # 150 = 9 batches + tail
+        model = CtrDnn(3, tconf.row_width, dense_dim=2, hidden=(16,))
+        tr = MultiChipTrainer(model, tconf, mesh, trconf, seed=0)
+        table = ShardedSparseTable(tconf, mesh, seed=0)
+        table.begin_pass(ds.unique_keys())
+        m = tr.train_from_dataset(ds, table)
+        table.end_pass()
+        assert m["count"] == 150
