@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""End-to-end CTR-DNN throughput benchmark (driver entry).
+
+Prints ONE JSON line to stdout:
+    {"metric": "ctr_dnn_samples_per_sec", "value": N, "unit": "samples/sec",
+     "vs_baseline": R}
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
+measured speedup of our pass-scoped design (host key planning + dedup merge +
+fused segment-sum pooling, sparse/table.py) over a *naive JAX port* of the
+same model (no dedup, per-slot masked pooling — what a line-for-line
+translation of pull_box_sparse + sequence_pool would look like).  Details and
+host-parser throughput land in BASELINE.md by hand; stderr carries the
+breakdown.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_data(td: str, n_slots: int, dense_dim: int, batch_size: int,
+               n_ins: int, vocab_per_slot: int):
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+
+    conf = make_synth_config(
+        n_sparse_slots=n_slots, dense_dim=dense_dim, batch_size=batch_size,
+        max_feasigns_per_ins=64, batch_key_capacity=batch_size * n_slots * 4,
+    )
+    files = write_synth_files(
+        td, n_files=4, ins_per_file=n_ins // 4, n_sparse_slots=n_slots,
+        vocab_per_slot=vocab_per_slot, dense_dim=dense_dim, seed=7,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=4)
+    ds.set_filelist(files)
+    t0 = time.perf_counter()
+    ds.load_into_memory()
+    parse_s = time.perf_counter() - t0
+    log(f"host parse: {n_ins} ins in {parse_s:.2f}s = {n_ins / parse_s:,.0f} lines/s")
+    return conf, ds, parse_s
+
+
+def bench_ours(ds, tconf, trconf, model, seed=0):
+    """Full pipeline: host plan_batch + jitted fused step."""
+    import jax
+
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.train.trainer import Trainer, _device_batch
+
+    table = SparseTable(tconf, seed=seed)
+    table.begin_pass(ds.unique_keys())
+    trainer = Trainer(model, tconf, trconf, seed=seed)
+    trainer._step_fn = trainer._build_step()
+    from paddlebox_tpu.metrics.auc import init_auc_state
+
+    auc = init_auc_state(trconf.auc_buckets)
+    values, g2sum = table.values, table.g2sum
+    params, opt_state = trainer.params, trainer.opt_state
+
+    batches = list(ds.batches(drop_last=True))
+    n_slots = batches[0].n_sparse_slots
+    B = batches[0].batch_size
+
+    # warmup / compile on the first batch
+    plan = table.plan_batch(batches[0])
+    dev = _device_batch(batches[0], plan, n_slots)
+    t0 = time.perf_counter()
+    params, opt_state, values, g2sum, auc, loss, _ = trainer._step_fn(
+        params, opt_state, values, g2sum, auc, dev)
+    loss.block_until_ready()
+    log(f"ours: compile+first step {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    n = 0
+    for b in batches[1:]:
+        plan = table.plan_batch(b)
+        dev = _device_batch(b, plan, n_slots)
+        params, opt_state, values, g2sum, auc, loss, _ = trainer._step_fn(
+            params, opt_state, values, g2sum, auc, dev)
+        n += B
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    table.values, table.g2sum = values, g2sum
+    table.end_pass()
+    sps = n / dt
+    log(f"ours: {n} samples in {dt:.2f}s = {sps:,.0f} samples/s "
+        f"({len(batches) - 1} steps, batch {B})")
+    return sps
+
+
+def bench_naive(ds, tconf, trconf, model_hidden, seed=0):
+    """Naive JAX port: embedding rows gathered per occurrence with NO dedup,
+    per-slot masked mean... pooling via S separate masked segment matmuls,
+    scatter-add per occurrence (duplicate keys collide serially), full-table
+    adagrad state read-modify-write.  This is what translating
+    pull_box_sparse/sequence_pool op-by-op yields."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddlebox_tpu.models.layers import bce_with_logits, init_mlp, mlp
+    from paddlebox_tpu.sparse.table import SparseTable
+
+    table = SparseTable(tconf, seed=seed)
+    table.begin_pass(ds.unique_keys())
+    values, g2sum = table.values, table.g2sum
+
+    batches = list(ds.batches(drop_last=True))
+    n_slots = batches[0].n_sparse_slots
+    B = batches[0].batch_size
+    W = tconf.row_width
+    in_dim = n_slots * W + batches[0].dense.shape[1]
+    params = init_mlp(jax.random.PRNGKey(seed), in_dim, model_hidden, 1)
+    optimizer = optax.adam(trconf.dense_lr)
+    opt_state = optimizer.init(params)
+
+    def step(params, opt_state, values, g2sum, batch):
+        rows = jnp.take(values, batch["idx"], axis=0)  # [K, W] no dedup
+
+        def loss_fn(p, r):
+            # naive per-slot pooling: S one-hot matmuls instead of one
+            # segment_sum over a fused segment index
+            pooled = []
+            seg = batch["key_segments"]
+            for s in range(n_slots):
+                sel = ((seg % n_slots) == s) & (seg < B * n_slots)
+                onehot = (
+                    (seg // n_slots)[:, None] == jnp.arange(B)[None, :]
+                ) & sel[:, None]
+                pooled.append(onehot.astype(r.dtype).T @ r)  # [B, W]
+            x = jnp.concatenate(pooled + [batch["dense"]], axis=1)
+            logits = mlp(p, x)[:, 0]
+            per_ins = bce_with_logits(logits, batch["labels"]) * batch["ins_mask"]
+            return per_ins.sum() / jnp.maximum(batch["ins_mask"].sum(), 1.0)
+
+        loss, (pgrads, row_grads) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            params, rows)
+        updates, opt_state = optimizer.update(pgrads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # per-occurrence scatter-add, then full-table dense adagrad
+        grad_tab = jnp.zeros_like(values).at[batch["idx"]].add(row_grads)
+        g2 = g2sum + (grad_tab[:, 2:] ** 2).mean(axis=1)
+        scale = tconf.learning_rate / (jnp.sqrt(g2 + tconf.initial_g2sum))
+        values = values - grad_tab * scale[:, None]
+        return params, opt_state, values, g2, loss
+
+    step = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def feed(b):
+        plan = table.plan_batch(b)
+        return {
+            "idx": jnp.asarray(plan.idx),
+            "key_segments": jnp.asarray(b.key_segments),
+            "dense": jnp.asarray(b.dense),
+            "labels": jnp.asarray(b.labels),
+            "ins_mask": jnp.asarray(b.ins_mask),
+        }
+
+    t0 = time.perf_counter()
+    params, opt_state, values, g2sum, loss = step(
+        params, opt_state, values, g2sum, feed(batches[0]))
+    loss.block_until_ready()
+    log(f"naive: compile+first step {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    n = 0
+    for b in batches[1:]:
+        params, opt_state, values, g2sum, loss = step(
+            params, opt_state, values, g2sum, feed(b))
+        n += B
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    table.values, table.g2sum = values, g2sum
+    table.end_pass()
+    sps = n / dt
+    log(f"naive: {n} samples in {dt:.2f}s = {sps:,.0f} samples/s")
+    return sps
+
+
+def main() -> None:
+    from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+    from paddlebox_tpu.models import CtrDnn
+
+    N_SLOTS, DENSE, B = 16, 13, 2048
+    N_INS = 40 * B  # 40 steps
+    HIDDEN = (512, 256, 128)
+    tconf = SparseTableConfig(embedding_dim=8)
+    trconf = TrainerConfig(auc_buckets=1 << 20)
+
+    with tempfile.TemporaryDirectory() as td:
+        conf, ds, parse_s = build_data(td, N_SLOTS, DENSE, B, N_INS, 100_000)
+        model = CtrDnn(N_SLOTS, tconf.row_width, dense_dim=DENSE, hidden=HIDDEN)
+        ours = bench_ours(ds, tconf, trconf, model)
+        try:
+            naive = bench_naive(ds, tconf, trconf, HIDDEN)
+        except Exception as e:  # naive baseline OOM/failed: still report ours
+            log(f"naive baseline failed: {e!r}")
+            naive = float("nan")
+        ds.close()
+
+    vs = ours / naive if np.isfinite(naive) and naive > 0 else 1.0
+    print(json.dumps({
+        "metric": "ctr_dnn_samples_per_sec",
+        "value": round(ours, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,14 +35,14 @@ def initialize_distributed(
     if coordinator_address is None and num_processes is None:
         # Single-process default: JAX infers cluster membership from the TPU
         # metadata service when present; a true single-host run raises
-        # because there is no cluster to join, which is fine to ignore —
-        # but only that specific case.  NOTE: must be called before any
-        # backend-initializing JAX call (jax.devices(), process_count(), ...).
+        # ValueError because there is no cluster to join, which is the one
+        # case that is fine to ignore.  RuntimeErrors (called after backend
+        # init, rendezvous/barrier failures) must propagate — masking them
+        # would silently degrade a pod job into N independent single-host
+        # runs.  NOTE: must be called before any backend-initializing JAX
+        # call (jax.devices(), process_count(), ...).
         try:
             jax.distributed.initialize()
-        except RuntimeError as e:
-            if "backend" in str(e).lower():
-                raise  # called too late — a real bug, do not mask it
         except ValueError:
             pass  # no coordinator discoverable: single-process run
         return

@@ -100,6 +100,12 @@ class ShardedSparseTable(SparseTable):
         n = self.n_shards
         owner = (pk % np.uint64(n)).astype(np.int64)
         shard_keys = [pk[owner == o] for o in range(n)]  # each stays sorted
+        # precomputed key -> (owner, row-within-shard) map aligned with the
+        # sorted pass keys, so per-batch planning is one searchsorted
+        row_within = np.empty(pk.shape[0], dtype=np.int32)
+        for o in range(n):
+            m = owner == o
+            row_within[m] = np.arange(int(m.sum()), dtype=np.int32)
         w = self.conf.row_width
         cap = _next_pow2(max((sk.shape[0] for sk in shard_keys), default=0) + 1)
         vals = np.zeros((n, cap, w + 1), dtype=np.float32)
@@ -109,6 +115,8 @@ class ShardedSparseTable(SparseTable):
         self.values = jax.device_put(jnp.asarray(vals[:, :, :w]), sharding)
         self.g2sum = jax.device_put(jnp.asarray(vals[:, :, w]), sharding)
         self._shard_keys = shard_keys
+        self._pass_owner = owner.astype(np.int32)
+        self._pass_row = row_within
         self._pass_keys = pk
         self._in_pass = True
         self._delta_keys.append(pk)
@@ -127,12 +135,25 @@ class ShardedSparseTable(SparseTable):
         self.g2sum = None
         self._shard_keys = None
         self._pass_keys = None
+        self._pass_owner = None
+        self._pass_row = None
         self._in_pass = False
 
     # -- planning --------------------------------------------------------- #
     @property
     def shard_capacity(self) -> int:
         return 0 if self.values is None else int(self.values.shape[1])
+
+    @property
+    def capacity(self) -> int:
+        """Total working-set rows across shards (the inherited property would
+        read the stacked leading axis and report n_shards)."""
+        return self.shard_capacity * self.n_shards
+
+    @property
+    def dead_row(self) -> int:
+        """In-shard dead-row index (what planning actually uses)."""
+        return self.shard_capacity - 1
 
     def plan_batch(self, batch):  # pragma: no cover - guard
         raise TypeError(
@@ -202,26 +223,18 @@ class ShardedSparseTable(SparseTable):
 
     def _resolve_shard_rows(self, uk: np.ndarray):
         """Owner shard + row-within-shard for sorted unique keys (dead row
-        when absent from the pass census)."""
-        n = self.n_shards
+        when absent from the pass census): one vectorized searchsorted into
+        the begin_pass-precomputed (owner, row) map."""
         dead = self.shard_capacity - 1
-        owner = (uk % np.uint64(n)).astype(np.int64)
-        rows = np.full(uk.shape[0], dead, dtype=np.int32)
-        missing = 0
-        for o in range(n):
-            m = owner == o
-            if not m.any():
-                continue
-            sk = self._shard_keys[o]
-            if sk.shape[0] == 0:
-                missing += int(m.sum())
-                continue
-            pos = np.searchsorted(sk, uk[m])
-            pos_c = np.minimum(pos, sk.shape[0] - 1)
-            found = sk[pos_c] == uk[m]
-            rows[m] = np.where(found, pos_c, dead).astype(np.int32)
-            missing += int((~found).sum())
-        return rows, owner, missing
+        owner = (uk % np.uint64(self.n_shards)).astype(np.int64)
+        npk = self._pass_keys.shape[0]
+        if npk == 0:
+            return np.full(uk.shape[0], dead, np.int32), owner, uk.shape[0]
+        pos = np.searchsorted(self._pass_keys, uk)
+        pos_c = np.minimum(pos, npk - 1)
+        found = self._pass_keys[pos_c] == uk
+        rows = np.where(found, self._pass_row[pos_c], dead).astype(np.int32)
+        return rows, owner, int((~found).sum())
 
 
 def _rank_within_group(group: np.ndarray, n_groups: int) -> np.ndarray:

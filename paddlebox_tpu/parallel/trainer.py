@@ -187,7 +187,6 @@ class MultiChipTrainer:
         p0 = model.init(jax.random.PRNGKey(seed))
         o0 = self.optimizer.init(p0)
         self._sharding = NamedSharding(mesh, P(DATA_AXIS))
-        self._replicate = NamedSharding(mesh, P())
         stack = lambda t: jax.device_put(
             jax.tree.map(lambda x: jnp.stack([x] * self.n_dev), t), self._sharding
         )
@@ -320,32 +319,37 @@ class MultiChipTrainer:
         values, g2sum = table.values, table.g2sum
         losses, counts, n_steps = [], [], 0
         n_slots = None
-        for group in groups:
-            if n_slots is None:
-                n_slots = group[0].n_sparse_slots
-            plan = table.plan_group(group)
-            feed = _stack_group(group, plan, n_slots)
-            feed = jax.device_put(feed, self._sharding)
-            (self.params, self.opt_state, values, g2sum, auc, loss, cnt, finite) = (
-                self._step_fn(self.params, self.opt_state, values, g2sum, auc, feed)
-            )
-            if self.conf.check_nan_inf and not bool(np.asarray(finite).all()):
-                raise FloatingPointError(
-                    f"non-finite loss/grad at step {self.global_step} "
-                    "(FLAGS_check_nan_inf analog)"
+        try:
+            for group in groups:
+                if n_slots is None:
+                    n_slots = group[0].n_sparse_slots
+                plan = table.plan_group(group)
+                feed = _stack_group(group, plan, n_slots)
+                feed = jax.device_put(feed, self._sharding)
+                (self.params, self.opt_state, values, g2sum, auc, loss, cnt, finite) = (
+                    self._step_fn(self.params, self.opt_state, values, g2sum, auc, feed)
                 )
-            losses.append(loss)
-            counts.append(cnt)
-            n_steps += 1
-            self.global_step += 1
-            if (
-                self.conf.sync_dense_mode == "kstep"
-                and self.global_step % max(self.conf.sync_weight_step, 1) == 0
-            ):
-                self.params, self.opt_state = self._sync_fn(
-                    self.params, self.opt_state
-                )
-        table.values, table.g2sum = values, g2sum
+                if self.conf.check_nan_inf and not bool(np.asarray(finite).all()):
+                    raise FloatingPointError(
+                        f"non-finite loss/grad at step {self.global_step} "
+                        "(FLAGS_check_nan_inf analog)"
+                    )
+                losses.append(loss)
+                counts.append(cnt)
+                n_steps += 1
+                self.global_step += 1
+                if (
+                    self.conf.sync_dense_mode == "kstep"
+                    and self.global_step % max(self.conf.sync_weight_step, 1) == 0
+                ):
+                    self.params, self.opt_state = self._sync_fn(
+                        self.params, self.opt_state
+                    )
+        finally:
+            # the old table buffers were donated to the jitted step: always
+            # hand the live ones back so end_pass() can salvage the pass even
+            # when check_nan_inf raises mid-loop
+            table.values, table.g2sum = values, g2sum
         merged = jax.tree.map(lambda x: np.asarray(x).sum(0), auc)
         metrics = compute_metrics(merged)
         if losses:

@@ -141,21 +141,25 @@ class Trainer:
         auc = auc_state if auc_state is not None else init_auc_state(self.conf.auc_buckets)
         values, g2sum = table.values, table.g2sum
         losses, n_steps = [], 0
-        for batch in dataset.batches(drop_last=drop_last):
-            plan = table.plan_batch(batch)
-            dev = _device_batch(batch, plan, batch.n_sparse_slots)
-            (self.params, self.opt_state, values, g2sum, auc, loss, finite) = (
-                self._step_fn(self.params, self.opt_state, values, g2sum, auc, dev)
-            )
-            if self.conf.check_nan_inf and not bool(finite):
-                raise FloatingPointError(
-                    f"non-finite loss/grad at step {self.global_step} "
-                    "(FLAGS_check_nan_inf analog)"
+        try:
+            for batch in dataset.batches(drop_last=drop_last):
+                plan = table.plan_batch(batch)
+                dev = _device_batch(batch, plan, batch.n_sparse_slots)
+                (self.params, self.opt_state, values, g2sum, auc, loss, finite) = (
+                    self._step_fn(self.params, self.opt_state, values, g2sum, auc, dev)
                 )
-            losses.append(loss)  # device scalars; synced once at pass end
-            n_steps += 1
-            self.global_step += 1
-        table.values, table.g2sum = values, g2sum
+                if self.conf.check_nan_inf and not bool(finite):
+                    raise FloatingPointError(
+                        f"non-finite loss/grad at step {self.global_step} "
+                        "(FLAGS_check_nan_inf analog)"
+                    )
+                losses.append(loss)  # device scalars; synced once at pass end
+                n_steps += 1
+                self.global_step += 1
+        finally:
+            # old buffers were donated to the jitted step: always hand the
+            # live ones back so end_pass() works even after a NaN raise
+            table.values, table.g2sum = values, g2sum
         metrics = compute_metrics(auc)
         metrics["loss"] = float(jnp.stack(losses).mean()) if losses else 0.0
         metrics["steps"] = n_steps
