@@ -25,3 +25,4 @@ from paddlebox_tpu.config import (  # noqa: F401
     TrainerConfig,
     flags,
 )
+from paddlebox_tpu.checkpoint import CheckpointManager  # noqa: F401

@@ -1,0 +1,249 @@
+"""Pass-boundary checkpointing: full "base" models + incremental "delta"s.
+
+TPU-native equivalent of the reference's model persistence (reference:
+fleet/box_wrapper.cc:1411-1460 ``SaveBase``/``SaveDelta`` writing day-keyed
+batch/xbox model dirs, reload ``InitializeGPUAndLoadModel`` cc:1329, plus the
+fleet_util donefile helpers, python/paddle/fluid/incubate/fleet/utils/
+fleet_util.py):
+
+  * ``save_base(tag, ...)``  — the whole sparse host store + dense params +
+    optimizer state, atomically (write to tmp dir, rename), then append a
+    donefile line.  Day-granular recovery point.
+  * ``save_delta(tag, ...)`` — only sparse rows touched since the last save
+    (``SparseTable.pop_delta``) + the (small) dense state.  The xbox-delta
+    analog for frequent intra-day publishing.
+  * ``load(...)``            — restore the latest base and every delta after
+    it (or up to an explicit tag).
+
+Formats are dependency-free: ``.npz`` for arrays; dense pytrees are flattened
+with ``jax.tree_util`` path strings as npz keys, so restore needs a template
+pytree of the same structure (the freshly-initialized params) and never
+unpickles anything.
+
+Works unchanged for ``SparseTable`` and ``ShardedSparseTable`` — both keep
+the same host store; sharding is a per-pass device layout, not a storage
+format.  Multi-host: each process passes a distinct ``shard`` id and saves
+its own store slice under the same tag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+# --------------------------------------------------------------------------- #
+# dense pytree <-> npz
+# --------------------------------------------------------------------------- #
+def _flatten_paths(tree: Any) -> dict[str, np.ndarray]:
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {jax.tree_util.keystr(path): np.asarray(leaf) for path, leaf in flat}
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    np.savez(path, **_flatten_paths(tree))
+
+
+def load_pytree(path: str, template: Any) -> Any:
+    """Rebuild a pytree with ``template``'s structure from saved leaves.
+    Raises KeyError if the structure does not match the file."""
+    with np.load(path) as data:
+        leaves_by_key = {k: data[k] for k in data.files}
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, old in paths:
+        key = jax.tree_util.keystr(path)
+        if key not in leaves_by_key:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        leaves.append(
+            jax.numpy.asarray(leaves_by_key[key], dtype=np.asarray(old).dtype)
+        )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint manager
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass
+class CheckpointInfo:
+    kind: str  # "base" | "delta"
+    tag: str
+    dirname: str
+    meta: dict
+
+
+class CheckpointManager:
+    """Directory layout::
+
+        root/
+          base-<tag>/   sparse.npz  dense.npz  opt.npz  meta.json
+          delta-<tag>/  ...
+          donefile.txt  one json line per completed checkpoint, append-only
+                        (the fleet_util donefile analog)
+    """
+
+    def __init__(self, root: str, shard: int = 0, n_shards: int = 1):
+        self.root = root
+        self.shard = int(shard)
+        self.n_shards = int(n_shards)
+        os.makedirs(root, exist_ok=True)
+
+    # -- write ------------------------------------------------------------- #
+    def _sparse_name(self) -> str:
+        return f"sparse-{self.shard:05d}.npz" if self.n_shards > 1 else "sparse.npz"
+
+    def _meta_name(self) -> str:
+        return f"meta-{self.shard:05d}.json" if self.n_shards > 1 else "meta.json"
+
+    def _write(
+        self,
+        kind: str,
+        tag: str,
+        sparse_state: dict,
+        params: Any = None,
+        opt_state: Any = None,
+        meta: Optional[dict] = None,
+    ) -> str:
+        dirname = os.path.join(self.root, f"{kind}-{tag}")
+        tmp = dirname + f".tmp-{os.getpid()}-{self.shard}"
+        os.makedirs(tmp, exist_ok=True)
+        np.savez(
+            os.path.join(tmp, self._sparse_name()),
+            keys=sparse_state["keys"],
+            values=sparse_state["values"],
+        )
+        # dense state is replicated: by convention shard 0 owns it
+        if params is not None and self.shard == 0:
+            save_pytree(os.path.join(tmp, "dense.npz"), params)
+        if opt_state is not None and self.shard == 0:
+            save_pytree(os.path.join(tmp, "opt.npz"), opt_state)
+        full_meta = {
+            "kind": kind,
+            "tag": tag,
+            "time": time.time(),
+            "n_sparse_rows": int(np.asarray(sparse_state["keys"]).shape[0]),
+            "shard": self.shard,
+            "n_shards": self.n_shards,
+            **(meta or {}),
+        }
+        with open(os.path.join(tmp, self._meta_name()), "w") as fh:
+            json.dump(full_meta, fh)
+        if self.n_shards == 1:
+            if os.path.exists(dirname):
+                shutil.rmtree(dirname)
+            os.replace(tmp, dirname)
+        else:
+            # shard files have disjoint names: create-if-absent then move each
+            # file atomically, so concurrent shard saves never collide
+            os.makedirs(dirname, exist_ok=True)
+            for f in os.listdir(tmp):
+                os.replace(os.path.join(tmp, f), os.path.join(dirname, f))
+            os.rmdir(tmp)
+        with open(os.path.join(self.root, "donefile.txt"), "a") as fh:
+            fh.write(json.dumps(full_meta) + "\n")
+        return dirname
+
+    def save_base(
+        self,
+        tag: str,
+        table,
+        params: Any = None,
+        opt_state: Any = None,
+        meta: Optional[dict] = None,
+    ) -> str:
+        """Full model (reference SaveBase).  On success resets the table's
+        delta tracker — a delta chain restarts from every base."""
+        state = table.state_dict()
+        meta = {"table_seed": table._seed, **(meta or {})}
+        out = self._write("base", tag, state, params, opt_state, meta)
+        table.clear_delta()  # only after the write landed
+        return out
+
+    def save_delta(
+        self,
+        tag: str,
+        table,
+        params: Any = None,
+        opt_state: Any = None,
+        meta: Optional[dict] = None,
+    ) -> str:
+        """Rows touched since the previous base/delta (reference SaveDelta)."""
+        meta = {"table_seed": table._seed, **(meta or {})}
+        state = table.delta_state_dict()
+        out = self._write("delta", tag, state, params, opt_state, meta)
+        table.clear_delta()  # only after the write landed
+        return out
+
+    # -- read -------------------------------------------------------------- #
+    def list_checkpoints(self) -> list[CheckpointInfo]:
+        """Completed checkpoints in donefile order (this shard's entries)."""
+        done = os.path.join(self.root, "donefile.txt")
+        if not os.path.exists(done):
+            return []
+        out = []
+        with open(done) as fh:
+            for line in fh:
+                meta = json.loads(line)
+                if meta.get("shard", 0) != self.shard:
+                    continue
+                dirname = os.path.join(self.root, f"{meta['kind']}-{meta['tag']}")
+                if os.path.isdir(dirname):
+                    out.append(CheckpointInfo(meta["kind"], meta["tag"], dirname, meta))
+        return out
+
+    def load(
+        self,
+        table,
+        params_template: Any = None,
+        opt_template: Any = None,
+        upto: Optional[str] = None,
+    ):
+        """Restore the latest base plus all following deltas (optionally
+        stopping at tag ``upto``).  Returns (params, opt_state, meta) — None
+        for pytrees without a template or file.  Reference:
+        InitializeGPUAndLoadModel (box_wrapper.cc:1329)."""
+        ckpts = self.list_checkpoints()
+        if upto is not None:
+            keep, found = [], False
+            for c in ckpts:
+                keep.append(c)
+                if c.tag == upto:
+                    found = True
+                    break
+            if not found:
+                raise FileNotFoundError(f"no checkpoint tagged {upto!r}")
+            ckpts = keep
+        base_i = max(
+            (i for i, c in enumerate(ckpts) if c.kind == "base"), default=None
+        )
+        if base_i is None:
+            raise FileNotFoundError(f"no base checkpoint under {self.root}")
+        chain = ckpts[base_i:]
+        sparse_name = self._sparse_name()
+        with np.load(os.path.join(chain[0].dirname, sparse_name)) as d:
+            table.load_state_dict({"keys": d["keys"], "values": d["values"]})
+        for c in chain[1:]:
+            if c.kind != "delta":
+                continue
+            with np.load(os.path.join(c.dirname, sparse_name)) as d:
+                table.apply_delta({"keys": d["keys"], "values": d["values"]})
+        last = chain[-1]
+        # deterministic resume: unseen-feature init depends on the table seed,
+        # so a restored table must reproduce the saved one's init stream
+        if "table_seed" in last.meta:
+            table._seed = int(last.meta["table_seed"])
+        params = opt_state = None
+        dense_p = os.path.join(last.dirname, "dense.npz")
+        if params_template is not None and os.path.exists(dense_p):
+            params = load_pytree(dense_p, params_template)
+        opt_p = os.path.join(last.dirname, "opt.npz")
+        if opt_template is not None and os.path.exists(opt_p):
+            opt_state = load_pytree(opt_p, opt_template)
+        return params, opt_state, last.meta

@@ -104,6 +104,9 @@ class DataFeedConfig:
     enable_pv_merge: bool = False
     rank_offset: str = ""  # name of the rank-offset tensor for rank_attention
     rank_offset_cols: int = 7  # reference: data_feed.cc max_rank 3 -> 7 cols
+    # cmatch codes whose instances participate in PV ranking; None = all
+    # (reference hard-codes ad channels {222, 223}, data_feed.cu:219)
+    rank_cmatch_filter: Optional[Sequence[int]] = None
     parse_ins_id: bool = False
     parse_logkey: bool = False  # search_id / rank / cmatch packed key
     label_slot: str = "click"  # float slot whose first value is the label
@@ -113,6 +116,10 @@ class DataFeedConfig:
     max_feasigns_per_ins: int = 256
     # total key capacity of one device batch; None -> batch_size * max_feasigns_per_ins
     batch_key_capacity: Optional[int] = None
+
+    @property
+    def max_rank(self) -> int:
+        return (self.rank_offset_cols - 1) // 2
 
     def used_slots(self) -> list[SlotConfig]:
         return [s for s in self.slots if s.is_used]

@@ -1,0 +1,99 @@
+"""Binary instance archives: framed RecordBlock serialization.
+
+TPU-native analog of the reference's ``BinaryArchive`` (fast raw
+serialization for shuffle RPC, framework/archive.h) and
+``BinaryArchiveWriter`` (archived instance files on disk,
+framework/data_feed.h:1544-1559, written by ``PreLoadIntoDisk``
+data_set.cc:1577).  One format serves both uses here: the shuffle wire
+format and the disk-spill file format.
+
+Layout per frame: ``u64 payload_len`` + payload, payload being an ``.npz``
+(zip of arrays) — zero custom parsing, numpy-native, and self-describing
+enough to survive schema growth (optional columns are simply absent).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import BinaryIO, Iterator, Optional
+
+import numpy as np
+
+from paddlebox_tpu.data.record import RecordBlock
+
+_LEN = np.dtype("<u8")
+
+
+def block_to_bytes(block: RecordBlock) -> bytes:
+    arrays = {
+        "n_ins": np.int64(block.n_ins),
+        "n_sparse_slots": np.int64(block.n_sparse_slots),
+        "keys": block.keys,
+        "key_offsets": block.key_offsets,
+        "dense": block.dense,
+        "labels": block.labels,
+    }
+    if block.ins_ids is not None:
+        arrays["ins_ids"] = np.asarray(block.ins_ids, dtype=np.str_)
+    for f in ("search_ids", "ranks", "cmatches"):
+        v = getattr(block, f)
+        if v is not None:
+            arrays[f] = v
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def block_from_bytes(data: bytes) -> RecordBlock:
+    with np.load(io.BytesIO(data)) as z:
+        get = lambda k: z[k] if k in z.files else None
+        ins_ids = get("ins_ids")
+        return RecordBlock(
+            n_ins=int(z["n_ins"]),
+            n_sparse_slots=int(z["n_sparse_slots"]),
+            keys=z["keys"],
+            key_offsets=z["key_offsets"],
+            dense=z["dense"],
+            labels=z["labels"],
+            ins_ids=None if ins_ids is None else [str(s) for s in ins_ids],
+            search_ids=get("search_ids"),
+            ranks=get("ranks"),
+            cmatches=get("cmatches"),
+        )
+
+
+def write_frame(fh: BinaryIO, payload: bytes) -> None:
+    fh.write(np.uint64(len(payload)).tobytes())
+    fh.write(payload)
+
+
+def read_frame(fh: BinaryIO) -> Optional[bytes]:
+    head = fh.read(8)
+    if not head:
+        return None
+    if len(head) != 8:
+        raise EOFError("truncated archive frame header")
+    n = int(np.frombuffer(head, dtype=_LEN)[0])
+    payload = fh.read(n)
+    if len(payload) != n:
+        raise EOFError("truncated archive frame payload")
+    return payload
+
+
+def write_archive(path: str, blocks) -> int:
+    """Write blocks to a framed archive file; returns frames written."""
+    n = 0
+    with open(path, "wb") as fh:
+        for b in blocks:
+            write_frame(fh, block_to_bytes(b))
+            n += 1
+    return n
+
+
+def read_archive(path: str) -> Iterator[RecordBlock]:
+    with open(path, "rb") as fh:
+        while True:
+            payload = read_frame(fh)
+            if payload is None:
+                return
+            yield block_from_bytes(payload)

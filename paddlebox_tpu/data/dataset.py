@@ -110,6 +110,10 @@ class PadBoxSlotDataset:
         if self._block is None:
             raise RuntimeError("load before shuffle")
         rng = np.random.default_rng(seed) if seed is not None else self._rng
+        if self.pv_mode:
+            # PV mode shuffles whole page-views; ads inside a PV stay together
+            self._pv_perm = rng.permutation(self._pv_perm.shape[0])
+            return
         self._order = rng.permutation(self._block.n_ins)
 
     def global_shuffle(self, seed: Optional[int] = None) -> None:
@@ -128,6 +132,72 @@ class PadBoxSlotDataset:
         idxs = [names.index(n) for n in slot_names]
         self._block = _shuffle_slots(self._block, idxs, np.random.default_rng(seed))
 
+    # -- PV merge --------------------------------------------------------- #
+    def preprocess_instance(self) -> None:
+        """Group instances into page-views by search_id (reference:
+        BoxPSDataset.preprocess_instance -> PadBoxSlotDataset PV merge,
+        data_feed.h:756-774; requires parse_logkey data).  After this,
+        ``batches()`` emits PV-aligned batches carrying ``rank_offset``."""
+        if self._block is None:
+            raise RuntimeError("load before preprocess_instance")
+        if not self.conf.enable_pv_merge:
+            raise RuntimeError("enable_pv_merge is off in the config")
+        if self._block.search_ids is None:
+            raise RuntimeError("PV merge needs parse_logkey (search_ids)")
+        sid = self._block.search_ids
+        order = np.argsort(sid, kind="stable")
+        bounds = np.nonzero(np.diff(sid[order]) != 0)[0] + 1
+        starts = np.concatenate([[0], bounds, [order.shape[0]]]).astype(np.int64)
+        self._pv_order = order
+        self._pv_starts = starts  # PV p = order[starts[p]:starts[p+1]]
+        self._pv_perm = np.arange(starts.shape[0] - 1)
+
+    def postprocess_instance(self) -> None:
+        """Back to flat instance mode (reference: BoxPSDataset.postprocess_instance)."""
+        self._pv_order = None
+        self._pv_starts = None
+        self._pv_perm = None
+
+    @property
+    def pv_mode(self) -> bool:
+        return getattr(self, "_pv_order", None) is not None
+
+    def get_pv_data_size(self) -> int:
+        if not self.pv_mode:
+            return 0
+        return self._pv_starts.shape[0] - 1
+
+    def _pv_batches(self, drop_last: bool) -> Iterator[HostBatch]:
+        """Pack whole PVs into fixed-capacity batches: up to pv_batch_size
+        PVs and at most batch_size instances per batch (static shapes)."""
+        B = self.conf.batch_size
+        max_pvs = self.conf.pv_batch_size
+        ids: list[np.ndarray] = []
+        bounds = [0]
+
+        def emit():
+            flat = np.concatenate(ids)
+            yield self.builder.build_pv(
+                self._block, flat, np.asarray(bounds, dtype=np.int64)
+            )
+
+        count = 0
+        for p in self._pv_perm:
+            lo, hi = self._pv_starts[p], self._pv_starts[p + 1]
+            pv = self._pv_order[lo:hi]
+            if pv.shape[0] > B:
+                raise ValueError(
+                    f"PV of {pv.shape[0]} ads exceeds batch_size {B}"
+                )
+            if ids and (count + pv.shape[0] > B or len(ids) >= max_pvs):
+                yield from emit()
+                ids, bounds, count = [], [0], 0
+            ids.append(pv)
+            count += pv.shape[0]
+            bounds.append(count)
+        if ids and not (drop_last and count < B):
+            yield from emit()
+
     # -- pass / batches -------------------------------------------------- #
     def get_memory_data_size(self) -> int:
         return 0 if self._block is None else self._block.n_ins
@@ -140,6 +210,9 @@ class PadBoxSlotDataset:
     def batches(self, drop_last: bool = False) -> Iterator[HostBatch]:
         if self._block is None:
             raise RuntimeError("load before iterating")
+        if self.pv_mode:
+            yield from self._pv_batches(drop_last)
+            return
         B = self.conf.batch_size
         n = self._block.n_ins
         for lo in range(0, n, B):

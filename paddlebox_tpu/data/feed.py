@@ -64,6 +64,51 @@ def empty_like(batch: HostBatch) -> HostBatch:
     )
 
 
+def build_rank_offset(
+    block: RecordBlock,
+    ids: np.ndarray,
+    pv_bounds: np.ndarray,  # int [n_pvs+1]: PV boundaries within ids
+    batch_size: int,
+    max_rank: int,
+    cmatch_filter=None,
+) -> np.ndarray:
+    """The PV rank matrix [B, 2*max_rank+1] with batch-local peer indices
+    (reference: CopyRankOffsetKernel, data_feed.cu:208-258; -1 fill).
+
+    Row layout per ad instance: col 0 = own rank (1-based; -1 unranked);
+    for peer-rank slot m: col 2m+1 = peer's rank, col 2m+2 = peer's row in
+    this batch.  A PV's ads see each other (self included, as in the
+    reference).  Instances fail ranking when their cmatch is filtered out or
+    rank is 0 / > max_rank.
+    """
+    cols = 2 * max_rank + 1
+    mat = np.full((batch_size, cols), -1, dtype=np.int32)
+    if block.ranks is None:
+        return mat
+    ranks = block.ranks[ids]
+    cmatches = (
+        block.cmatches[ids] if block.cmatches is not None
+        else np.zeros_like(ranks)
+    )
+    ok = (ranks > 0) & (ranks <= max_rank)
+    if cmatch_filter is not None:
+        ok &= np.isin(cmatches, np.asarray(list(cmatch_filter)))
+    eff_rank = np.where(ok, ranks, -1)
+    for p in range(pv_bounds.shape[0] - 1):
+        lo, hi = int(pv_bounds[p]), int(pv_bounds[p + 1])
+        members = np.arange(lo, hi)
+        mat[members, 0] = eff_rank[lo:hi]
+        ranked = members[eff_rank[lo:hi] > 0]
+        for j in members:
+            if eff_rank[j] <= 0:
+                continue
+            for k in ranked:
+                m = eff_rank[k] - 1
+                mat[j, 2 * m + 1] = eff_rank[k]
+                mat[j, 2 * m + 2] = k
+    return mat
+
+
 class BatchBuilder:
     """Packs instance index ranges of a RecordBlock into HostBatches."""
 
@@ -73,6 +118,18 @@ class BatchBuilder:
             conf.batch_size * conf.max_feasigns_per_ins
         )
         self.dropped_keys = 0  # overflow counter (observability)
+
+    def build_pv(
+        self, block: RecordBlock, ids: np.ndarray, pv_bounds: np.ndarray
+    ) -> HostBatch:
+        """A PV-merged batch: same packing plus the rank_offset matrix."""
+        batch = self.build(block, ids)
+        batch.rank_offset = build_rank_offset(
+            block, np.asarray(ids, dtype=np.int64), pv_bounds,
+            self.conf.batch_size, self.conf.max_rank,
+            self.conf.rank_cmatch_filter,
+        )
+        return batch
 
     def build(self, block: RecordBlock, ids: np.ndarray) -> HostBatch:
         conf = self.conf

@@ -1,0 +1,262 @@
+"""Global data shuffle: route every record to its owning worker.
+
+TPU-native redesign of the reference's multi-node shuffle (reference:
+``PadBoxSlotDataset::ShuffleData``/``ReceiveSuffleData`` data_set.cc:1916-2090
+routing each record by ``search_id % mpi_size`` / ``XXH64(ins_id) % size`` /
+random, serializing via BinaryArchive and sending through the closed-lib
+``boxps::PaddleShuffler`` MPI transport):
+
+  * ``route_ids``            — the routing policy, identical semantics.
+  * ``InProcessShuffleGroup``— N logical workers inside one process (JAX is
+    one process per host; reader threads are the workers).  Barrier +
+    mailbox exchange, zero serialization.
+  * ``TcpShuffler``          — multi-process/host transport over plain TCP
+    sockets with the framed archive format (data/archive.py).  This replaces
+    the MPI transport: every worker runs a listener, ``exchange`` pushes
+    each peer its routed sub-block and concatenates what it receives.  The
+    rendezvous (who listens where) comes from the caller — in production the
+    JAX coordination service's KV store, in tests literal localhost ports
+    (the reference tests do the same with subprocess pservers,
+    test_dist_base.py:754-900).
+
+Attach a shuffler to ``PadBoxSlotDataset.shuffler`` and records are
+exchanged at load time, making ``global_shuffle`` meaningful across workers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from paddlebox_tpu.data.archive import block_from_bytes, block_to_bytes
+from paddlebox_tpu.data.record import RecordBlock
+
+
+# --------------------------------------------------------------------------- #
+# routing
+# --------------------------------------------------------------------------- #
+def _hash_ins_ids(ins_ids: Sequence[str]) -> np.ndarray:
+    """Stable 64-bit hash per ins_id (the reference uses XXH64; any stable
+    hash serves — blake2b is in the stdlib and seedable)."""
+    out = np.empty(len(ins_ids), dtype=np.uint64)
+    for i, s in enumerate(ins_ids):
+        out[i] = np.frombuffer(
+            hashlib.blake2b(s.encode(), digest_size=8).digest(), dtype=np.uint64
+        )[0]
+    return out
+
+
+def route_ids(
+    block: RecordBlock,
+    n_workers: int,
+    mode: str = "search_id",
+    seed: int = 0,
+) -> np.ndarray:
+    """Destination worker per instance (reference: data_set.cc:1934-1942)."""
+    if n_workers <= 0:
+        raise ValueError("n_workers must be positive")
+    if block.n_ins == 0:
+        return np.empty(0, dtype=np.int32)
+    if mode == "search_id":
+        if block.search_ids is None:
+            raise ValueError(
+                "search_id routing needs parse_logkey data (search_ids absent)"
+            )
+        return (block.search_ids % np.uint64(n_workers)).astype(np.int32)
+    if mode == "ins_id":
+        if block.ins_ids is None:
+            raise ValueError("ins_id routing needs parse_ins_id data")
+        return (_hash_ins_ids(block.ins_ids) % np.uint64(n_workers)).astype(np.int32)
+    if mode == "random":
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, n_workers, size=block.n_ins, dtype=np.int32)
+    raise ValueError(f"unknown shuffle mode {mode!r}")
+
+
+def split_by_route(
+    block: RecordBlock, dest: np.ndarray, n_workers: int
+) -> list[RecordBlock]:
+    return [block.select(np.nonzero(dest == d)[0]) for d in range(n_workers)]
+
+
+# --------------------------------------------------------------------------- #
+# in-process exchange (threads as workers)
+# --------------------------------------------------------------------------- #
+class InProcessShuffleGroup:
+    """Exchange coordinator for N same-process workers.
+
+    Usage: each worker thread gets ``group.shuffler(worker_id)`` and attaches
+    it to its dataset; all N datasets must load in the same pass (the
+    exchange is a collective)."""
+
+    def __init__(self, n_workers: int, mode: str = "search_id", seed: int = 0):
+        self.n_workers = n_workers
+        self.mode = mode
+        self.seed = seed
+        self._mailboxes: list[list[RecordBlock]] = [[] for _ in range(n_workers)]
+        self._lock = threading.Lock()
+        self._barrier = threading.Barrier(n_workers)
+
+    def shuffler(self, worker_id: int) -> "_InProcessShuffler":
+        if not 0 <= worker_id < self.n_workers:
+            raise ValueError(f"bad worker_id {worker_id}")
+        return _InProcessShuffler(self, worker_id)
+
+    def _exchange(self, worker_id: int, block: RecordBlock) -> RecordBlock:
+        dest = route_ids(block, self.n_workers, self.mode, self.seed)
+        parts = split_by_route(block, dest, self.n_workers)
+        with self._lock:
+            for d, p in enumerate(parts):
+                if p.n_ins:
+                    self._mailboxes[d].append(p)
+        self._barrier.wait()  # all deposits visible
+        with self._lock:
+            mine = self._mailboxes[worker_id]
+            self._mailboxes[worker_id] = []  # clear before anyone re-deposits
+        out = (
+            RecordBlock.concat(mine)
+            if mine
+            else block.select(np.empty(0, dtype=np.int64))
+        )
+        # barrier 2: nobody starts the next round (and re-deposits) until
+        # every worker has taken + cleared its round-1 mailbox
+        self._barrier.wait()
+        return out
+
+
+class _InProcessShuffler:
+    def __init__(self, group: InProcessShuffleGroup, worker_id: int):
+        self.group = group
+        self.worker_id = worker_id
+
+    def exchange(self, block: RecordBlock) -> RecordBlock:
+        return self.group._exchange(self.worker_id, block)
+
+
+# --------------------------------------------------------------------------- #
+# TCP exchange (processes/hosts as workers)
+# --------------------------------------------------------------------------- #
+_FRAME = struct.Struct("<iiQ")  # sender worker_id, exchange round, payload length
+
+
+class TcpShuffler:
+    """Socket transport for the exchange (the PaddleShuffler/MPI analog).
+
+    endpoints[i] = (host, port) of worker i's listener.  ``start()`` binds
+    this worker's listener; ``exchange(block)`` routes, sends each peer its
+    part, and blocks until one part from every peer has arrived.  One
+    exchange round at a time (matching the reference's pass-scoped shuffle).
+    """
+
+    def __init__(
+        self,
+        endpoints: Sequence[tuple[str, int]],
+        worker_id: int,
+        mode: str = "search_id",
+        seed: int = 0,
+        timeout: float = 120.0,
+    ):
+        self.endpoints = list(endpoints)
+        self.n_workers = len(endpoints)
+        self.worker_id = worker_id
+        self.mode = mode
+        self.seed = seed
+        self.timeout = timeout
+        self._server: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        # keyed by (sender, round): a fast peer may deliver round N+1 while
+        # this worker still waits on round N — rounds must not collide
+        self._received: dict[tuple[int, int], RecordBlock] = {}
+        self._recv_cv = threading.Condition()
+        self._round = 0
+        self._stop = False
+
+    # -- listener ---------------------------------------------------------- #
+    def start(self) -> None:
+        host, port = self.endpoints[self.worker_id]
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(self.n_workers)
+        srv.settimeout(0.2)
+        self._server = srv
+        self._accept_thread = threading.Thread(target=self._serve, daemon=True)
+        self._accept_thread.start()
+
+    def bound_port(self) -> int:
+        """The actual listening port (use with port 0 for OS-assigned)."""
+        return self._server.getsockname()[1]
+
+    def _serve(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(self.timeout)
+            head = _recv_exact(conn, _FRAME.size)
+            sender, rnd, n = _FRAME.unpack(head)
+            payload = _recv_exact(conn, n)
+            block = block_from_bytes(payload)
+            with self._recv_cv:
+                self._received[(sender, rnd)] = block
+                self._recv_cv.notify_all()
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._stop = True
+        if self._server is not None:
+            self._server.close()
+
+    # -- exchange ---------------------------------------------------------- #
+    def exchange(self, block: RecordBlock) -> RecordBlock:
+        rnd = self._round
+        self._round += 1
+        dest = route_ids(block, self.n_workers, self.mode, self.seed)
+        parts = split_by_route(block, dest, self.n_workers)
+        own = parts[self.worker_id]
+        for peer, part in enumerate(parts):
+            if peer == self.worker_id:
+                continue
+            payload = block_to_bytes(part)
+            with socket.create_connection(
+                self.endpoints[peer], timeout=self.timeout
+            ) as c:
+                c.sendall(_FRAME.pack(self.worker_id, rnd, len(payload)))
+                c.sendall(payload)
+        expected = {(p, rnd) for p in range(self.n_workers)} - {(self.worker_id, rnd)}
+        with self._recv_cv:
+            ok = self._recv_cv.wait_for(
+                lambda: expected.issubset(self._received), timeout=self.timeout
+            )
+            if not ok:
+                missing = sorted(p for p, r in expected - set(self._received))
+                raise TimeoutError(f"shuffle: no data from workers {missing}")
+            got = [self._received.pop(k) for k in sorted(expected)]
+        return RecordBlock.concat([own, *got])
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = conn.recv(min(1 << 20, n - got))
+        if not chunk:
+            raise EOFError("peer closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)

@@ -49,36 +49,62 @@ def write_synth_files(
     max_keys_per_slot: int = 3,
     seed: int = 0,
     signal_scale: float = 4.0,
+    with_logkey: bool = False,
+    max_ads_per_pv: int = 4,
+    cmatch_values: Sequence[int] = (222, 223),
 ) -> list[str]:
-    """Writes slot-text files; returns their paths."""
+    """Writes slot-text files; returns their paths.
+
+    with_logkey adds the ``search_id:rank:cmatch`` prefix and groups
+    consecutive instances into page-views sharing a search_id, with ranks
+    1..n_ads (the PV-merge / rank_attention input shape,
+    reference data_feed.h:756-774)."""
     rng = np.random.default_rng(seed)
     # latent per-key weights drive the label
     key_w = rng.normal(size=(n_sparse_slots, vocab_per_slot)) * signal_scale
     os.makedirs(out_dir, exist_ok=True)
     paths = []
+    next_sid = seed * 1_000_003 + 1
     for f in range(n_files):
         path = os.path.join(out_dir, f"part-{f:03d}")
         with open(path, "w") as fh:
-            for _ in range(ins_per_file):
-                logit = 0.0
-                slot_keys: list[np.ndarray] = []
-                for s in range(n_sparse_slots):
-                    n = int(rng.integers(1, max_keys_per_slot + 1))
-                    local = rng.integers(0, vocab_per_slot, size=n)
-                    # globally unique feasign: slot s owns [s*vocab, (s+1)*vocab)
-                    slot_keys.append(local + s * vocab_per_slot + 1)
-                    logit += key_w[s, local].mean()
-                logit /= n_sparse_slots
-                p = 1.0 / (1.0 + np.exp(-logit))
-                label = int(rng.random() < p)
-                parts = [f"1 {label}"]
-                for ks in slot_keys:
-                    parts.append(f"{len(ks)} " + " ".join(str(int(k)) for k in ks))
-                if dense_dim:
-                    dvals = rng.normal(size=dense_dim) * 0.1
-                    parts.append(
-                        f"{dense_dim} " + " ".join(f"{v:.4f}" for v in dvals)
+            written = 0
+            while written < ins_per_file:
+                if with_logkey:
+                    n_ads = int(
+                        rng.integers(1, min(max_ads_per_pv, ins_per_file - written) + 1)
                     )
-                fh.write(" ".join(parts) + "\n")
+                    sid = next_sid
+                    next_sid += 1
+                else:
+                    n_ads = 1
+                for ad in range(n_ads):
+                    logit = 0.0
+                    slot_keys: list[np.ndarray] = []
+                    for s in range(n_sparse_slots):
+                        n = int(rng.integers(1, max_keys_per_slot + 1))
+                        local = rng.integers(0, vocab_per_slot, size=n)
+                        # globally unique feasign: slot s owns [s*vocab, (s+1)*vocab)
+                        slot_keys.append(local + s * vocab_per_slot + 1)
+                        logit += key_w[s, local].mean()
+                    logit /= n_sparse_slots
+                    p = 1.0 / (1.0 + np.exp(-logit))
+                    label = int(rng.random() < p)
+                    parts = []
+                    if with_logkey:
+                        cm = int(rng.choice(list(cmatch_values)))
+                        parts.append(f"{sid}:{ad + 1}:{cm}")
+                    parts.append(f"1 {label}")
+                    for ks in slot_keys:
+                        parts.append(
+                            f"{len(ks)} " + " ".join(str(int(k)) for k in ks)
+                        )
+                    if dense_dim:
+                        dvals = rng.normal(size=dense_dim) * 0.1
+                        parts.append(
+                            f"{dense_dim} " + " ".join(f"{v:.4f}" for v in dvals)
+                        )
+                    fh.write(" ".join(parts) + "\n")
+                    written += 1
         paths.append(path)
     return paths

@@ -2,5 +2,6 @@
 
 from paddlebox_tpu.models.ctr_dnn import CtrDnn
 from paddlebox_tpu.models.layers import bce_with_logits, init_mlp, linear, mlp
+from paddlebox_tpu.models.rank_ctr import RankCtrDnn
 
-__all__ = ["CtrDnn", "bce_with_logits", "init_mlp", "linear", "mlp"]
+__all__ = ["CtrDnn", "RankCtrDnn", "bce_with_logits", "init_mlp", "linear", "mlp"]

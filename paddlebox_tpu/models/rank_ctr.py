@@ -1,0 +1,77 @@
+"""PV-aware CTR model: pooled slot embeddings + rank_attention.
+
+The list-wise CTR capability of the reference (user programs combining
+``fused_seqpool_cvm`` features with ``rank_attention`` over PV-merged
+batches; reference template test_paddlebox_datafeed.py:22-66 with
+enable_pv_merge + rank_offset).  The attention input X is the per-instance
+pooled feature vector; its PV peers' features are contracted against the
+(own rank, peer rank)-selected parameter block and the result concatenated
+into the dense tower.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_mlp, mlp
+from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.ops.rank_attention import rank_attention
+
+
+class RankCtrDnn:
+    uses_rank_offset = True
+
+    def __init__(
+        self,
+        n_sparse_slots: int,
+        emb_width: int,
+        dense_dim: int = 0,
+        hidden: Sequence[int] = (512, 256, 128),
+        max_rank: int = 3,
+        att_out_dim: int = 64,
+        use_cvm: bool = True,
+        cvm_offset: int = 2,
+    ):
+        self.n_sparse_slots = n_sparse_slots
+        self.emb_width = emb_width
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+        self.max_rank = max_rank
+        self.att_out_dim = att_out_dim
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        pooled_w = emb_width if use_cvm else emb_width - cvm_offset
+        self.feat_dim = n_sparse_slots * pooled_w + dense_dim
+        self.input_dim = self.feat_dim + att_out_dim
+
+    def init(self, key: jax.Array) -> dict:
+        k1, k2 = jax.random.split(key)
+        k = self.max_rank
+        bound = jnp.sqrt(6.0 / (self.feat_dim + self.att_out_dim))
+        return {
+            "tower": init_mlp(k1, self.input_dim, self.hidden, 1),
+            "rank_param": jax.random.uniform(
+                k2, (k * k * self.feat_dim, self.att_out_dim),
+                minval=-bound, maxval=bound,
+            ),
+        }
+
+    def apply(
+        self,
+        params: dict,
+        rows: jax.Array,
+        key_segments: jax.Array,
+        dense: jax.Array,
+        batch_size: int,
+        rank_offset: jax.Array,  # int32 [B, 2*max_rank+1]
+    ) -> jax.Array:
+        pooled = fused_seqpool_cvm(
+            rows, key_segments, batch_size, self.n_sparse_slots,
+            use_cvm=self.use_cvm, cvm_offset=self.cvm_offset,
+        )
+        x = jnp.concatenate([pooled, dense], axis=1) if self.dense_dim else pooled
+        att = rank_attention(x, rank_offset, params["rank_param"], self.max_rank)
+        return mlp(params["tower"], jnp.concatenate([x, att], axis=1))[:, 0]

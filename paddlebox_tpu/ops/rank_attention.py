@@ -1,0 +1,56 @@
+"""rank_attention: PV-rank-conditioned parameter selection.
+
+TPU-native implementation of the reference op (reference:
+operators/rank_attention_op.{cc,cu}, kernels rank_attention.cu.h:27-110):
+for each ad instance i inside a page-view (PV), combine the features of its
+PV peers with a parameter block selected by the *(own rank, peer rank)* pair:
+
+    out[i, c] = sum_k sum_f  X[peer(i, k), f] * P[rank(i), k, f, c]
+
+where ``rank_offset`` (built by the PV feed, see data/feed.py) encodes, per
+instance row: col 0 = own rank (-1/0 = unranked), col 2k+1 = peer-with-rank-
+(k+1)'s rank, col 2k+2 = that peer's batch-local row index.  Missing peers
+and unranked instances contribute zeros — identical to the CUDA kernels'
+guard behavior.
+
+The reference materializes InputHelp/ParamHelp scratch tensors and runs a
+batched GEMM + hand-written gradient merge kernels; here one einsum expresses
+the whole contraction, XLA maps it onto the MXU, and autodiff derives both
+gradients (the merge_param_gradient kernel is exactly the transpose XLA
+generates).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rank_attention(
+    x: jax.Array,  # [N, F] per-instance features
+    rank_offset: jax.Array,  # int32 [N, 2*max_rank + 1]
+    rank_param: jax.Array,  # [max_rank * max_rank * F, C] (reference layout)
+    max_rank: int,
+) -> jax.Array:
+    """Returns [N, C].  Differentiable in x and rank_param."""
+    n, f = x.shape
+    c = rank_param.shape[-1]
+    p = rank_param.reshape(max_rank, max_rank, f, c)
+
+    own = rank_offset[:, 0] - 1  # [N]; < 0 -> unranked
+    peer_rank = rank_offset[:, 1::2] - 1  # [N, K]
+    peer_idx = rank_offset[:, 2::2]  # [N, K]
+    valid = (own[:, None] >= 0) & (peer_rank >= 0) & (peer_idx >= 0)
+
+    peers = jnp.take(x, jnp.clip(peer_idx, 0, n - 1), axis=0)  # [N, K, F]
+    peers = jnp.where(valid[..., None], peers, 0.0)
+    # parameter block per (instance, peer slot): P[own, peer_rank]
+    blk = p[jnp.clip(own, 0, max_rank - 1)[:, None],
+            jnp.clip(peer_rank, 0, max_rank - 1)]  # [N, K, F, C]
+    blk = jnp.where(valid[..., None, None], blk, 0.0)
+    return jnp.einsum("nkf,nkfc->nc", peers, blk)
+
+
+def ins_rank(rank_offset: jax.Array) -> jax.Array:
+    """[N, 1] own-rank column (the reference's InsRank output)."""
+    return rank_offset[:, 0:1].astype(jnp.float32)

@@ -62,7 +62,11 @@ def _stack_group(
     for b, m in zip(batches, plan.key_mask):
         ins = np.minimum(b.key_segments // n_slots, b.batch_size - 1)
         key_clicks.append(b.labels[ins] * m)
+    extra = {}
+    if batches[0].rank_offset is not None:
+        extra["rank_offset"] = np.stack([b.rank_offset for b in batches])
     return {
+        **extra,
         "serve_rows": plan.serve_rows,
         "occ_flat": plan.occ_flat,
         "serve_map": plan.serve_map,
@@ -204,6 +208,7 @@ class MultiChipTrainer:
         conf = self.conf
         sync_step = conf.sync_dense_mode == "step"
         check_nan = conf.check_nan_inf
+        uses_rank = getattr(model, "uses_rank_offset", False)
 
         def body(params, opt_state, values, g2sum, auc, batch):
             # local blocks all carry a leading device axis of size 1
@@ -217,9 +222,12 @@ class MultiChipTrainer:
                 tconf.create_threshold, tconf.cvm_offset,
             )
             bsz = batch["labels"].shape[0]
+            extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
 
             def loss_fn(p, r):
-                logits = model.apply(p, r, batch["key_segments"], batch["dense"], bsz)
+                logits = model.apply(
+                    p, r, batch["key_segments"], batch["dense"], bsz, **extra
+                )
                 per_ins = bce_with_logits(logits, batch["labels"]) * batch["ins_mask"]
                 local_cnt = batch["ins_mask"].sum()
                 if sync_step:
@@ -284,6 +292,23 @@ class MultiChipTrainer:
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
+    # -- dense persistence -------------------------------------------------- #
+    def dense_state(self) -> tuple:
+        """(params, opt_state) with the device axis dropped — replica 0 (in
+        kstep mode call sync first if drift matters)."""
+        take0 = lambda t: jax.tree.map(lambda x: np.asarray(x[0]), t)
+        return take0(self.params), take0(self.opt_state)
+
+    def load_dense_state(self, params, opt_state=None) -> None:
+        stack = lambda t: jax.device_put(
+            jax.tree.map(lambda x: jnp.stack([jnp.asarray(x)] * self.n_dev), t),
+            self._sharding,
+        )
+        if params is not None:
+            self.params = stack(params)
+        if opt_state is not None:
+            self.opt_state = stack(opt_state)
+
     # -- public API --------------------------------------------------------- #
     def init_auc(self) -> AucState:
         auc = init_auc_state(self.conf.auc_buckets)
@@ -319,10 +344,16 @@ class MultiChipTrainer:
         values, g2sum = table.values, table.g2sum
         losses, counts, n_steps = [], [], 0
         n_slots = None
+        uses_rank = getattr(self.model, "uses_rank_offset", False)
         try:
             for group in groups:
                 if n_slots is None:
                     n_slots = group[0].n_sparse_slots
+                if uses_rank and group[0].rank_offset is None:
+                    raise RuntimeError(
+                        "model requires PV-merged batches with rank_offset: "
+                        "set enable_pv_merge and call dataset.preprocess_instance()"
+                    )
                 plan = table.plan_group(group)
                 feed = _stack_group(group, plan, n_slots)
                 feed = jax.device_put(feed, self._sharding)

@@ -248,6 +248,8 @@ class SparseTable:
 
     # -- persistence ------------------------------------------------------ #
     def state_dict(self) -> dict:
+        """Live views of the host store (not copies — serialize before the
+        next begin_pass/end_pass mutates them)."""
         if self._in_pass:
             raise RuntimeError("end_pass before checkpointing")
         return {"keys": self._store_keys, "values": self._store_vals}
@@ -277,6 +279,10 @@ class SparseTable:
         state = self.delta_state_dict()
         self._delta_keys = []
         return state
+
+    def clear_delta(self) -> None:
+        """Reset the delta tracker (call only after a successful save)."""
+        self._delta_keys = []
 
     def apply_delta(self, state: dict) -> None:
         keys = np.asarray(state["keys"], dtype=np.uint64)

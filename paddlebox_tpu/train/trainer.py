@@ -43,7 +43,7 @@ def _device_batch(batch: HostBatch, plan, n_slots: int) -> dict:
     """Assemble the static-shape device feed from a HostBatch + BatchPlan."""
     ins = np.minimum(batch.key_segments // n_slots, batch.batch_size - 1)
     key_clicks = batch.labels[ins] * plan.key_mask
-    return {
+    dev = {
         "idx": jnp.asarray(plan.idx),
         "uniq_idx": jnp.asarray(plan.uniq_idx),
         "inverse": jnp.asarray(plan.inverse),
@@ -54,6 +54,9 @@ def _device_batch(batch: HostBatch, plan, n_slots: int) -> dict:
         "labels": jnp.asarray(batch.labels),
         "ins_mask": jnp.asarray(batch.ins_mask),
     }
+    if batch.rank_offset is not None:
+        dev["rank_offset"] = jnp.asarray(batch.rank_offset)
+    return dev
 
 
 class Trainer:
@@ -86,6 +89,7 @@ class Trainer:
         tconf = self.table_conf
         optimizer = self.optimizer
         check_nan = self.conf.check_nan_inf
+        uses_rank = getattr(model, "uses_rank_offset", False)
 
         def step(params, opt_state, values, g2sum, auc, batch):
             rows = pull_rows(
@@ -94,9 +98,12 @@ class Trainer:
                 cvm_offset=tconf.cvm_offset,
             )
             bsz = batch["labels"].shape[0]
+            extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
 
             def loss_fn(p, r):
-                logits = model.apply(p, r, batch["key_segments"], batch["dense"], bsz)
+                logits = model.apply(
+                    p, r, batch["key_segments"], batch["dense"], bsz, **extra
+                )
                 per_ins = bce_with_logits(logits, batch["labels"]) * batch["ins_mask"]
                 denom = jnp.maximum(batch["ins_mask"].sum(), 1.0)
                 return per_ins.sum() / denom, jax.nn.sigmoid(logits)
@@ -123,6 +130,17 @@ class Trainer:
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
 
+    # -- dense persistence -------------------------------------------------- #
+    def dense_state(self) -> tuple:
+        """(params, opt_state) for CheckpointManager.save_*."""
+        return self.params, self.opt_state
+
+    def load_dense_state(self, params, opt_state=None) -> None:
+        if params is not None:
+            self.params = params
+        if opt_state is not None:
+            self.opt_state = opt_state
+
     # -- public API --------------------------------------------------------- #
     def train_from_dataset(
         self,
@@ -141,8 +159,14 @@ class Trainer:
         auc = auc_state if auc_state is not None else init_auc_state(self.conf.auc_buckets)
         values, g2sum = table.values, table.g2sum
         losses, n_steps = [], 0
+        uses_rank = getattr(self.model, "uses_rank_offset", False)
         try:
             for batch in dataset.batches(drop_last=drop_last):
+                if uses_rank and batch.rank_offset is None:
+                    raise RuntimeError(
+                        "model requires PV-merged batches with rank_offset: "
+                        "set enable_pv_merge and call dataset.preprocess_instance()"
+                    )
                 plan = table.plan_batch(batch)
                 dev = _device_batch(batch, plan, batch.n_sparse_slots)
                 (self.params, self.opt_state, values, g2sum, auc, loss, finite) = (

@@ -1,0 +1,186 @@
+"""PV merge + rank_offset + rank_attention (reference:
+operators/rank_attention_op.cu + rank_attention.cu.h:27-110;
+CopyRankOffsetKernel data_feed.cu:208-258; PV feed data_feed.h:756-774;
+python test mirror: test_rank_attention_op.py)."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.feed import build_rank_offset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import RankCtrDnn
+from paddlebox_tpu.ops import rank_attention
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train.trainer import Trainer
+
+
+# --------------------------------------------------------------------------- #
+# numpy oracle mirroring the CUDA kernel semantics
+# --------------------------------------------------------------------------- #
+def np_rank_attention(x, rank_offset, rank_param, max_rank):
+    n, f = x.shape
+    c = rank_param.shape[-1]
+    p = rank_param.reshape(max_rank, max_rank, f, c)
+    out = np.zeros((n, c), dtype=x.dtype)
+    for i in range(n):
+        lower = rank_offset[i, 0] - 1
+        if lower < 0:
+            continue
+        for k in range(max_rank):
+            faster = rank_offset[i, 2 * k + 1] - 1
+            idx = rank_offset[i, 2 * k + 2]
+            if faster < 0 or idx < 0:
+                continue
+            out[i] += x[idx] @ p[lower, faster]
+    return out
+
+
+def _random_rank_offset(rng, n, max_rank):
+    """Random but self-consistent rank_offset (like the reference op test)."""
+    mat = np.full((n, 2 * max_rank + 1), -1, dtype=np.int32)
+    for i in range(n):
+        own = int(rng.integers(0, max_rank + 1))  # 0 = unranked
+        mat[i, 0] = own if own else -1
+        if own:
+            for m in range(max_rank):
+                if rng.random() < 0.7:
+                    mat[i, 2 * m + 1] = m + 1
+                    mat[i, 2 * m + 2] = int(rng.integers(0, n))
+    return mat
+
+
+def test_rank_attention_matches_oracle():
+    rng = np.random.default_rng(0)
+    n, f, c, k = 17, 5, 4, 3
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    param = rng.normal(size=(k * k * f, c)).astype(np.float32)
+    off = _random_rank_offset(rng, n, k)
+    got = np.asarray(rank_attention(x, off, param, k))
+    want = np_rank_attention(x, off, param, k)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_rank_attention_grads_flow():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    n, f, c, k = 9, 3, 2, 2
+    x = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
+    param = jnp.asarray(rng.normal(size=(k * k * f, c)).astype(np.float32))
+    off = jnp.asarray(_random_rank_offset(rng, n, k))
+    gx, gp = jax.grad(
+        lambda a, b: rank_attention(a, off, b, k).sum(), argnums=(0, 1)
+    )(x, param)
+    assert np.isfinite(np.asarray(gx)).all()
+    assert np.isfinite(np.asarray(gp)).all()
+    assert np.abs(np.asarray(gp)).sum() > 0
+
+
+# --------------------------------------------------------------------------- #
+# rank_offset construction
+# --------------------------------------------------------------------------- #
+def test_build_rank_offset_pairs():
+    from paddlebox_tpu.data.record import RecordBlock
+
+    # one PV of 3 ads (ranks 1,2,3) + one unranked ad
+    n = 4
+    block = RecordBlock(
+        n_ins=n,
+        n_sparse_slots=1,
+        keys=np.arange(n, dtype=np.uint64),
+        key_offsets=np.arange(n + 1, dtype=np.int64),
+        dense=np.zeros((n, 0), np.float32),
+        labels=np.zeros(n, np.float32),
+        ranks=np.array([1, 2, 3, 0], np.int32),
+        cmatches=np.array([222, 223, 222, 222], np.int32),
+        search_ids=np.array([7, 7, 7, 8], np.uint64),
+    )
+    ids = np.arange(4)
+    bounds = np.array([0, 3, 4])
+    mat = build_rank_offset(block, ids, bounds, batch_size=6, max_rank=3,
+                            cmatch_filter=(222, 223))
+    assert mat.shape == (6, 7)
+    np.testing.assert_array_equal(mat[:, 0], [1, 2, 3, -1, -1, -1])
+    # every ranked ad of the PV sees peers at slots by peer rank
+    for j in range(3):
+        for m in range(3):
+            assert mat[j, 2 * m + 1] == m + 1
+            assert mat[j, 2 * m + 2] == m  # batch-local peer row
+    # unranked ad row stays -1; padding rows stay -1
+    assert (mat[3:] == -1).all()
+    # cmatch filter drops everything when nothing matches
+    mat2 = build_rank_offset(block, ids, bounds, 6, 3, cmatch_filter=(999,))
+    assert (mat2 == -1).all()
+
+
+# --------------------------------------------------------------------------- #
+# PV dataset + e2e
+# --------------------------------------------------------------------------- #
+def _pv_dataset(tmp_path, n_ins=96, batch_size=16):
+    conf = make_synth_config(
+        n_sparse_slots=3, dense_dim=2, batch_size=batch_size,
+        max_feasigns_per_ins=16, parse_logkey=True, enable_pv_merge=True,
+        pv_batch_size=8, rank_cmatch_filter=(222, 223),
+    )
+    files = write_synth_files(
+        str(tmp_path), n_files=2, ins_per_file=n_ins // 2, n_sparse_slots=3,
+        vocab_per_slot=50, dense_dim=2, seed=3, with_logkey=True,
+        max_ads_per_pv=3,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    return conf, ds
+
+
+def test_pv_batches(tmp_path):
+    conf, ds = _pv_dataset(tmp_path)
+    ds.preprocess_instance()
+    assert ds.pv_mode and ds.get_pv_data_size() > 0
+    total = 0
+    for b in ds.batches():
+        assert b.rank_offset is not None
+        assert b.rank_offset.shape == (conf.batch_size, conf.rank_offset_cols)
+        nreal = b.n_real_ins
+        total += nreal
+        # ranked rows only among real instances; peer indices in-batch
+        ro = b.rank_offset
+        assert (ro[nreal:, 0] == -1).all()
+        idxs = ro[:, 2::2]
+        assert idxs.max() < conf.batch_size
+        ranked = ro[:, 0] > 0
+        # a ranked ad always lists itself as a peer at its own rank slot
+        for i in np.nonzero(ranked)[0]:
+            m = ro[i, 0] - 1
+            assert ro[i, 2 * m + 2] >= 0
+    assert total == ds.get_memory_data_size()
+    ds.local_shuffle(seed=0)  # PV shuffle keeps groups intact
+    sizes = [b.n_real_ins for b in ds.batches()]
+    assert sum(sizes) == total
+    ds.postprocess_instance()
+    assert not ds.pv_mode
+    ds.close()
+
+
+def test_pv_e2e_train(tmp_path):
+    conf, ds = _pv_dataset(tmp_path)
+    ds.preprocess_instance()
+    tconf = SparseTableConfig(embedding_dim=4)
+    model = RankCtrDnn(
+        3, tconf.row_width, dense_dim=2, hidden=(16,), max_rank=conf.max_rank,
+        att_out_dim=8,
+    )
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10))
+    table = SparseTable(tconf, seed=0)
+    losses = []
+    for _ in range(6):
+        table.begin_pass(ds.unique_keys())
+        m = trainer.train_from_dataset(ds, table)
+        table.end_pass()
+        losses.append(m["loss"])
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    ds.close()

@@ -1,0 +1,184 @@
+"""Global shuffle routing + exchange (reference: ShuffleData/ReceiveSuffleData
+data_set.cc:1916-2090) and the binary archive wire/disk format
+(framework/archive.h, data_feed.h:1544-1559)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.data.archive import (
+    block_from_bytes,
+    block_to_bytes,
+    read_archive,
+    write_archive,
+)
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.record import RecordBlock
+from paddlebox_tpu.data.shuffle import (
+    InProcessShuffleGroup,
+    TcpShuffler,
+    route_ids,
+    split_by_route,
+)
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+
+
+def _block(n_ins=20, s=2, seed=0, with_meta=True):
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(1, 4, size=n_ins * s)
+    offs = np.zeros(n_ins * s + 1, dtype=np.int64)
+    np.cumsum(lens, out=offs[1:])
+    return RecordBlock(
+        n_ins=n_ins,
+        n_sparse_slots=s,
+        keys=rng.integers(1, 1000, size=int(offs[-1])).astype(np.uint64),
+        key_offsets=offs,
+        dense=rng.normal(size=(n_ins, 3)).astype(np.float32),
+        labels=rng.integers(0, 2, size=n_ins).astype(np.float32),
+        ins_ids=[f"ins-{seed}-{i}" for i in range(n_ins)] if with_meta else None,
+        search_ids=rng.integers(0, 1 << 40, size=n_ins).astype(np.uint64)
+        if with_meta
+        else None,
+        ranks=np.zeros(n_ins, dtype=np.int32) if with_meta else None,
+        cmatches=np.zeros(n_ins, dtype=np.int32) if with_meta else None,
+    )
+
+
+# --------------------------------------------------------------------------- #
+# archive
+# --------------------------------------------------------------------------- #
+def test_archive_roundtrip(tmp_path):
+    blocks = [_block(seed=0), _block(seed=1, with_meta=False), _block(n_ins=0)]
+    b2 = block_from_bytes(block_to_bytes(blocks[0]))
+    np.testing.assert_array_equal(b2.keys, blocks[0].keys)
+    assert b2.ins_ids == blocks[0].ins_ids
+    path = str(tmp_path / "a.bin")
+    assert write_archive(path, blocks) == 3
+    back = list(read_archive(path))
+    assert [b.n_ins for b in back] == [b.n_ins for b in blocks]
+    np.testing.assert_array_equal(back[0].search_ids, blocks[0].search_ids)
+    assert back[1].search_ids is None
+
+
+# --------------------------------------------------------------------------- #
+# routing
+# --------------------------------------------------------------------------- #
+def test_route_by_search_id_deterministic():
+    b = _block()
+    d1 = route_ids(b, 4, "search_id")
+    d2 = route_ids(b, 4, "search_id")
+    np.testing.assert_array_equal(d1, d2)
+    np.testing.assert_array_equal(d1, (b.search_ids % 4).astype(np.int32))
+
+
+def test_route_modes_partition():
+    b = _block(n_ins=50)
+    for mode in ("search_id", "ins_id", "random"):
+        dest = route_ids(b, 3, mode, seed=1)
+        parts = split_by_route(b, dest, 3)
+        assert sum(p.n_ins for p in parts) == b.n_ins
+        # each instance in exactly one part, content preserved
+        all_labels = np.concatenate([p.labels for p in parts])
+        assert sorted(all_labels.tolist()) == sorted(b.labels.tolist())
+
+
+def test_route_requires_meta():
+    b = _block(with_meta=False)
+    with pytest.raises(ValueError):
+        route_ids(b, 2, "search_id")
+    with pytest.raises(ValueError):
+        route_ids(b, 2, "ins_id")
+
+
+# --------------------------------------------------------------------------- #
+# in-process exchange
+# --------------------------------------------------------------------------- #
+def _run_workers(n, fn):
+    results, errs = [None] * n, []
+
+    def wrap(i):
+        try:
+            results[i] = fn(i)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    [t.start() for t in ts]
+    [t.join(timeout=60) for t in ts]
+    assert not errs, errs
+    return results
+
+
+def test_inprocess_exchange_routes_every_record():
+    n = 4
+    group = InProcessShuffleGroup(n, mode="search_id")
+    blocks = [_block(seed=i) for i in range(n)]
+
+    results = _run_workers(n, lambda i: group.shuffler(i).exchange(blocks[i]))
+    total = sum(r.n_ins for r in results)
+    assert total == sum(b.n_ins for b in blocks)
+    for wid, r in enumerate(results):
+        if r.n_ins:
+            np.testing.assert_array_equal(
+                (r.search_ids % n).astype(np.int32), np.full(r.n_ins, wid)
+            )
+    # reusable for a second pass
+    results2 = _run_workers(n, lambda i: group.shuffler(i).exchange(blocks[i]))
+    assert sum(r.n_ins for r in results2) == total
+
+
+def test_dataset_with_shuffler(tmp_path):
+    """Two datasets (workers) loading disjoint files exchange at load time."""
+    n = 2
+    conf = make_synth_config(n_sparse_slots=2, dense_dim=2, batch_size=8)
+    files = write_synth_files(
+        str(tmp_path), n_files=2, ins_per_file=32, n_sparse_slots=2,
+        vocab_per_slot=30, dense_dim=2,
+    )
+    group = InProcessShuffleGroup(n, mode="random", seed=3)
+
+    def load(i):
+        ds = PadBoxSlotDataset(conf, read_threads=1)
+        ds.set_filelist([files[i]])
+        ds.shuffler = group.shuffler(i)
+        ds.load_into_memory()
+        return ds
+
+    dss = _run_workers(n, load)
+    assert sum(ds.get_memory_data_size() for ds in dss) == 64
+    # both got some records (random routing over 32 each)
+    assert all(ds.get_memory_data_size() > 0 for ds in dss)
+    for ds in dss:
+        ds.global_shuffle(seed=0)
+        assert sum(1 for _ in ds.batches()) >= 1
+        ds.close()
+
+
+# --------------------------------------------------------------------------- #
+# tcp exchange
+# --------------------------------------------------------------------------- #
+def test_tcp_exchange():
+    n = 3
+    shufflers = [
+        TcpShuffler([("127.0.0.1", 0)] * n, i, mode="search_id") for i in range(n)
+    ]
+    # bind with OS-assigned ports, then share the real endpoints
+    for s in shufflers:
+        s.endpoints = list(s.endpoints)
+        s.start()
+    endpoints = [("127.0.0.1", s.bound_port()) for s in shufflers]
+    for s in shufflers:
+        s.endpoints = endpoints
+    blocks = [_block(seed=10 + i) for i in range(n)]
+    try:
+        results = _run_workers(n, lambda i: shufflers[i].exchange(blocks[i]))
+        assert sum(r.n_ins for r in results) == sum(b.n_ins for b in blocks)
+        for wid, r in enumerate(results):
+            if r.n_ins:
+                np.testing.assert_array_equal(
+                    (r.search_ids % n).astype(np.int32), np.full(r.n_ins, wid)
+                )
+    finally:
+        for s in shufflers:
+            s.close()
