@@ -110,6 +110,10 @@ class DataFeedConfig:
     parse_ins_id: bool = False
     parse_logkey: bool = False  # search_id / rank / cmatch packed key
     label_slot: str = "click"  # float slot whose first value is the label
+    # extra per-task label slots for multi-task models (reference: each task's
+    # label is its own float slot, named per-metric in the MetricMsg config,
+    # box_wrapper.cc:1222-1270).  Excluded from the dense feature matrix.
+    task_label_slots: Sequence[str] = ()
 
     # fixed device-batch capacities (XLA static shapes): max total feasigns per
     # batch per sparse slot group.  Host feed pads/clips to these.
@@ -134,12 +138,13 @@ class DataFeedConfig:
         ]
 
     def dense_slots(self) -> list[SlotConfig]:
-        """Used dense float slots excluding the label slot, in file order.
-        Matches the RecordBlock dense-matrix column layout exactly."""
+        """Used dense float slots excluding label/task-label slots, in file
+        order.  Matches the RecordBlock dense-matrix column layout exactly."""
+        excluded = {self.label_slot, *self.task_label_slots}
         return [
             s
             for s in self.slots
-            if s.is_used and s.is_dense and s.name != self.label_slot
+            if s.is_used and s.is_dense and s.name not in excluded
         ]
 
     def dense_width(self) -> int:
@@ -161,6 +166,16 @@ class DataFeedConfig:
                 f"label slot {self.label_slot!r} is not among the configured "
                 "slots; every instance must carry a label"
             )
+        if len(set(self.task_label_slots)) != len(self.task_label_slots):
+            raise ValueError("task_label_slots contains duplicates")
+        for t in self.task_label_slots:
+            if self.slots and t not in seen:
+                raise ValueError(f"task label slot {t!r} is not configured")
+            if t == self.label_slot:
+                raise ValueError(
+                    "task_label_slots must not repeat the primary label slot "
+                    "(task 0 is the primary label implicitly)"
+                )
 
 
 # --------------------------------------------------------------------------- #

@@ -35,7 +35,7 @@ def block_to_bytes(block: RecordBlock) -> bytes:
     }
     if block.ins_ids is not None:
         arrays["ins_ids"] = np.asarray(block.ins_ids, dtype=np.str_)
-    for f in ("search_ids", "ranks", "cmatches"):
+    for f in ("search_ids", "ranks", "cmatches", "task_labels"):
         v = getattr(block, f)
         if v is not None:
             arrays[f] = v
@@ -59,6 +59,7 @@ def block_from_bytes(data: bytes) -> RecordBlock:
             search_ids=get("search_ids"),
             ranks=get("ranks"),
             cmatches=get("cmatches"),
+            task_labels=get("task_labels"),
         )
 
 

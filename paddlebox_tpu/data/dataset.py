@@ -259,6 +259,7 @@ def _shuffle_slots(block: RecordBlock, slot_idxs, rng) -> RecordBlock:
         search_ids=block.search_ids,
         ranks=block.ranks,
         cmatches=block.cmatches,
+        task_labels=block.task_labels,
     )
 
 

@@ -39,6 +39,12 @@ class HostBatch:
     batch_size: int
     n_sparse_slots: int
     rank_offset: Optional[np.ndarray] = None  # int32 [B, C] (PV merge mode)
+    # multi-task labels [B, T]: col 0 = primary label, cols 1.. = the
+    # configured task_label_slots (present only when those are configured)
+    task_labels: Optional[np.ndarray] = None
+    # per-instance logkey metadata for mask/cmatch-rank metric variants
+    cmatches: Optional[np.ndarray] = None  # int32 [B]
+    ranks: Optional[np.ndarray] = None  # int32 [B]
 
     @property
     def n_real_ins(self) -> int:
@@ -61,6 +67,10 @@ def empty_like(batch: HostBatch) -> HostBatch:
         n_sparse_slots=S,
         rank_offset=None if batch.rank_offset is None
         else np.zeros_like(batch.rank_offset),
+        task_labels=None if batch.task_labels is None
+        else np.zeros_like(batch.task_labels),
+        cmatches=None if batch.cmatches is None else np.zeros_like(batch.cmatches),
+        ranks=None if batch.ranks is None else np.zeros_like(batch.ranks),
     )
 
 
@@ -168,6 +178,21 @@ class BatchBuilder:
         mask = np.zeros(B, dtype=np.float32)
         mask[:b] = 1.0
 
+        task_labels = None
+        if block.task_labels is not None and block.task_labels.shape[1]:
+            task_labels = np.zeros(
+                (B, 1 + block.task_labels.shape[1]), dtype=np.float32
+            )
+            task_labels[:b, 0] = block.labels[ids]
+            task_labels[:b, 1:] = block.task_labels[ids]
+        cmatches = ranks_arr = None
+        if block.cmatches is not None:
+            cmatches = np.full(B, -1, dtype=np.int32)
+            cmatches[:b] = block.cmatches[ids]
+        if block.ranks is not None:
+            ranks_arr = np.full(B, -1, dtype=np.int32)
+            ranks_arr[:b] = block.ranks[ids]
+
         return HostBatch(
             keys=keys,
             key_segments=segs,
@@ -177,4 +202,7 @@ class BatchBuilder:
             ins_mask=mask,
             batch_size=B,
             n_sparse_slots=S,
+            task_labels=task_labels,
+            cmatches=cmatches,
+            ranks=ranks_arr,
         )

@@ -37,6 +37,7 @@ class RecordBlock:
     search_ids: Optional[np.ndarray] = None  # uint64 [n_ins]
     ranks: Optional[np.ndarray] = None  # int32 [n_ins]
     cmatches: Optional[np.ndarray] = None  # int32 [n_ins]
+    task_labels: Optional[np.ndarray] = None  # float32 [n_ins, n_extra_tasks]
 
     def __post_init__(self):
         assert self.key_offsets.shape[0] == self.n_ins * self.n_sparse_slots + 1
@@ -100,6 +101,7 @@ class RecordBlock:
             search_ids=_cat_opt("search_ids"),
             ranks=_cat_opt("ranks"),
             cmatches=_cat_opt("cmatches"),
+            task_labels=_cat_opt("task_labels"),
         )
 
     def select(self, order: np.ndarray) -> "RecordBlock":
@@ -130,6 +132,7 @@ class RecordBlock:
             search_ids=self.search_ids[order] if self.search_ids is not None else None,
             ranks=self.ranks[order] if self.ranks is not None else None,
             cmatches=self.cmatches[order] if self.cmatches is not None else None,
+            task_labels=self.task_labels[order] if self.task_labels is not None else None,
         )
 
     def unique_keys(self) -> np.ndarray:

@@ -45,11 +45,14 @@ class SlotParser:
         for s in conf.dense_slots():
             dense_cols[s.name] = col
             col += int(np.prod(s.shape))
+        task_cols = {name: i for i, name in enumerate(conf.task_label_slots)}
         self._walk = []  # (kind, width_or_-1, sparse_idx_or_dense_col)
         for s in conf.slots:
             is_label = s.name == conf.label_slot
             if is_label:
                 self._walk.append(("label", int(np.prod(s.shape)), -1, s.type))
+            elif s.name in task_cols:
+                self._walk.append(("task", int(np.prod(s.shape)), task_cols[s.name], s.type))
             elif s.name in sparse_names:
                 self._walk.append(("sparse", -1, sparse_names[s.name], s.type))
             elif s.name in dense_cols:
@@ -58,6 +61,7 @@ class SlotParser:
                 self._walk.append(("skip", -1, -1, s.type))
         self._dense_width = col
         assert col == conf.dense_width()
+        self.n_task_labels = len(task_cols)
         self.n_sparse = len(self.sparse_slots)
 
     @property
@@ -72,6 +76,9 @@ class SlotParser:
         keys: list[int] = []
         offsets: list[int] = [0]
         dense_rows: list[list[float]] = []
+        task_rows: Optional[list[list[float]]] = (
+            [] if self.n_task_labels else None
+        )
         labels: list[float] = []
         ins_ids: Optional[list[str]] = [] if conf.parse_ins_id else None
         search_ids: Optional[list[int]] = [] if conf.parse_logkey else None
@@ -85,7 +92,7 @@ class SlotParser:
                 continue
             try:
                 p = self._parse_one(
-                    toks, keys, offsets, dense_rows, labels,
+                    toks, keys, offsets, dense_rows, task_rows, labels,
                     ins_ids, search_ids, ranks, cmatches,
                 )
             except (IndexError, ValueError) as e:
@@ -103,13 +110,20 @@ class SlotParser:
                 n_ins, self._dense_width
             ),
             labels=np.asarray(labels, dtype=np.float32),
+            task_labels=(
+                np.asarray(task_rows, dtype=np.float32).reshape(
+                    n_ins, self.n_task_labels
+                )
+                if task_rows is not None
+                else None
+            ),
             ins_ids=ins_ids,
             search_ids=np.asarray(search_ids, dtype=np.uint64) if search_ids is not None else None,
             ranks=np.asarray(ranks, dtype=np.int32) if ranks is not None else None,
             cmatches=np.asarray(cmatches, dtype=np.int32) if cmatches is not None else None,
         )
 
-    def _parse_one(self, toks, keys, offsets, dense_rows, labels,
+    def _parse_one(self, toks, keys, offsets, dense_rows, task_rows, labels,
                    ins_ids, search_ids, ranks, cmatches) -> int:
         """Parse one tokenized instance into the accumulator lists."""
         conf = self.conf
@@ -124,6 +138,7 @@ class SlotParser:
             cmatches.append(int(cm))
             p += 1
         drow = [0.0] * self._dense_width
+        trow = [0.0] * self.n_task_labels
         label = 0.0
         per_slot_counts = []
         for kind, width, col, typ in self._walk:
@@ -137,6 +152,13 @@ class SlotParser:
                         f"label slot expected {width} values, got {n}"
                     )
                 label = float(toks[p])
+                p += n
+            elif kind == "task":
+                if n != width:
+                    raise ValueError(
+                        f"task label slot expected {width} values, got {n}"
+                    )
+                trow[col] = float(toks[p])  # first value is the task label
                 p += n
             elif kind == "dense":
                 if n != width:
@@ -157,6 +179,8 @@ class SlotParser:
         for c in per_slot_counts:
             offsets.append(offsets[-1] + c)
         dense_rows.append(drow)
+        if task_rows is not None:
+            task_rows.append(trow)
         labels.append(label)
         return p
 

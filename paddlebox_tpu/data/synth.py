@@ -22,9 +22,14 @@ def make_synth_config(
     dense_dim: int = 4,
     batch_size: int = 64,
     max_feasigns_per_ins: int = 64,
+    n_task_labels: int = 0,
     **kw,
 ) -> DataFeedConfig:
     slots = [SlotConfig(name="click", type="float", is_dense=True, shape=(1,))]
+    slots += [
+        SlotConfig(name=f"task{t}", type="float", is_dense=True, shape=(1,))
+        for t in range(n_task_labels)
+    ]
     slots += [SlotConfig(name=f"slot{i}", type="uint64") for i in range(n_sparse_slots)]
     if dense_dim:
         slots.append(
@@ -34,6 +39,7 @@ def make_synth_config(
         slots=slots,
         batch_size=batch_size,
         label_slot="click",
+        task_label_slots=tuple(f"task{t}" for t in range(n_task_labels)),
         max_feasigns_per_ins=max_feasigns_per_ins,
         **kw,
     )
@@ -52,6 +58,7 @@ def write_synth_files(
     with_logkey: bool = False,
     max_ads_per_pv: int = 4,
     cmatch_values: Sequence[int] = (222, 223),
+    n_task_labels: int = 0,
 ) -> list[str]:
     """Writes slot-text files; returns their paths.
 
@@ -95,6 +102,10 @@ def write_synth_files(
                         cm = int(rng.choice(list(cmatch_values)))
                         parts.append(f"{sid}:{ad + 1}:{cm}")
                     parts.append(f"1 {label}")
+                    for t in range(n_task_labels):
+                        # task labels share the latent signal, thinned per task
+                        tl = int(rng.random() < p * (0.5 + 0.5 / (t + 1)))
+                        parts.append(f"1 {tl}")
                     for ks in slot_keys:
                         parts.append(
                             f"{len(ks)} " + " ".join(str(int(k)) for k in ks)

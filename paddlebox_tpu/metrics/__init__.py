@@ -1,5 +1,6 @@
 """Training metrics computed on-device (SURVEY.md §5.5)."""
 
+from paddlebox_tpu.metrics.variants import MetricGroup, MetricSpec  # noqa: F401
 from paddlebox_tpu.metrics.auc import (
     AucState,
     compute_metrics,

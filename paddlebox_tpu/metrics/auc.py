@@ -66,6 +66,26 @@ def update_auc_state(
     )
 
 
+def stack_auc_states(base: AucState, n: int) -> AucState:
+    """Stack n copies along a new leading axis (per-task / per-metric / per-
+    device streams all use this layout)."""
+    return jax.tree.map(lambda x: jnp.stack([x] * n), base)
+
+
+def unstack_auc_state(state: AucState, i: int) -> AucState:
+    """Host-side: slice stream i out of a stacked state."""
+    return jax.tree.map(lambda x: np.asarray(x)[i], state)
+
+
+def compute_metrics_stacked(state: AucState, names) -> dict:
+    """compute_metrics per stream of a stacked state, keys '<name>/<metric>'."""
+    out = {}
+    for i, name in enumerate(names):
+        for k, v in compute_metrics(unstack_auc_state(state, i)).items():
+            out[f"{name}/{k}"] = v
+    return out
+
+
 def psum_auc_state(state: AucState, axis_name: str) -> AucState:
     """Cross-device merge (reference: collect_data_nccl allreduce,
     box_wrapper.cc:230-273) — one psum over the mesh axis."""

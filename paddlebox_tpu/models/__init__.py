@@ -1,7 +1,22 @@
 """Example CTR model family (SURVEY.md §7 stage 7)."""
 
 from paddlebox_tpu.models.ctr_dnn import CtrDnn
+from paddlebox_tpu.models.dcn import DCN
+from paddlebox_tpu.models.deepfm import DeepFM
 from paddlebox_tpu.models.layers import bce_with_logits, init_mlp, linear, mlp
+from paddlebox_tpu.models.mmoe import MMoE
 from paddlebox_tpu.models.rank_ctr import RankCtrDnn
+from paddlebox_tpu.models.wide_deep import WideDeep
 
-__all__ = ["CtrDnn", "RankCtrDnn", "bce_with_logits", "init_mlp", "linear", "mlp"]
+__all__ = [
+    "CtrDnn",
+    "DCN",
+    "DeepFM",
+    "MMoE",
+    "RankCtrDnn",
+    "WideDeep",
+    "bce_with_logits",
+    "init_mlp",
+    "linear",
+    "mlp",
+]

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from paddlebox_tpu.models.layers import init_mlp, mlp
-from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.ops import fused_seqpool_cvm, fused_seqpool_cvm_extended
 
 
 class CtrDnn:
@@ -25,11 +25,12 @@ class CtrDnn:
     def __init__(
         self,
         n_sparse_slots: int,
-        emb_width: int,  # pulled row width (cvm_offset + embedding_dim)
+        emb_width: int,  # pulled row width (cvm_offset + embedding_dim [+ expand])
         dense_dim: int = 0,
         hidden: Sequence[int] = (512, 256, 128),
         use_cvm: bool = True,
         cvm_offset: int = 2,
+        expand_dim: int = 0,  # extended embedding tail width (pull_box_extended)
     ):
         self.n_sparse_slots = n_sparse_slots
         self.emb_width = emb_width
@@ -37,8 +38,10 @@ class CtrDnn:
         self.hidden = tuple(hidden)
         self.use_cvm = use_cvm
         self.cvm_offset = cvm_offset
-        pooled_w = emb_width if use_cvm else emb_width - cvm_offset
-        self.input_dim = n_sparse_slots * pooled_w + dense_dim
+        self.expand_dim = expand_dim
+        base_w = emb_width - expand_dim
+        pooled_w = base_w if use_cvm else base_w - cvm_offset
+        self.input_dim = n_sparse_slots * (pooled_w + expand_dim) + dense_dim
 
     def init(self, key: jax.Array) -> dict:
         return {"tower": init_mlp(key, self.input_dim, self.hidden, 1)}
@@ -52,9 +55,17 @@ class CtrDnn:
         batch_size: int,
     ) -> jax.Array:
         """Returns logits [B]."""
-        pooled = fused_seqpool_cvm(
-            rows, key_segments, batch_size, self.n_sparse_slots,
-            use_cvm=self.use_cvm, cvm_offset=self.cvm_offset,
-        )
+        if self.expand_dim:
+            base, expand = fused_seqpool_cvm_extended(
+                rows, key_segments, batch_size, self.n_sparse_slots,
+                self.expand_dim, use_cvm=self.use_cvm,
+                cvm_offset=self.cvm_offset,
+            )
+            pooled = jnp.concatenate([base, expand], axis=1)
+        else:
+            pooled = fused_seqpool_cvm(
+                rows, key_segments, batch_size, self.n_sparse_slots,
+                use_cvm=self.use_cvm, cvm_offset=self.cvm_offset,
+            )
         x = jnp.concatenate([pooled, dense], axis=1) if self.dense_dim else pooled
         return mlp(params["tower"], x)[:, 0]

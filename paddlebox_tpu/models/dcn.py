@@ -1,0 +1,66 @@
+"""DCN: deep & cross network over fused seqpool-CVM features
+(BASELINE.json configs[3]: "xDeepFM / DCN higher-order feature-interaction
+nets").
+
+Cross layer l:  x_{l+1} = x0 * (x_l @ w_l) + b_l + x_l   (rank-1 explicit
+feature crossing; w_l is a vector so each layer is one matvec — cheap and
+MXU-trivial after XLA batches it)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_mlp, init_linear, linear, mlp
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class DCN:
+    def __init__(
+        self,
+        n_sparse_slots: int,
+        emb_width: int,
+        dense_dim: int = 0,
+        hidden: Sequence[int] = (256, 128),
+        n_cross: int = 3,
+        use_cvm: bool = True,
+        cvm_offset: int = 2,
+    ):
+        self.n_sparse_slots = n_sparse_slots
+        self.emb_width = emb_width
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+        self.n_cross = n_cross
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        pooled_w = emb_width if use_cvm else emb_width - cvm_offset
+        self.input_dim = n_sparse_slots * pooled_w + dense_dim
+
+    def init(self, key: jax.Array) -> dict:
+        keys = jax.random.split(key, self.n_cross + 2)
+        d = self.input_dim
+        cross = []
+        for i in range(self.n_cross):
+            # zero init -> each cross layer starts as identity; CVM features
+            # reach magnitude ~log(show) and random weights compound them
+            # multiplicatively layer over layer
+            cross.append({"w": jnp.zeros(d), "b": jnp.zeros(d)})
+        deep = init_mlp(keys[-2], d, self.hidden, self.hidden[-1])
+        head = init_linear(keys[-1], d + self.hidden[-1], 1)
+        return {"cross": cross, "deep": deep, "head": head}
+
+    def apply(self, params, rows, key_segments, dense, batch_size):
+        feats = fused_seqpool_cvm(
+            rows, key_segments, batch_size, self.n_sparse_slots,
+            use_cvm=self.use_cvm, cvm_offset=self.cvm_offset,
+        )
+        if self.dense_dim:
+            feats = jnp.concatenate([feats, dense], axis=1)
+        x0 = feats
+        x = feats
+        for layer in params["cross"]:
+            x = x0 * (x @ layer["w"])[:, None] + layer["b"] + x
+        deep = mlp(params["deep"], feats)
+        return linear(params["head"], jnp.concatenate([x, deep], axis=1))[:, 0]

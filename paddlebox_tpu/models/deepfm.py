@@ -1,0 +1,69 @@
+"""DeepFM: factorization machine + deep tower over pooled slot embeddings.
+
+One of the reference's benchmark configs (BASELINE.json configs[1]; in the
+reference this is a user program over ``_pull_box_sparse`` +
+``fused_seqpool_cvm`` + ``fc`` layers — SURVEY.md §1 notes there is no model
+zoo to port, so the model family is first-class here).
+
+FM second-order term over per-slot pooled embedding vectors v_s:
+    fm2 = 0.5 * sum_d [ (sum_s v_sd)^2 - sum_s v_sd^2 ]
+computed directly from the [B, S, D] pooled tensor — two reductions, no
+pairwise materialization.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_linear, init_mlp, linear, mlp
+from paddlebox_tpu.ops.seqpool_cvm import _cvm_transform, seqpool
+
+
+class DeepFM:
+    def __init__(
+        self,
+        n_sparse_slots: int,
+        emb_width: int,  # pulled row width (cvm_offset + embedding_dim)
+        dense_dim: int = 0,
+        hidden: Sequence[int] = (400, 400, 400),
+        use_cvm: bool = True,
+        cvm_offset: int = 2,
+    ):
+        self.n_sparse_slots = n_sparse_slots
+        self.emb_width = emb_width
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        self.emb_dim = emb_width - cvm_offset  # FM acts on the embedding part
+        pooled_w = emb_width if use_cvm else self.emb_dim
+        self.deep_in = n_sparse_slots * pooled_w + dense_dim
+
+    def init(self, key: jax.Array) -> dict:
+        k1, k2 = jax.random.split(key)
+        return {
+            "tower": init_mlp(k1, self.deep_in, self.hidden, 1),
+            # first-order weights act on the CVM-normalized features (raw
+            # pooled show/clk counters are unbounded and blow the linear path)
+            "fm1": init_linear(k2, self.deep_in, 1),
+        }
+
+    def apply(self, params, rows, key_segments, dense, batch_size):
+        pooled = seqpool(rows, key_segments, batch_size, self.n_sparse_slots)
+        v = pooled[..., self.cvm_offset:]  # [B, S, D] embeddings
+        # FM second order: 0.5 * ((sum_s v)^2 - sum_s v^2) summed over D
+        sum_v = v.sum(axis=1)
+        fm2 = 0.5 * (sum_v * sum_v - (v * v).sum(axis=1)).sum(axis=1)  # [B]
+        feats = (
+            _cvm_transform(pooled, self.cvm_offset)
+            if self.use_cvm
+            else v
+        ).reshape(batch_size, -1)
+        if self.dense_dim:
+            feats = jnp.concatenate([feats, dense], axis=1)
+        fm1 = linear(params["fm1"], feats)[:, 0]
+        deep = mlp(params["tower"], feats)[:, 0]
+        return fm1 + fm2 + deep

@@ -1,0 +1,82 @@
+"""MMoE: multi-gate mixture-of-experts multi-task model
+(BASELINE.json configs[4]: "MMoE multi-task recommender — shared sparse
+table, multi-tower dense").
+
+All tasks share the sparse table and the pooled features; E expert MLPs feed
+T softmax gates and T task towers.  Task 0's label is the primary label
+slot; tasks 1.. read the configured ``task_label_slots``
+(DataFeedConfig.task_label_slots — the reference names a label var per
+MetricMsg, box_wrapper.cc:1222-1270).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_linear, init_mlp, linear, mlp
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class MMoE:
+    def __init__(
+        self,
+        n_sparse_slots: int,
+        emb_width: int,
+        dense_dim: int = 0,
+        n_tasks: int = 2,
+        n_experts: int = 4,
+        expert_hidden: Sequence[int] = (128,),
+        expert_dim: int = 64,
+        tower_hidden: Sequence[int] = (32,),
+        use_cvm: bool = True,
+        cvm_offset: int = 2,
+    ):
+        self.n_sparse_slots = n_sparse_slots
+        self.emb_width = emb_width
+        self.dense_dim = dense_dim
+        self.n_tasks = n_tasks
+        self.n_experts = n_experts
+        self.expert_hidden = tuple(expert_hidden)
+        self.expert_dim = expert_dim
+        self.tower_hidden = tuple(tower_hidden)
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        pooled_w = emb_width if use_cvm else emb_width - cvm_offset
+        self.input_dim = n_sparse_slots * pooled_w + dense_dim
+
+    def init(self, key: jax.Array) -> dict:
+        ke, kg, kt = jax.random.split(key, 3)
+        experts = [
+            init_mlp(k, self.input_dim, self.expert_hidden, self.expert_dim)
+            for k in jax.random.split(ke, self.n_experts)
+        ]
+        gates = [
+            init_linear(k, self.input_dim, self.n_experts)
+            for k in jax.random.split(kg, self.n_tasks)
+        ]
+        towers = [
+            init_mlp(k, self.expert_dim, self.tower_hidden, 1)
+            for k in jax.random.split(kt, self.n_tasks)
+        ]
+        return {"experts": experts, "gates": gates, "towers": towers}
+
+    def apply(self, params, rows, key_segments, dense, batch_size):
+        """Returns logits [B, n_tasks]."""
+        feats = fused_seqpool_cvm(
+            rows, key_segments, batch_size, self.n_sparse_slots,
+            use_cvm=self.use_cvm, cvm_offset=self.cvm_offset,
+        )
+        if self.dense_dim:
+            feats = jnp.concatenate([feats, dense], axis=1)
+        expert_out = jnp.stack(
+            [mlp(e, feats) for e in params["experts"]], axis=1
+        )  # [B, E, expert_dim]
+        logits = []
+        for gate, tower in zip(params["gates"], params["towers"]):
+            g = jax.nn.softmax(linear(gate, feats), axis=-1)  # [B, E]
+            mixed = jnp.einsum("be,bed->bd", g, expert_out)
+            logits.append(mlp(tower, mixed)[:, 0])
+        return jnp.stack(logits, axis=1)

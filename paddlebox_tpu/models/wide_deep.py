@@ -1,0 +1,49 @@
+"""Wide&Deep: a linear "wide" path + deep tower over fused seqpool-CVM
+features (BASELINE.json configs[2]: "Wide&Deep with fused_seqpool_cvm
+multi-slot features")."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_linear, init_mlp, linear, mlp
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class WideDeep:
+    def __init__(
+        self,
+        n_sparse_slots: int,
+        emb_width: int,
+        dense_dim: int = 0,
+        hidden: Sequence[int] = (512, 256, 128),
+        use_cvm: bool = True,
+        cvm_offset: int = 2,
+    ):
+        self.n_sparse_slots = n_sparse_slots
+        self.emb_width = emb_width
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+        self.use_cvm = use_cvm
+        self.cvm_offset = cvm_offset
+        pooled_w = emb_width if use_cvm else emb_width - cvm_offset
+        self.input_dim = n_sparse_slots * pooled_w + dense_dim
+
+    def init(self, key: jax.Array) -> dict:
+        k1, k2 = jax.random.split(key)
+        return {
+            "tower": init_mlp(k1, self.input_dim, self.hidden, 1),
+            "wide": init_linear(k2, self.input_dim, 1),
+        }
+
+    def apply(self, params, rows, key_segments, dense, batch_size):
+        feats = fused_seqpool_cvm(
+            rows, key_segments, batch_size, self.n_sparse_slots,
+            use_cvm=self.use_cvm, cvm_offset=self.cvm_offset,
+        )
+        if self.dense_dim:
+            feats = jnp.concatenate([feats, dense], axis=1)
+        return linear(params["wide"], feats)[:, 0] + mlp(params["tower"], feats)[:, 0]

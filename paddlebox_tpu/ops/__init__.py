@@ -7,12 +7,17 @@ operators/pull_box_sparse_op.*) with jittable JAX functions that XLA fuses.
 
 from paddlebox_tpu.ops.cvm import cvm, cvm_decayed_show
 from paddlebox_tpu.ops.rank_attention import ins_rank, rank_attention
-from paddlebox_tpu.ops.seqpool_cvm import fused_seqpool_cvm, seqpool
+from paddlebox_tpu.ops.seqpool_cvm import (
+    fused_seqpool_cvm,
+    fused_seqpool_cvm_extended,
+    seqpool,
+)
 
 __all__ = [
     "cvm",
     "cvm_decayed_show",
     "fused_seqpool_cvm",
+    "fused_seqpool_cvm_extended",
     "seqpool",
     "rank_attention",
     "ins_rank",

@@ -76,15 +76,51 @@ def fused_seqpool_cvm(
     """
     pooled = seqpool(rows, key_segments, batch_size, n_slots)
     if need_filter:
-        score = (
-            pooled[..., 0:1] * show_coeff + pooled[..., 1:2] * clk_coeff
-        )
-        keep = (score >= embed_threshold).astype(pooled.dtype)
-        pooled = jnp.concatenate(
-            [pooled[..., :cvm_offset], pooled[..., cvm_offset:] * keep], axis=-1
+        pooled = _embed_filter(
+            pooled, cvm_offset, show_coeff, clk_coeff, embed_threshold
         )
     if use_cvm:
         out = _cvm_transform(pooled, cvm_offset)
     else:
         out = pooled[..., cvm_offset:]
     return out.reshape(batch_size, -1)
+
+
+def _embed_filter(pooled, cvm_offset, show_coeff, clk_coeff, embed_threshold):
+    score = pooled[..., 0:1] * show_coeff + pooled[..., 1:2] * clk_coeff
+    keep = (score >= embed_threshold).astype(pooled.dtype)
+    return jnp.concatenate(
+        [pooled[..., :cvm_offset], pooled[..., cvm_offset:] * keep], axis=-1
+    )
+
+
+def fused_seqpool_cvm_extended(
+    rows: jax.Array,
+    key_segments: jax.Array,
+    batch_size: int,
+    n_slots: int,
+    expand_dim: int,
+    use_cvm: bool = True,
+    cvm_offset: int = 2,
+) -> tuple[jax.Array, jax.Array]:
+    """Pool rows carrying base + expand embeddings and return the two feature
+    blocks separately (reference: pull_box_extended_sparse's dual Out/OutExtend
+    outputs, operators/pull_box_extended_sparse_op.{cc,cu,h}, pooled by the
+    fused_seqpool_cvm variants).
+
+    rows: [K, cvm_offset + emb + expand]; returns
+      base   [B, n_slots * (cvm_offset + emb)]  (CVM-transformed if use_cvm)
+      expand [B, n_slots * expand]              (plain pooled values)
+    """
+    if expand_dim <= 0:
+        raise ValueError(
+            "fused_seqpool_cvm_extended needs expand_dim > 0 "
+            "(use fused_seqpool_cvm for plain rows)"
+        )
+    pooled = seqpool(rows, key_segments, batch_size, n_slots)
+    base, expand = pooled[..., :-expand_dim], pooled[..., -expand_dim:]
+    if use_cvm:
+        base = _cvm_transform(base, cvm_offset)
+    else:
+        base = base[..., cvm_offset:]
+    return base.reshape(batch_size, -1), expand.reshape(batch_size, -1)

@@ -23,7 +23,15 @@ import optax
 
 from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
 from paddlebox_tpu.data.feed import HostBatch
-from paddlebox_tpu.metrics.auc import AucState, compute_metrics, init_auc_state, update_auc_state
+from paddlebox_tpu.metrics.auc import (
+    AucState,
+    compute_metrics,
+    compute_metrics_stacked,
+    init_auc_state,
+    stack_auc_states,
+    update_auc_state,
+)
+from paddlebox_tpu.metrics.variants import MetricGroup
 from paddlebox_tpu.models.layers import bce_with_logits
 from paddlebox_tpu.sparse.table import SparseTable, pull_rows, push_and_update
 
@@ -56,6 +64,8 @@ def _device_batch(batch: HostBatch, plan, n_slots: int) -> dict:
     }
     if batch.rank_offset is not None:
         dev["rank_offset"] = jnp.asarray(batch.rank_offset)
+    if batch.task_labels is not None:
+        dev["task_labels"] = jnp.asarray(batch.task_labels)
     return dev
 
 
@@ -68,10 +78,13 @@ class Trainer:
         table_conf: SparseTableConfig,
         trainer_conf: Optional[TrainerConfig] = None,
         seed: int = 0,
+        metric_group: Optional[MetricGroup] = None,
     ):
         self.model = model
         self.table_conf = table_conf
         self.conf = trainer_conf or TrainerConfig()
+        self.metric_group = metric_group
+        self.n_tasks = getattr(model, "n_tasks", 1)
         if self.conf.dense_optimizer == "adam":
             self.optimizer = optax.adam(self.conf.dense_lr)
         elif self.conf.dense_optimizer == "sgd":
@@ -90,8 +103,10 @@ class Trainer:
         optimizer = self.optimizer
         check_nan = self.conf.check_nan_inf
         uses_rank = getattr(model, "uses_rank_offset", False)
+        n_tasks = self.n_tasks
+        has_group = self.metric_group is not None
 
-        def step(params, opt_state, values, g2sum, auc, batch):
+        def step(params, opt_state, values, g2sum, mstate, batch):
             rows = pull_rows(
                 values, batch["idx"],
                 create_threshold=tconf.create_threshold,
@@ -104,8 +119,16 @@ class Trainer:
                 logits = model.apply(
                     p, r, batch["key_segments"], batch["dense"], bsz, **extra
                 )
-                per_ins = bce_with_logits(logits, batch["labels"]) * batch["ins_mask"]
-                denom = jnp.maximum(batch["ins_mask"].sum(), 1.0)
+                mask = batch["ins_mask"]
+                denom = jnp.maximum(mask.sum(), 1.0)
+                if n_tasks > 1:
+                    # [B, T] logits vs [B, T] task labels; mean over tasks
+                    per_ins = (
+                        bce_with_logits(logits, batch["task_labels"]).mean(axis=1)
+                        * mask
+                    )
+                else:
+                    per_ins = bce_with_logits(logits, batch["labels"]) * mask
                 return per_ins.sum() / denom, jax.nn.sigmoid(logits)
 
             (loss, preds), (pgrads, row_grads) = jax.value_and_grad(
@@ -118,7 +141,22 @@ class Trainer:
                 values, g2sum, row_grads, batch["idx"], batch["uniq_idx"],
                 batch["inverse"], batch["key_mask"], batch["key_clicks"], tconf,
             )
-            auc = update_auc_state(auc, preds, batch["labels"], batch["ins_mask"])
+            primary = preds[:, 0] if n_tasks > 1 else preds
+            mstate = dict(mstate)
+            mstate["auc"] = update_auc_state(
+                mstate["auc"], primary, batch["labels"], batch["ins_mask"]
+            )
+            if n_tasks > 1:
+                mstate["task"] = jax.vmap(
+                    lambda s, pr, lb: update_auc_state(
+                        s, pr, lb, batch["ins_mask"]
+                    )
+                )(mstate["task"], preds.T, batch["task_labels"].T)
+            if has_group:
+                mstate["group"] = MetricGroup.update(
+                    mstate["group"], primary, batch["labels"],
+                    batch["metric_masks"],
+                )
             if check_nan:
                 finite = jnp.isfinite(loss)
                 for leaf in jax.tree.leaves(pgrads):
@@ -126,9 +164,35 @@ class Trainer:
                 finite &= jnp.isfinite(row_grads).all()
             else:
                 finite = jnp.array(True)
-            return params, opt_state, values, g2sum, auc, loss, finite
+            return params, opt_state, values, g2sum, mstate, loss, finite
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
+
+    def _init_mstate(self, auc_state=None) -> dict:
+        """Fresh metric state, or continuation: pass the previous pass's
+        ``trainer.last_metric_state`` (a dict) to carry EVERY stream forward;
+        a bare AucState continues only the primary stream and is rejected
+        when task/group streams exist (they would silently reset)."""
+        if isinstance(auc_state, dict):
+            return auc_state
+        if auc_state is not None and (self.n_tasks > 1 or self.metric_group):
+            raise ValueError(
+                "pass trainer.last_metric_state (dict) to continue metrics "
+                "across passes — a bare AucState would reset the task/group "
+                "streams while continuing the primary one"
+            )
+        mstate = {
+            "auc": auc_state
+            if auc_state is not None
+            else init_auc_state(self.conf.auc_buckets)
+        }
+        if self.n_tasks > 1:
+            mstate["task"] = stack_auc_states(
+                init_auc_state(self.conf.auc_buckets), self.n_tasks
+            )
+        if self.metric_group is not None:
+            mstate["group"] = self.metric_group.init_state()
+        return mstate
 
     # -- dense persistence -------------------------------------------------- #
     def dense_state(self) -> tuple:
@@ -156,7 +220,7 @@ class Trainer:
         """
         if self._step_fn is None:
             self._step_fn = self._build_step()
-        auc = auc_state if auc_state is not None else init_auc_state(self.conf.auc_buckets)
+        mstate = self._init_mstate(auc_state)
         values, g2sum = table.values, table.g2sum
         losses, n_steps = [], 0
         uses_rank = getattr(self.model, "uses_rank_offset", False)
@@ -167,10 +231,26 @@ class Trainer:
                         "model requires PV-merged batches with rank_offset: "
                         "set enable_pv_merge and call dataset.preprocess_instance()"
                     )
+                if self.n_tasks > 1 and (
+                    batch.task_labels is None
+                    or batch.task_labels.shape[1] != self.n_tasks
+                ):
+                    got = (
+                        0 if batch.task_labels is None
+                        else batch.task_labels.shape[1]
+                    )
+                    raise RuntimeError(
+                        f"model has {self.n_tasks} tasks but the batch carries "
+                        f"{got} task label columns: configure "
+                        "DataFeedConfig.task_label_slots with "
+                        f"{self.n_tasks - 1} slots (task 0 is the primary label)"
+                    )
                 plan = table.plan_batch(batch)
                 dev = _device_batch(batch, plan, batch.n_sparse_slots)
-                (self.params, self.opt_state, values, g2sum, auc, loss, finite) = (
-                    self._step_fn(self.params, self.opt_state, values, g2sum, auc, dev)
+                if self.metric_group is not None:
+                    dev["metric_masks"] = jnp.asarray(self.metric_group.masks(batch))
+                (self.params, self.opt_state, values, g2sum, mstate, loss, finite) = (
+                    self._step_fn(self.params, self.opt_state, values, g2sum, mstate, dev)
                 )
                 if self.conf.check_nan_inf and not bool(finite):
                     raise FloatingPointError(
@@ -184,10 +264,19 @@ class Trainer:
             # old buffers were donated to the jitted step: always hand the
             # live ones back so end_pass() works even after a NaN raise
             table.values, table.g2sum = values, g2sum
-        metrics = compute_metrics(auc)
+        metrics = compute_metrics(mstate["auc"])
+        if self.n_tasks > 1:
+            metrics.update(
+                compute_metrics_stacked(
+                    mstate["task"], [f"task{t}" for t in range(self.n_tasks)]
+                )
+            )
+        if self.metric_group is not None:
+            metrics.update(self.metric_group.compute(mstate["group"]))
         metrics["loss"] = float(jnp.stack(losses).mean()) if losses else 0.0
         metrics["steps"] = n_steps
-        self.last_auc_state = auc
+        self.last_auc_state = mstate["auc"]
+        self.last_metric_state = mstate
         return metrics
 
     def train_steps(self, table: SparseTable, batches: Iterable[HostBatch]) -> dict:
