@@ -1,0 +1,170 @@
+"""Native (C++) host-pipeline components, loaded via ctypes.
+
+The reference keeps its whole data layer in C++ because host feed was the
+production bottleneck (SURVEY.md §2.4); here the parser is the native hot
+path and the rest of the pipeline stays numpy (already vectorized).  The
+shared library builds on demand with g++ (no pybind11 in the image — plain
+C ABI + ctypes), is cached next to the source keyed by source mtime, and
+anything failing (no compiler, build error) falls back to the pure-Python
+parser transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "slot_parser.cpp")
+_SO = os.path.join(_DIR, "_slot_parser.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    tmp = _SO + f".tmp-{os.getpid()}"
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        return None
+
+
+def get_lib():
+    """The loaded native library, or None (build unavailable/failed)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.pbx_parse_buffer.restype = ctypes.c_void_p
+        lib.pbx_parse_buffer.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int64,
+        ]
+        for name in ("pbx_n_ins", "pbx_n_keys", "pbx_ins_id_bytes"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.pbx_fill.restype = None
+        lib.pbx_fill.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 10
+        lib.pbx_free.restype = None
+        lib.pbx_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+_KIND_CODE = {"skip": 0, "label": 1, "task": 2, "dense": 3, "sparse": 4}
+
+
+class NativeParser:
+    """ctypes front-end bound to one walk layout (shared per SlotParser)."""
+
+    def __init__(self, walk, n_sparse: int, dense_width: int, n_tasks: int,
+                 parse_ins_id: bool, parse_logkey: bool):
+        self.lib = get_lib()
+        if self.lib is None:
+            raise RuntimeError("native parser unavailable")
+        kinds, widths, cols = [], [], []
+        for kind, width, col, _typ in walk:
+            kinds.append(_KIND_CODE[kind])
+            widths.append(max(width, 0))
+            cols.append(max(col, 0))
+        self._kinds = np.asarray(kinds, dtype=np.int8)
+        self._widths = np.asarray(widths, dtype=np.int32)
+        self._cols = np.asarray(cols, dtype=np.int32)
+        self.n_sparse = n_sparse
+        self.dense_width = dense_width
+        self.n_tasks = n_tasks
+        self.parse_ins_id = parse_ins_id
+        self.parse_logkey = parse_logkey
+
+    def parse_bytes(self, data: bytes, path: str = "<buffer>"):
+        from paddlebox_tpu.data.record import RecordBlock
+
+        lib = self.lib
+        err = ctypes.create_string_buffer(256)
+        handle = lib.pbx_parse_buffer(
+            data, len(data),
+            self._kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            self._widths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(self._kinds), self.n_sparse, self.dense_width, self.n_tasks,
+            int(self.parse_ins_id), int(self.parse_logkey), err, 256,
+        )
+        if not handle:
+            raise ValueError(
+                f"{path}: malformed instance ({err.value.decode()})"
+            )
+        try:
+            n = lib.pbx_n_ins(handle)
+            nk = lib.pbx_n_keys(handle)
+            keys = np.empty(nk, dtype=np.uint64)
+            offsets = np.empty(n * self.n_sparse + 1, dtype=np.int64)
+            dense = np.zeros((n, self.dense_width), dtype=np.float32)
+            labels = np.empty(n, dtype=np.float32)
+            tasks = (
+                np.empty((n, self.n_tasks), dtype=np.float32)
+                if self.n_tasks
+                else None
+            )
+            sids = ranks = cmatches = None
+            if self.parse_logkey:
+                sids = np.empty(n, dtype=np.uint64)
+                ranks = np.empty(n, dtype=np.int32)
+                cmatches = np.empty(n, dtype=np.int32)
+            insid_buf = insid_offs = None
+            if self.parse_ins_id:
+                insid_buf = np.empty(lib.pbx_ins_id_bytes(handle), dtype=np.uint8)
+                insid_offs = np.empty(n + 1, dtype=np.int64)
+            ptr = lambda a: (
+                a.ctypes.data_as(ctypes.c_void_p) if a is not None else None
+            )
+            lib.pbx_fill(
+                handle, ptr(keys), ptr(offsets), ptr(dense), ptr(labels),
+                ptr(tasks), ptr(sids), ptr(ranks), ptr(cmatches),
+                ptr(insid_buf), ptr(insid_offs),
+            )
+        finally:
+            lib.pbx_free(handle)
+        ins_ids = None
+        if self.parse_ins_id:
+            raw = insid_buf.tobytes()
+            ins_ids = [
+                raw[insid_offs[i]:insid_offs[i + 1]].decode()
+                for i in range(n)
+            ]
+        return RecordBlock(
+            n_ins=int(n),
+            n_sparse_slots=self.n_sparse,
+            keys=keys,
+            key_offsets=offsets,
+            dense=dense,
+            labels=labels,
+            ins_ids=ins_ids,
+            search_ids=sids,
+            ranks=ranks,
+            cmatches=cmatches,
+            task_labels=tasks,
+        )

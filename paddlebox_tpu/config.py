@@ -34,6 +34,9 @@ class _Flags:
         "enable_pull_box_padding_zero": True,
         # use pallas kernels for sparse gather/scatter where available
         "use_pallas_sparse": False,
+        # use the native (C++/ctypes) slot parser when it builds; falls back
+        # to the pure-Python parser automatically
+        "use_native_parser": True,
         # reference: FLAGS_padbox_auc_runner_mode (flags.cc:495)
         "auc_runner_mode": False,
         # preferred device compute dtype for dense towers
@@ -168,9 +171,15 @@ class DataFeedConfig:
             )
         if len(set(self.task_label_slots)) != len(self.task_label_slots):
             raise ValueError("task_label_slots contains duplicates")
+        by_name = {s.name: s for s in self.slots}
         for t in self.task_label_slots:
             if self.slots and t not in seen:
                 raise ValueError(f"task label slot {t!r} is not configured")
+            if self.slots and by_name[t].type != "float":
+                raise ValueError(
+                    f"task label slot {t!r} must be a float slot, "
+                    f"got type={by_name[t].type!r}"
+                )
             if t == self.label_slot:
                 raise ValueError(
                     "task_label_slots must not repeat the primary label slot "

@@ -15,8 +15,12 @@ features.  Dense (fixed-shape) float slots must supply exactly
 The label slot is always consumed (even if declared is_used=False) because
 every instance must carry a label; it never appears in the dense matrix.
 
-This is the reference implementation; a vectorized / native parser may
-replace it on the hot path once bench.py quantifies the gap.
+Two implementations share this walk layout: the pure-Python reference
+implementation below (always available, used by parse_lines), and the native
+C++ parser (paddlebox_tpu/_native/slot_parser.cpp, ctypes) that parse_file
+prefers when it builds — the host feed is the production bottleneck, exactly
+why the reference kept this layer in pooled C++ (data_feed.h:897-1085).
+Disable via PBOX_USE_NATIVE_PARSER=0.
 """
 
 from __future__ import annotations
@@ -27,7 +31,7 @@ from typing import Iterable, Optional
 
 import numpy as np
 
-from paddlebox_tpu.config import DataFeedConfig
+from paddlebox_tpu.config import DataFeedConfig, flags
 
 
 class SlotParser:
@@ -63,6 +67,53 @@ class SlotParser:
         assert col == conf.dense_width()
         self.n_task_labels = len(task_cols)
         self.n_sparse = len(self.sparse_slots)
+        self._native = None
+        self._native_tried = False
+
+    def _native_parser(self):
+        """Build/load the C++ parser lazily; None when unavailable."""
+        if self._native_tried:
+            return self._native
+        self._native_tried = True
+        if not flags.use_native_parser:
+            return None
+        try:
+            from paddlebox_tpu._native import NativeParser
+
+            self._native = NativeParser(
+                self._walk, self.n_sparse, self._dense_width,
+                self.n_task_labels, self.conf.parse_ins_id,
+                self.conf.parse_logkey,
+            )
+        except (ImportError, RuntimeError, OSError):
+            self._native = None  # any unavailability -> pure-Python fallback
+        return self._native
+
+    def _native_parse_stream(self, native, fh, path: str):
+        """Feed a binary stream to the native parser in bounded chunks split
+        at line boundaries (keeps pipe/.gz memory at chunk size, not shard
+        size), concatenating the resulting blocks."""
+        from paddlebox_tpu.data.record import RecordBlock
+
+        CHUNK = 64 << 20
+        blocks = []
+        carry = b""
+        while True:
+            data = fh.read(CHUNK)
+            if not data:
+                break
+            data = carry + data
+            cut = data.rfind(b"\n")
+            if cut < 0:
+                carry = data
+                continue
+            carry = data[cut + 1:]
+            blocks.append(native.parse_bytes(data[: cut + 1], path=path))
+        if carry:
+            blocks.append(native.parse_bytes(carry, path=path))
+        if not blocks:
+            return native.parse_bytes(b"", path=path)
+        return RecordBlock.concat(blocks)
 
     @property
     def dense_width(self) -> int:
@@ -189,10 +240,12 @@ class SlotParser:
         """Read one file, honoring pipe_command and .gz, and parse it.
 
         Reference: LoadIntoMemoryByLine forks ``pipe_command`` over the file
-        (data_feed.cc:2854; framework/io/shell.cc popen discipline).  The pipe
-        command streams: the file is handed to the subprocess as stdin and
-        stdout is consumed line-by-line, never buffering the whole output.
+        (data_feed.cc:2854; framework/io/shell.cc popen discipline).  Pipe and
+        .gz input streams in bounded chunks (line-by-line for the Python
+        parser, 64MB line-aligned chunks for the native one) — the whole
+        decompressed shard is never held at once.
         """
+        native = self._native_parser()
         if self.conf.pipe_command:
             with open(path, "rb") as src:
                 proc = subprocess.Popen(
@@ -200,11 +253,17 @@ class SlotParser:
                     shell=True,
                     stdin=src,
                     stdout=subprocess.PIPE,
-                    text=True,
-                    encoding="utf-8",
                 )
                 try:
-                    block = self.parse_lines(proc.stdout, path=path)
+                    if native is not None:
+                        block = self._native_parse_stream(
+                            native, proc.stdout, path
+                        )
+                    else:
+                        import io
+
+                        text = io.TextIOWrapper(proc.stdout, encoding="utf-8")
+                        block = self.parse_lines(text, path=path)
                 finally:
                     proc.stdout.close()
                     ret = proc.wait()
@@ -215,7 +274,14 @@ class SlotParser:
                     )
                 return block
         if path.endswith(".gz"):
+            if native is not None:
+                with gzip.open(path, "rb") as f:
+                    return self._native_parse_stream(native, f, path)
             with gzip.open(path, "rt") as f:
                 return self.parse_lines(f, path=path)
+        if native is not None:
+            # plain file: one read, size == on-disk size
+            with open(path, "rb") as f:
+                return native.parse_bytes(f.read(), path=path)
         with open(path, "r") as f:
             return self.parse_lines(f, path=path)

@@ -44,6 +44,7 @@ from paddlebox_tpu.metrics.auc import (
     AucState,
     compute_metrics,
     init_auc_state,
+    stack_auc_states,
     update_auc_state,
 )
 from paddlebox_tpu.models.layers import bce_with_logits
@@ -311,9 +312,9 @@ class MultiChipTrainer:
 
     # -- public API --------------------------------------------------------- #
     def init_auc(self) -> AucState:
-        auc = init_auc_state(self.conf.auc_buckets)
         return jax.device_put(
-            jax.tree.map(lambda x: jnp.stack([x] * self.n_dev), auc), self._sharding
+            stack_auc_states(init_auc_state(self.conf.auc_buckets), self.n_dev),
+            self._sharding,
         )
 
     def train_from_dataset(
