@@ -61,9 +61,7 @@ def bench_ours(ds, tconf, trconf, model, seed=0):
     table.begin_pass(ds.unique_keys())
     trainer = Trainer(model, tconf, trconf, seed=seed)
     trainer._step_fn = trainer._build_step()
-    from paddlebox_tpu.metrics.auc import init_auc_state
-
-    auc = init_auc_state(trconf.auc_buckets)
+    mstate = trainer._init_mstate()
     values, g2sum = table.values, table.g2sum
     params, opt_state = trainer.params, trainer.opt_state
 
@@ -75,8 +73,8 @@ def bench_ours(ds, tconf, trconf, model, seed=0):
     plan = table.plan_batch(batches[0])
     dev = _device_batch(batches[0], plan, n_slots)
     t0 = time.perf_counter()
-    params, opt_state, values, g2sum, auc, loss, _ = trainer._step_fn(
-        params, opt_state, values, g2sum, auc, dev)
+    params, opt_state, values, g2sum, mstate, loss, _, _ = trainer._step_fn(
+        params, opt_state, values, g2sum, mstate, dev)
     loss.block_until_ready()
     log(f"ours: compile+first step {time.perf_counter() - t0:.1f}s")
 
@@ -85,8 +83,8 @@ def bench_ours(ds, tconf, trconf, model, seed=0):
     for b in batches[1:]:
         plan = table.plan_batch(b)
         dev = _device_batch(b, plan, n_slots)
-        params, opt_state, values, g2sum, auc, loss, _ = trainer._step_fn(
-            params, opt_state, values, g2sum, auc, dev)
+        params, opt_state, values, g2sum, mstate, loss, _, _ = trainer._step_fn(
+            params, opt_state, values, g2sum, mstate, dev)
         n += B
     loss.block_until_ready()
     dt = time.perf_counter() - t0

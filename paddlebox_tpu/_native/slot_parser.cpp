@@ -64,7 +64,11 @@ inline bool parse_u64(const char* t, size_t n, uint64_t* out) {
   uint64_t v = 0;
   for (size_t i = 0; i < n; ++i) {
     if (t[i] < '0' || t[i] > '9') return false;
-    v = v * 10u + static_cast<uint64_t>(t[i] - '0');
+    uint64_t d = static_cast<uint64_t>(t[i] - '0');
+    // reject > 2^64-1 instead of silently wrapping (the Python parser
+    // raises OverflowError on the same input)
+    if (v > (UINT64_MAX - d) / 10u) return false;
+    v = v * 10u + d;
   }
   *out = v;
   return true;

@@ -20,6 +20,8 @@ paddlebox_tpu/data/shuffle.py.
 from __future__ import annotations
 
 import concurrent.futures as futures
+import dataclasses
+import os
 import threading
 from typing import Iterator, Optional, Sequence
 
@@ -32,6 +34,16 @@ from paddlebox_tpu.data.slot_parser import SlotParser
 from paddlebox_tpu.utils.timer import Timer
 
 
+@dataclasses.dataclass
+class _DiskSpill:
+    """Pass data spilled to local disk as binary archives (reference:
+    PreLoadIntoDisk, data_set.cc:1577 + BinaryArchiveWriter)."""
+
+    paths: list[str]
+    unique_keys: np.ndarray
+    n_ins: int
+
+
 class PadBoxSlotDataset:
     def __init__(self, conf: DataFeedConfig, read_threads: Optional[int] = None):
         self.conf = conf
@@ -42,6 +54,7 @@ class PadBoxSlotDataset:
         self.date: Optional[str] = None
         self._block: Optional[RecordBlock] = None
         self._order: Optional[np.ndarray] = None
+        self._spill: Optional[_DiskSpill] = None
         self._preload: Optional[futures.Future] = None
         self._pool = futures.ThreadPoolExecutor(max_workers=self.read_threads)
         self._preload_pool = futures.ThreadPoolExecutor(max_workers=1)
@@ -74,6 +87,7 @@ class PadBoxSlotDataset:
     def load_into_memory(self) -> None:
         self._block = self._read_all()
         self._order = np.arange(self._block.n_ins)
+        self._spill = None
 
     def preload_into_memory(self) -> None:
         """Overlap next-pass reading with current-pass training (reference:
@@ -82,16 +96,72 @@ class PadBoxSlotDataset:
             raise RuntimeError("preload already in flight")
         self._preload = self._preload_pool.submit(self._read_all)
 
+    # -- disk spill ------------------------------------------------------- #
+    def _read_to_disk(self, spill_dir: str) -> _DiskSpill:
+        """Parse + archive each input file to local disk; only the key census
+        stays in memory (reference: PreLoadIntoDisk data_set.cc:1577 writes
+        BinaryArchive instance files; batches() then streams them back)."""
+        from paddlebox_tpu.data.archive import write_archive
+
+        self.read_timer.resume()
+        try:
+            os.makedirs(spill_dir, exist_ok=True)
+            if not self.filelist:
+                raise RuntimeError("set_filelist before loading")
+            # the shuffler exchange is a once-per-pass collective, so the
+            # spill path parses + exchanges exactly like _read_all (whole
+            # pass in memory during load) and spends its memory win at
+            # train time, streaming archives back batch by batch
+            blocks = list(self._pool.map(self.parser.parse_file, self.filelist))
+            block = RecordBlock.concat(blocks)
+            if self.shuffler is not None:
+                block = self.shuffler.exchange(block)
+            n_chunks = max(len(self.filelist), 1)
+            chunk = max((block.n_ins + n_chunks - 1) // n_chunks, 1)
+            paths = []
+            for i, lo in enumerate(range(0, block.n_ins, chunk)):
+                out = os.path.join(spill_dir, f"spill-{i:05d}.bin")
+                write_archive(
+                    out,
+                    [block.select(np.arange(lo, min(lo + chunk, block.n_ins)))],
+                )
+                paths.append(out)
+            return _DiskSpill(paths, np.unique(block.keys), block.n_ins)
+        finally:
+            self.read_timer.pause()
+
+    def preload_into_disk(self, spill_dir: str) -> None:
+        """Background parse-to-disk (PreLoadIntoDisk analog): the pass data
+        waits as binary archives; training streams them batch by batch
+        without holding the whole pass in memory."""
+        if self._preload is not None:
+            raise RuntimeError("preload already in flight")
+        self._preload = self._preload_pool.submit(self._read_to_disk, spill_dir)
+
     def wait_preload_done(self) -> None:
         if self._preload is None:
             raise RuntimeError("no preload in flight")
-        self._block = self._preload.result()
-        self._order = np.arange(self._block.n_ins)
+        result = self._preload.result()
         self._preload = None
+        if isinstance(result, _DiskSpill):
+            self._spill = result
+            self._block = None
+            self._order = None
+        else:
+            self._block = result
+            self._order = np.arange(self._block.n_ins)
+            self._spill = None
 
     def release_memory(self) -> None:
         self._block = None
         self._order = None
+        if self._spill is not None:
+            for p in self._spill.paths:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            self._spill = None
 
     def close(self) -> None:
         """Shut down reader threads; the dataset stays usable for in-memory
@@ -200,14 +270,52 @@ class PadBoxSlotDataset:
 
     # -- pass / batches -------------------------------------------------- #
     def get_memory_data_size(self) -> int:
+        if self._spill is not None:
+            return self._spill.n_ins
         return 0 if self._block is None else self._block.n_ins
 
     def unique_keys(self) -> np.ndarray:
+        if self._spill is not None:
+            return self._spill.unique_keys
         if self._block is None:
             raise RuntimeError("load before key census")
         return self._block.unique_keys()
 
+    def _disk_batches(self, drop_last: bool) -> Iterator[HostBatch]:
+        """Stream batches from spill archives, carrying partial-batch
+        remainders across archive boundaries."""
+        from paddlebox_tpu.data.archive import read_archive
+
+        B = self.conf.batch_size
+        pending: Optional[RecordBlock] = None
+        for path in self._spill.paths:
+            for block in read_archive(path):
+                pending = (
+                    block if pending is None
+                    else RecordBlock.concat([pending, block])
+                )
+                n_full = pending.n_ins // B
+                for i in range(n_full):
+                    yield self.builder.build(
+                        pending, np.arange(i * B, (i + 1) * B)
+                    )
+                rem = pending.n_ins - n_full * B
+                pending = (
+                    pending.select(np.arange(n_full * B, pending.n_ins))
+                    if rem
+                    else None
+                )
+        if pending is not None and not drop_last:
+            yield self.builder.build(pending, np.arange(pending.n_ins))
+
     def batches(self, drop_last: bool = False) -> Iterator[HostBatch]:
+        if self._spill is not None:
+            if self.pv_mode:
+                raise RuntimeError(
+                    "PV merge needs in-memory data (use preload_into_memory)"
+                )
+            yield from self._disk_batches(drop_last)
+            return
         if self._block is None:
             raise RuntimeError("load before iterating")
         if self.pv_mode:

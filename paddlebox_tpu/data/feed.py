@@ -45,6 +45,8 @@ class HostBatch:
     # per-instance logkey metadata for mask/cmatch-rank metric variants
     cmatches: Optional[np.ndarray] = None  # int32 [B]
     ranks: Optional[np.ndarray] = None  # int32 [B]
+    # instance ids of the real rows (len == n_real_ins), for field dumping
+    ins_ids: Optional[list] = None
 
     @property
     def n_real_ins(self) -> int:
@@ -71,6 +73,7 @@ def empty_like(batch: HostBatch) -> HostBatch:
         else np.zeros_like(batch.task_labels),
         cmatches=None if batch.cmatches is None else np.zeros_like(batch.cmatches),
         ranks=None if batch.ranks is None else np.zeros_like(batch.ranks),
+        ins_ids=None if batch.ins_ids is None else [],
     )
 
 
@@ -205,4 +208,9 @@ class BatchBuilder:
             task_labels=task_labels,
             cmatches=cmatches,
             ranks=ranks_arr,
+            ins_ids=(
+                [block.ins_ids[i] for i in ids]
+                if block.ins_ids is not None
+                else None
+            ),
         )

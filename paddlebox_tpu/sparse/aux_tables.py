@@ -1,0 +1,112 @@
+"""Auxiliary lookup tables: InputTable and ReplicaCache.
+
+TPU-native equivalents of two small BoxPS side stores:
+
+  * ``InputTable`` (reference: box_wrapper.h:188-248 + the ``lookup_input``
+    op and InputTableDataset/Feed, data_set.h:476-485) — a host-side
+    string-key -> dense-row table.  The reference resolves string keys to
+    row ids at feed time and gathers rows on device; here ``lookup_idx``
+    happens host-side during batch assembly and the device does one
+    ``jnp.take`` from the (replicated) row matrix.
+  * ``ReplicaCache`` (reference: GpuReplicaCache box_wrapper.h:140-186 +
+    ``pull_cache_value`` op) — a small dense embedding table replicated
+    into every chip's HBM, indexed by int ids that arrive as feature
+    values.
+
+Both are deliberately dumb: numpy on the host, one device array, no
+sharding — they exist for small side data (ad metadata, position vectors),
+not the main sparse table.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class InputTable:
+    """String key -> dense float row; unknown keys read the zero row 0."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self._index: dict[str, int] = {}
+        self._rows: list[np.ndarray] = [np.zeros(dim, dtype=np.float32)]
+        self._device: Optional[jax.Array] = None
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def add_row(self, key: str, row) -> int:
+        row = np.asarray(row, dtype=np.float32)
+        if row.shape != (self.dim,):
+            raise ValueError(f"row must have shape ({self.dim},), got {row.shape}")
+        if key in self._index:
+            self._rows[self._index[key]] = row
+        else:
+            self._index[key] = len(self._rows)
+            self._rows.append(row)
+        self._device = None  # invalidate
+        return self._index[key]
+
+    def lookup_idx(self, keys: Iterable[str]) -> np.ndarray:
+        """Host-side key resolution (the feed-time half of lookup_input)."""
+        return np.asarray(
+            [self._index.get(k, 0) for k in keys], dtype=np.int32
+        )
+
+    def rows_device(self) -> jax.Array:
+        """The [n, dim] row matrix as a device constant for jitted gathers."""
+        if self._device is None:
+            self._device = jnp.asarray(np.stack(self._rows))
+        return self._device
+
+    def lookup_rows(self, keys: Iterable[str]) -> np.ndarray:
+        """Convenience host-side gather: [len(keys), dim]."""
+        idx = self.lookup_idx(keys)
+        return np.stack(self._rows)[idx]
+
+    def state_dict(self) -> dict:
+        return {
+            "keys": np.asarray(list(self._index.keys()), dtype=np.str_),
+            "ids": np.asarray(list(self._index.values()), dtype=np.int64),
+            "rows": np.stack(self._rows),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        rows = np.asarray(state["rows"], dtype=np.float32)
+        self._rows = [rows[i] for i in range(rows.shape[0])]
+        self._index = {
+            str(k): int(i) for k, i in zip(state["keys"], state["ids"])
+        }
+        self._device = None
+
+
+def pull_cache_value(cache_values: jax.Array, ids: jax.Array) -> jax.Array:
+    """Jittable replica-cache gather (reference: pull_cache_value op) —
+    out-of-range ids clamp to row 0 (the zero/default row)."""
+    n = cache_values.shape[0]
+    safe = jnp.where((ids >= 0) & (ids < n), ids, 0)
+    return jnp.take(cache_values, safe, axis=0)
+
+
+class ReplicaCache:
+    """Small dense table replicated to every device (GpuReplicaCache)."""
+
+    def __init__(self, matrix):
+        m = np.asarray(matrix, dtype=np.float32)
+        if m.ndim != 2:
+            raise ValueError("ReplicaCache needs a 2-D [n, dim] matrix")
+        # row 0 is reserved as the default/zero row for bad ids
+        self._host = np.concatenate([np.zeros((1, m.shape[1]), np.float32), m])
+        self.values = jnp.asarray(self._host)
+
+    @property
+    def n_rows(self) -> int:
+        return self._host.shape[0] - 1
+
+    def pull(self, ids) -> jax.Array:
+        """ids are 1-based into the caller's matrix (0 -> default row)."""
+        return pull_cache_value(self.values, jnp.asarray(ids))

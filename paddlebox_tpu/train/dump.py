@@ -1,0 +1,113 @@
+"""Instance/field and parameter dumping.
+
+TPU-native equivalent of the reference's dump subsystem (reference:
+``TrainerBase::DumpWork`` trainer.h, ``DeviceWorker::DumpField/DumpParam``
+device_worker.cc, wired through trainer_desc dump_fields/dump_param and
+BoxPSTrainer's dump threads boxps_trainer.cc:96-108): per-instance text
+lines written by a background writer thread (the channel-writer discipline),
+and post-pass parameter snapshots.
+
+Line format (one per real instance):
+    <ins_id>\t<label>\t<pred>[\t<name>:<value>...]
+where extra columns come from ``fields`` — any of "task_labels", "cmatch",
+"rank", "dense".
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class FieldDumper:
+    """Background text dumper for per-instance training outputs."""
+
+    def __init__(self, path: str, fields: Sequence[str] = ()):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.fields = tuple(fields)
+        self._q: queue.Queue = queue.Queue(maxsize=64)
+        self._fh = open(path, "w")
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._writer, daemon=True)
+        self._thread.start()
+        self.n_dumped = 0
+
+    def _writer(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self._error is not None:
+                continue  # drain so producers never block after a failure
+            try:
+                self._fh.write(item)
+            except Exception as e:  # disk full / quota: surface on next call
+                self._error = e
+
+    def dump_batch(self, batch, preds: np.ndarray) -> None:
+        """Queue one batch's real instances (padding rows skipped)."""
+        if self._error is not None:
+            raise RuntimeError(f"field dump to {self.path} failed") from self._error
+        n = batch.n_real_ins
+        preds = np.asarray(preds)
+        lines = []
+        for i in range(n):
+            ins_id = (
+                batch.ins_ids[i]
+                if batch.ins_ids
+                else str(self.n_dumped + i)
+            )
+            cols = [ins_id, f"{batch.labels[i]:.0f}", f"{preds[i]:.6f}"]
+            for f in self.fields:
+                if f == "task_labels" and batch.task_labels is not None:
+                    cols.append(
+                        "task_labels:"
+                        + ",".join(f"{v:.0f}" for v in batch.task_labels[i])
+                    )
+                elif f == "cmatch" and batch.cmatches is not None:
+                    cols.append(f"cmatch:{batch.cmatches[i]}")
+                elif f == "rank" and batch.ranks is not None:
+                    cols.append(f"rank:{batch.ranks[i]}")
+                elif f == "dense":
+                    cols.append(
+                        "dense:" + ",".join(f"{v:.6g}" for v in batch.dense[i])
+                    )
+            lines.append("\t".join(cols))
+        self.n_dumped += n
+        if lines:
+            self._q.put("\n".join(lines) + "\n")
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=60)
+        if self._thread.is_alive():
+            raise RuntimeError(
+                f"field dump writer for {self.path} did not drain in time"
+            )
+        self._fh.close()
+        if self._error is not None:
+            raise RuntimeError(f"field dump to {self.path} failed") from self._error
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def dump_params(path: str, params, table=None) -> None:
+    """Post-pass parameter dump (reference: DumpParam + BoxPSTrainer::
+    DumpParameters boxps_trainer.cc:123-131): dense pytree as npz, plus the
+    sparse host store when a table is given."""
+    from paddlebox_tpu.checkpoint import save_pytree
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    save_pytree(path + ".dense.npz", params)
+    if table is not None:
+        state = table.state_dict()
+        np.savez(path + ".sparse.npz", keys=state["keys"], values=state["values"])

@@ -14,6 +14,7 @@ inside a step.  Host work per batch is only the numpy key->row planning
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Iterable, Optional
 
 import jax
@@ -94,6 +95,7 @@ class Trainer:
         self.params = model.init(jax.random.PRNGKey(seed))
         self.opt_state = self.optimizer.init(self.params)
         self._step_fn = None
+        self._eval_fn = None
         self.global_step = 0
 
     # -- the fused step ---------------------------------------------------- #
@@ -164,7 +166,7 @@ class Trainer:
                 finite &= jnp.isfinite(row_grads).all()
             else:
                 finite = jnp.array(True)
-            return params, opt_state, values, g2sum, mstate, loss, finite
+            return params, opt_state, values, g2sum, mstate, loss, finite, primary
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
 
@@ -224,6 +226,16 @@ class Trainer:
         values, g2sum = table.values, table.g2sum
         losses, n_steps = [], 0
         uses_rank = getattr(self.model, "uses_rank_offset", False)
+        dumper = None
+        if self.conf.need_dump_field and self.conf.dump_fields_path:
+            from paddlebox_tpu.train.dump import FieldDumper
+
+            dumper = FieldDumper(
+                os.path.join(
+                    self.conf.dump_fields_path, f"dump-{self.global_step}.txt"
+                ),
+                self.conf.dump_fields,
+            )
         try:
             for batch in dataset.batches(drop_last=drop_last):
                 if uses_rank and batch.rank_offset is None:
@@ -249,7 +261,8 @@ class Trainer:
                 dev = _device_batch(batch, plan, batch.n_sparse_slots)
                 if self.metric_group is not None:
                     dev["metric_masks"] = jnp.asarray(self.metric_group.masks(batch))
-                (self.params, self.opt_state, values, g2sum, mstate, loss, finite) = (
+                (self.params, self.opt_state, values, g2sum, mstate, loss,
+                 finite, preds) = (
                     self._step_fn(self.params, self.opt_state, values, g2sum, mstate, dev)
                 )
                 if self.conf.check_nan_inf and not bool(finite):
@@ -257,6 +270,8 @@ class Trainer:
                         f"non-finite loss/grad at step {self.global_step} "
                         "(FLAGS_check_nan_inf analog)"
                     )
+                if dumper is not None:
+                    dumper.dump_batch(batch, np.asarray(preds))
                 losses.append(loss)  # device scalars; synced once at pass end
                 n_steps += 1
                 self.global_step += 1
@@ -264,6 +279,17 @@ class Trainer:
             # old buffers were donated to the jitted step: always hand the
             # live ones back so end_pass() works even after a NaN raise
             table.values, table.g2sum = values, g2sum
+            if dumper is not None:
+                dumper.close()
+        if self.conf.need_dump_param and self.conf.dump_fields_path:
+            from paddlebox_tpu.train.dump import dump_params
+
+            dump_params(
+                os.path.join(
+                    self.conf.dump_fields_path, f"param-{self.global_step}"
+                ),
+                self.params,
+            )
         metrics = compute_metrics(mstate["auc"])
         if self.n_tasks > 1:
             metrics.update(
@@ -278,6 +304,49 @@ class Trainer:
         self.last_auc_state = mstate["auc"]
         self.last_metric_state = mstate
         return metrics
+
+    # -- inference / evaluation -------------------------------------------- #
+    def _build_eval_step(self):
+        model = self.model
+        tconf = self.table_conf
+        uses_rank = getattr(model, "uses_rank_offset", False)
+        n_tasks = self.n_tasks
+
+        def step(params, values, auc, batch):
+            rows = pull_rows(
+                values, batch["idx"],
+                create_threshold=tconf.create_threshold,
+                cvm_offset=tconf.cvm_offset,
+            )
+            bsz = batch["labels"].shape[0]
+            extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
+            logits = model.apply(
+                params, rows, batch["key_segments"], batch["dense"], bsz, **extra
+            )
+            preds = jax.nn.sigmoid(logits[:, 0] if n_tasks > 1 else logits)
+            auc = update_auc_state(auc, preds, batch["labels"], batch["ins_mask"])
+            return auc
+
+        return jax.jit(step, donate_argnums=(2,))
+
+    def evaluate(self, dataset, table: SparseTable, drop_last: bool = False) -> dict:
+        """Forward-only pass: no table/param updates, streaming AUC only —
+        the ``infer_from_dataset`` analog (reference: executor.py:1520
+        infer_from_dataset; BoxPS SetTestMode).  Requires an open pass."""
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_step()
+        uses_rank = getattr(self.model, "uses_rank_offset", False)
+        auc = init_auc_state(self.conf.auc_buckets)
+        for batch in dataset.batches(drop_last=drop_last):
+            if uses_rank and batch.rank_offset is None:
+                raise RuntimeError(
+                    "model requires PV-merged batches with rank_offset: "
+                    "set enable_pv_merge and call dataset.preprocess_instance()"
+                )
+            plan = table.plan_batch(batch)
+            dev = _device_batch(batch, plan, batch.n_sparse_slots)
+            auc = self._eval_fn(self.params, table.values, auc, dev)
+        return compute_metrics(auc)
 
     def train_steps(self, table: SparseTable, batches: Iterable[HostBatch]) -> dict:
         """Lower-level entry: train over an explicit batch iterable."""
