@@ -240,3 +240,8 @@ class TrainerConfig:
     need_dump_param: bool = False
     # nan check after each batch (reference: FLAGS_check_nan_inf)
     check_nan_inf: bool = False
+    # per-stage host timing (reference: TrainFilesWithProfiler — a slower
+    # diagnostic mode: the device step is synchronized every batch)
+    profile: bool = False
+    # jax.profiler trace dir for one-pass device timeline capture ("" = off)
+    trace_dir: str = ""

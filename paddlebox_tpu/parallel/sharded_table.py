@@ -139,6 +139,28 @@ class ShardedSparseTable(SparseTable):
         self._pass_row = None
         self._in_pass = False
 
+    def pass_state_dict(self) -> dict:
+        """Mid-pass snapshot over the stacked [n_shards, cap, W] layout."""
+        if not self._in_pass:
+            return self.state_dict()
+        vals = np.asarray(self.values)
+        g2 = np.asarray(self.g2sum)
+        keys, rows = [], []
+        for o, sk in enumerate(self._shard_keys):
+            m = sk.shape[0]
+            if m:
+                keys.append(sk)
+                rows.append(np.concatenate([vals[o, :m], g2[o, :m, None]], axis=1))
+        if not keys:
+            return {
+                "keys": np.empty(0, np.uint64),
+                "values": np.empty((0, self.conf.row_width + 1), np.float32),
+            }
+        k = np.concatenate(keys)
+        v = np.concatenate(rows)
+        order = np.argsort(k)
+        return {"keys": k[order], "values": v[order]}
+
     # -- planning --------------------------------------------------------- #
     @property
     def shard_capacity(self) -> int:

@@ -43,10 +43,12 @@ from paddlebox_tpu.data.feed import HostBatch, empty_like
 from paddlebox_tpu.metrics.auc import (
     AucState,
     compute_metrics,
+    compute_metrics_stacked,
     init_auc_state,
     stack_auc_states,
     update_auc_state,
 )
+from paddlebox_tpu.metrics.variants import MetricGroup
 from paddlebox_tpu.models.layers import bce_with_logits
 from paddlebox_tpu.parallel.mesh import DATA_AXIS
 from paddlebox_tpu.parallel.sharded_table import ShardedBatchPlan, ShardedSparseTable
@@ -56,7 +58,10 @@ shard_map = jax.shard_map
 
 
 def _stack_group(
-    batches: Sequence[HostBatch], plan: ShardedBatchPlan, n_slots: int
+    batches: Sequence[HostBatch],
+    plan: ShardedBatchPlan,
+    n_slots: int,
+    metric_group: Optional[MetricGroup] = None,
 ) -> dict:
     """Stack per-device batches + plan into [D, ...] arrays (numpy)."""
     key_clicks = []
@@ -66,6 +71,12 @@ def _stack_group(
     extra = {}
     if batches[0].rank_offset is not None:
         extra["rank_offset"] = np.stack([b.rank_offset for b in batches])
+    if batches[0].task_labels is not None:
+        extra["task_labels"] = np.stack([b.task_labels for b in batches])
+    if metric_group is not None:
+        extra["metric_masks"] = np.stack(
+            [metric_group.masks(b) for b in batches]
+        )
     return {
         **extra,
         "serve_rows": plan.serve_rows,
@@ -173,12 +184,15 @@ class MultiChipTrainer:
         mesh: Mesh,
         trainer_conf: Optional[TrainerConfig] = None,
         seed: int = 0,
+        metric_group: Optional[MetricGroup] = None,
     ):
         self.model = model
         self.table_conf = table_conf
         self.mesh = mesh
         self.n_dev = int(mesh.devices.size)
         self.conf = trainer_conf or TrainerConfig()
+        self.metric_group = metric_group
+        self.n_tasks = getattr(model, "n_tasks", 1)
         if self.conf.dense_optimizer == "adam":
             self.optimizer = optax.adam(self.conf.dense_lr)
         elif self.conf.dense_optimizer == "sgd":
@@ -199,6 +213,7 @@ class MultiChipTrainer:
         self.opt_state = stack(o0)
         self._step_fn = None
         self._sync_fn = None
+        self._eval_fn = None
         self.global_step = 0
 
     # -- jitted bodies ----------------------------------------------------- #
@@ -210,11 +225,14 @@ class MultiChipTrainer:
         sync_step = conf.sync_dense_mode == "step"
         check_nan = conf.check_nan_inf
         uses_rank = getattr(model, "uses_rank_offset", False)
+        n_tasks = self.n_tasks
+        has_group = self.metric_group is not None
 
-        def body(params, opt_state, values, g2sum, auc, batch):
+        def body(params, opt_state, values, g2sum, mstate, batch):
             # local blocks all carry a leading device axis of size 1
             unstack = lambda t: jax.tree.map(lambda x: x[0], t)
-            params, opt_state, auc = unstack(params), unstack(opt_state), unstack(auc)
+            params, opt_state = unstack(params), unstack(opt_state)
+            mstate = unstack(mstate)
             values, g2sum = values[0], g2sum[0]
             batch = unstack(batch)
 
@@ -229,8 +247,15 @@ class MultiChipTrainer:
                 logits = model.apply(
                     p, r, batch["key_segments"], batch["dense"], bsz, **extra
                 )
-                per_ins = bce_with_logits(logits, batch["labels"]) * batch["ins_mask"]
-                local_cnt = batch["ins_mask"].sum()
+                mask = batch["ins_mask"]
+                if n_tasks > 1:
+                    per_ins = (
+                        bce_with_logits(logits, batch["task_labels"]).mean(axis=1)
+                        * mask
+                    )
+                else:
+                    per_ins = bce_with_logits(logits, batch["labels"]) * mask
+                local_cnt = mask.sum()
                 if sync_step:
                     denom = jnp.maximum(jax.lax.psum(local_cnt, DATA_AXIS), 1.0)
                 else:
@@ -250,7 +275,22 @@ class MultiChipTrainer:
                 values, g2sum, row_grads, batch["occ_flat"], batch["serve_map"],
                 batch["serve_uniq"], batch["key_mask"], batch["key_clicks"], tconf,
             )
-            auc = update_auc_state(auc, preds, batch["labels"], batch["ins_mask"])
+            primary = preds[:, 0] if n_tasks > 1 else preds
+            mstate = dict(mstate)
+            mstate["auc"] = update_auc_state(
+                mstate["auc"], primary, batch["labels"], batch["ins_mask"]
+            )
+            if n_tasks > 1:
+                mstate["task"] = jax.vmap(
+                    lambda s, pr, lb: update_auc_state(
+                        s, pr, lb, batch["ins_mask"]
+                    )
+                )(mstate["task"], preds.T, batch["task_labels"].T)
+            if has_group:
+                mstate["group"] = MetricGroup.update(
+                    mstate["group"], primary, batch["labels"],
+                    batch["metric_masks"],
+                )
             if check_nan:
                 finite = jnp.isfinite(loss)
                 for leaf in jax.tree.leaves(pgrads):
@@ -262,7 +302,7 @@ class MultiChipTrainer:
             cnt = batch["ins_mask"].sum()
             return (
                 restack(params), restack(opt_state), values[None], g2sum[None],
-                restack(auc), loss[None], cnt[None], finite[None],
+                restack(mstate), loss[None], cnt[None], finite[None],
             )
 
         spec = P(DATA_AXIS)
@@ -317,6 +357,32 @@ class MultiChipTrainer:
             self._sharding,
         )
 
+    def _init_mstate(self, auc_state=None) -> dict:
+        """Per-device metric streams, each leaf stacked [n_dev, ...] and
+        mesh-sharded (merged by summing over devices at read time)."""
+        if isinstance(auc_state, dict):
+            return auc_state
+        if auc_state is not None and (self.n_tasks > 1 or self.metric_group):
+            raise ValueError(
+                "pass trainer.last_metric_state (dict) to continue metrics "
+                "across passes — a bare AucState would reset the task/group "
+                "streams while continuing the primary one"
+            )
+        mstate = {"auc": auc_state if auc_state is not None else self.init_auc()}
+        if self.n_tasks > 1:
+            base = stack_auc_states(
+                init_auc_state(self.conf.auc_buckets), self.n_tasks
+            )
+            mstate["task"] = jax.device_put(
+                stack_auc_states(base, self.n_dev), self._sharding
+            )
+        if self.metric_group is not None:
+            mstate["group"] = jax.device_put(
+                stack_auc_states(self.metric_group.init_state(), self.n_dev),
+                self._sharding,
+            )
+        return mstate
+
     def train_from_dataset(
         self,
         dataset,
@@ -341,7 +407,7 @@ class MultiChipTrainer:
             self._step_fn = self._build_step()
         if self._sync_fn is None and self.conf.sync_dense_mode == "kstep":
             self._sync_fn = self._build_sync()
-        auc = auc_state if auc_state is not None else self.init_auc()
+        mstate = self._init_mstate(auc_state)
         values, g2sum = table.values, table.g2sum
         losses, counts, n_steps = [], [], 0
         n_slots = None
@@ -355,11 +421,25 @@ class MultiChipTrainer:
                         "model requires PV-merged batches with rank_offset: "
                         "set enable_pv_merge and call dataset.preprocess_instance()"
                     )
+                if self.n_tasks > 1 and (
+                    group[0].task_labels is None
+                    or group[0].task_labels.shape[1] != self.n_tasks
+                ):
+                    got = (
+                        0 if group[0].task_labels is None
+                        else group[0].task_labels.shape[1]
+                    )
+                    raise RuntimeError(
+                        f"model has {self.n_tasks} tasks but the batch carries "
+                        f"{got} task label columns: configure "
+                        "DataFeedConfig.task_label_slots with "
+                        f"{self.n_tasks - 1} slots (task 0 is the primary label)"
+                    )
                 plan = table.plan_group(group)
-                feed = _stack_group(group, plan, n_slots)
+                feed = _stack_group(group, plan, n_slots, self.metric_group)
                 feed = jax.device_put(feed, self._sharding)
-                (self.params, self.opt_state, values, g2sum, auc, loss, cnt, finite) = (
-                    self._step_fn(self.params, self.opt_state, values, g2sum, auc, feed)
+                (self.params, self.opt_state, values, g2sum, mstate, loss, cnt, finite) = (
+                    self._step_fn(self.params, self.opt_state, values, g2sum, mstate, feed)
                 )
                 if self.conf.check_nan_inf and not bool(np.asarray(finite).all()):
                     raise FloatingPointError(
@@ -382,8 +462,23 @@ class MultiChipTrainer:
             # hand the live ones back so end_pass() can salvage the pass even
             # when check_nan_inf raises mid-loop
             table.values, table.g2sum = values, g2sum
-        merged = jax.tree.map(lambda x: np.asarray(x).sum(0), auc)
+        # cross-device merge: sum each stream's histograms over the device axis
+        merged = jax.tree.map(lambda x: np.asarray(x).sum(0), mstate["auc"])
         metrics = compute_metrics(merged)
+        if self.n_tasks > 1:
+            task_merged = jax.tree.map(
+                lambda x: np.asarray(x).sum(0), mstate["task"]
+            )
+            metrics.update(
+                compute_metrics_stacked(
+                    task_merged, [f"task{t}" for t in range(self.n_tasks)]
+                )
+            )
+        if self.metric_group is not None:
+            group_merged = jax.tree.map(
+                lambda x: np.asarray(x).sum(0), mstate["group"]
+            )
+            metrics.update(self.metric_group.compute(group_merged))
         if losses:
             per_step = np.stack([np.asarray(l) for l in losses])  # [T, D]
             if self.conf.sync_dense_mode == "kstep":
@@ -401,8 +496,63 @@ class MultiChipTrainer:
         metrics["steps"] = n_steps
         metrics["missing_keys"] = table.missing_key_count
         metrics["overflow_keys"] = table.overflow_key_count
-        self.last_auc_state = auc
+        self.last_auc_state = mstate["auc"]
+        self.last_metric_state = mstate
         return metrics
+
+    # -- inference / evaluation -------------------------------------------- #
+    def _build_eval(self):
+        model = self.model
+        tconf = self.table_conf
+        uses_rank = getattr(model, "uses_rank_offset", False)
+        n_tasks = self.n_tasks
+
+        def body(params, values, auc, batch):
+            unstack = lambda t: jax.tree.map(lambda x: x[0], t)
+            params, auc, batch = unstack(params), unstack(auc), unstack(batch)
+            values = values[0]
+            rows = sharded_pull(
+                values, batch["serve_rows"], batch["occ_flat"],
+                tconf.create_threshold, tconf.cvm_offset,
+            )
+            bsz = batch["labels"].shape[0]
+            extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
+            logits = model.apply(
+                params, rows, batch["key_segments"], batch["dense"], bsz, **extra
+            )
+            preds = jax.nn.sigmoid(logits[:, 0] if n_tasks > 1 else logits)
+            auc = update_auc_state(auc, preds, batch["labels"], batch["ins_mask"])
+            return jax.tree.map(lambda x: x[None], auc)
+
+        spec = P(DATA_AXIS)
+        mapped = shard_map(
+            body, mesh=self.mesh, in_specs=(spec,) * 4, out_specs=spec
+        )
+        return jax.jit(mapped, donate_argnums=(2,))
+
+    def evaluate(self, dataset, table: ShardedSparseTable,
+                 drop_last: bool = False) -> dict:
+        """Forward-only multi-chip pass (infer_from_dataset analog): no
+        table/param updates, per-device AUC merged at the end."""
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval()
+        uses_rank = getattr(self.model, "uses_rank_offset", False)
+        auc = self.init_auc()
+        n_slots = None
+        for group in _group_batches(dataset.batches(drop_last=drop_last), self.n_dev):
+            if n_slots is None:
+                n_slots = group[0].n_sparse_slots
+            if uses_rank and group[0].rank_offset is None:
+                raise RuntimeError(
+                    "model requires PV-merged batches with rank_offset: "
+                    "set enable_pv_merge and call dataset.preprocess_instance()"
+                )
+            plan = table.plan_group(group)
+            feed = _stack_group(group, plan, n_slots)
+            feed = jax.device_put(feed, self._sharding)
+            auc = self._eval_fn(self.params, table.values, auc, feed)
+        merged = jax.tree.map(lambda x: np.asarray(x).sum(0), auc)
+        return compute_metrics(merged)
 
 
 def _group_batches(

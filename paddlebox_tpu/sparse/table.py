@@ -258,6 +258,17 @@ class SparseTable:
         self._store_keys = np.asarray(state["keys"], dtype=np.uint64)
         self._store_vals = np.asarray(state["values"], dtype=np.float32)
 
+    def pass_state_dict(self) -> dict:
+        """Snapshot usable mid-pass: the live working set when a pass is
+        open (for in-pass dump_param), the host store otherwise."""
+        if not self._in_pass:
+            return self.state_dict()
+        n = self._pass_keys.shape[0]
+        vals = np.concatenate(
+            [np.asarray(self.values), np.asarray(self.g2sum)[:, None]], axis=1
+        )[:n]
+        return {"keys": self._pass_keys, "values": vals}
+
     def delta_state_dict(self) -> dict:
         """Rows touched since the last pop — SaveDelta's xbox-delta analog
         (reference: box_wrapper.cc:1411-1460)."""

@@ -24,17 +24,27 @@ import numpy as np
 from paddlebox_tpu.data.record import RecordBlock
 
 
-def build_candidate_pools(block: RecordBlock, max_pool: int = 100_000,
-                          seed: int = 0) -> list[np.ndarray]:
+def key_slot_map(block: RecordBlock) -> np.ndarray:
+    """[n_keys] slot index of every key occurrence (computed once per block
+    and shared by pool building and replacement)."""
+    lens = np.diff(block.key_offsets)
+    slot_of_row = np.tile(np.arange(block.n_sparse_slots), block.n_ins)
+    return np.repeat(slot_of_row, lens)
+
+
+def build_candidate_pools(
+    block: RecordBlock,
+    max_pool: int = 100_000,
+    seed: int = 0,
+    key_slots: Optional[np.ndarray] = None,
+) -> list[np.ndarray]:
     """Per-slot pools of observed feasign values (reservoir-capped at
     max_pool, reference FLAGS_padbox_slot_feasign_max_num analog)."""
     rng = np.random.default_rng(seed)
-    s = block.n_sparse_slots
+    if key_slots is None:
+        key_slots = key_slot_map(block)
     pools = []
-    lens = np.diff(block.key_offsets)
-    slot_of_row = np.tile(np.arange(s), block.n_ins)
-    key_slots = np.repeat(slot_of_row, lens)
-    for si in range(s):
+    for si in range(block.n_sparse_slots):
         vals = block.keys[key_slots == si]
         if vals.shape[0] > max_pool:
             vals = rng.choice(vals, size=max_pool, replace=False)
@@ -47,15 +57,15 @@ def replace_slots(
     slot_idxs: Sequence[int],
     pools: Sequence[np.ndarray],
     seed: int = 0,
+    key_slots: Optional[np.ndarray] = None,
 ) -> RecordBlock:
     """New block with the given slots' values redrawn from their pools
     (counts per instance preserved; all other slots untouched)."""
     rng = np.random.default_rng(seed)
     s = block.n_sparse_slots
     keys = block.keys.copy()
-    lens = np.diff(block.key_offsets)
-    slot_of_row = np.tile(np.arange(s), block.n_ins)
-    key_slots = np.repeat(slot_of_row, lens)
+    if key_slots is None:
+        key_slots = key_slot_map(block)
     for si in slot_idxs:
         m = key_slots == si
         n = int(m.sum())
@@ -101,7 +111,10 @@ class AucRunner:
         if block is None:
             raise RuntimeError("load the dataset before running AUC runner")
         names = [s.name for s in dataset.conf.sparse_slots()]
-        pools = build_candidate_pools(block, self.max_pool, self.seed)
+        key_slots = key_slot_map(block)
+        pools = build_candidate_pools(
+            block, self.max_pool, self.seed, key_slots=key_slots
+        )
 
         def eval_current() -> dict:
             self.table.begin_pass(dataset.unique_keys())
@@ -115,7 +128,9 @@ class AucRunner:
         out = {"baseline": baseline}
         for gname, slots in slot_groups.items():
             idxs = [names.index(n) for n in slots]
-            dataset._block = replace_slots(block, idxs, pools, self.seed)
+            dataset._block = replace_slots(
+                block, idxs, pools, self.seed, key_slots=key_slots
+            )
             try:
                 m = eval_current()
             finally:

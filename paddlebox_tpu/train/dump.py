@@ -45,23 +45,19 @@ class FieldDumper:
             if self._error is not None:
                 continue  # drain so producers never block after a failure
             try:
-                self._fh.write(item)
+                self._fh.write(self._format(*item))
             except Exception as e:  # disk full / quota: surface on next call
                 self._error = e
 
-    def dump_batch(self, batch, preds: np.ndarray) -> None:
-        """Queue one batch's real instances (padding rows skipped)."""
-        if self._error is not None:
-            raise RuntimeError(f"field dump to {self.path} failed") from self._error
+    def _format(self, batch, preds: np.ndarray, base: int) -> str:
+        """Per-instance text formatting — runs on the writer thread so the
+        training loop stays numpy-only (the reference's channel-writer
+        threads do the serialization off the train thread for the same
+        reason, boxps_trainer.cc:96-108)."""
         n = batch.n_real_ins
-        preds = np.asarray(preds)
         lines = []
         for i in range(n):
-            ins_id = (
-                batch.ins_ids[i]
-                if batch.ins_ids
-                else str(self.n_dumped + i)
-            )
+            ins_id = batch.ins_ids[i] if batch.ins_ids else str(base + i)
             cols = [ins_id, f"{batch.labels[i]:.0f}", f"{preds[i]:.6f}"]
             for f in self.fields:
                 if f == "task_labels" and batch.task_labels is not None:
@@ -78,9 +74,16 @@ class FieldDumper:
                         "dense:" + ",".join(f"{v:.6g}" for v in batch.dense[i])
                     )
             lines.append("\t".join(cols))
-        self.n_dumped += n
-        if lines:
-            self._q.put("\n".join(lines) + "\n")
+        return "\n".join(lines) + "\n" if lines else ""
+
+    def dump_batch(self, batch, preds: np.ndarray) -> None:
+        """Queue one batch's real instances (padding rows skipped).  The
+        batch's arrays must not be mutated afterwards (HostBatches are
+        rebuilt per batch, so this holds)."""
+        if self._error is not None:
+            raise RuntimeError(f"field dump to {self.path} failed") from self._error
+        self._q.put((batch, np.asarray(preds), self.n_dumped))
+        self.n_dumped += batch.n_real_ins
 
     def close(self) -> None:
         self._q.put(None)
@@ -100,14 +103,19 @@ class FieldDumper:
         self.close()
 
 
-def dump_params(path: str, params, table=None) -> None:
+def dump_params(path: str, params, table=None, select: Sequence[str] = ()) -> None:
     """Post-pass parameter dump (reference: DumpParam + BoxPSTrainer::
     DumpParameters boxps_trainer.cc:123-131): dense pytree as npz, plus the
-    sparse host store when a table is given."""
-    from paddlebox_tpu.checkpoint import save_pytree
+    sparse host store when a table is given.  ``select`` filters dense
+    leaves by tree-path substring (the dump_param name list analog); empty
+    dumps everything."""
+    from paddlebox_tpu.checkpoint import _flatten_paths
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    save_pytree(path + ".dense.npz", params)
+    flat = _flatten_paths(params)
+    if select:
+        flat = {k: v for k, v in flat.items() if any(s in k for s in select)}
+    np.savez(path + ".dense.npz", **flat)
     if table is not None:
-        state = table.state_dict()
+        state = table.pass_state_dict()  # mid-pass safe
         np.savez(path + ".sparse.npz", keys=state["keys"], values=state["values"])

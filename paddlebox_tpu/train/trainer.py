@@ -236,7 +236,16 @@ class Trainer:
                 ),
                 self.conf.dump_fields,
             )
+        from paddlebox_tpu.utils.profiler import (
+            NullProfiler,
+            StepProfiler,
+            device_trace,
+        )
+
+        prof = StepProfiler() if self.conf.profile else NullProfiler()
+
         try:
+          with device_trace(self.conf.trace_dir or None):
             for batch in dataset.batches(drop_last=drop_last):
                 if uses_rank and batch.rank_offset is None:
                     raise RuntimeError(
@@ -257,21 +266,31 @@ class Trainer:
                         "DataFeedConfig.task_label_slots with "
                         f"{self.n_tasks - 1} slots (task 0 is the primary label)"
                     )
-                plan = table.plan_batch(batch)
-                dev = _device_batch(batch, plan, batch.n_sparse_slots)
-                if self.metric_group is not None:
-                    dev["metric_masks"] = jnp.asarray(self.metric_group.masks(batch))
-                (self.params, self.opt_state, values, g2sum, mstate, loss,
-                 finite, preds) = (
-                    self._step_fn(self.params, self.opt_state, values, g2sum, mstate, dev)
-                )
+                with prof.stage("plan"):
+                    plan = table.plan_batch(batch)
+                with prof.stage("feed"):
+                    dev = _device_batch(batch, plan, batch.n_sparse_slots)
+                    if self.metric_group is not None:
+                        dev["metric_masks"] = jnp.asarray(
+                            self.metric_group.masks(batch)
+                        )
+                with prof.stage("step"):
+                    (self.params, self.opt_state, values, g2sum, mstate,
+                     loss, finite, preds) = (
+                        self._step_fn(self.params, self.opt_state, values,
+                                      g2sum, mstate, dev)
+                    )
+                    if prof.enabled:
+                        loss.block_until_ready()  # sync for honest timing
+                prof.step_done()
                 if self.conf.check_nan_inf and not bool(finite):
                     raise FloatingPointError(
                         f"non-finite loss/grad at step {self.global_step} "
                         "(FLAGS_check_nan_inf analog)"
                     )
                 if dumper is not None:
-                    dumper.dump_batch(batch, np.asarray(preds))
+                    with prof.stage("dump"):
+                        dumper.dump_batch(batch, np.asarray(preds))
                 losses.append(loss)  # device scalars; synced once at pass end
                 n_steps += 1
                 self.global_step += 1
@@ -289,6 +308,8 @@ class Trainer:
                     self.conf.dump_fields_path, f"param-{self.global_step}"
                 ),
                 self.params,
+                table=table,
+                select=self.conf.dump_param,
             )
         metrics = compute_metrics(mstate["auc"])
         if self.n_tasks > 1:
@@ -301,6 +322,9 @@ class Trainer:
             metrics.update(self.metric_group.compute(mstate["group"]))
         metrics["loss"] = float(jnp.stack(losses).mean()) if losses else 0.0
         metrics["steps"] = n_steps
+        if prof.enabled:
+            metrics["profile"] = prof.report()
+            print("[profile]", prof.log_line())
         self.last_auc_state = mstate["auc"]
         self.last_metric_state = mstate
         return metrics

@@ -1,0 +1,94 @@
+"""Per-step host/device timing breakdown + device trace capture.
+
+TPU-native replacement for the reference's two profiling surfaces
+(SURVEY.md §5.1):
+
+  * hand-rolled hot-path timers — per-device pull/push/nccl timers printed
+    by ``PrintSyncTimer`` (box_wrapper.h:375-391) and per-op wall timing in
+    ``BoxPSWorker::TrainFilesWithProfiler`` (boxps_worker.cc:657-760).
+    Here the jitted step is one fused program, so the meaningful split is
+    host stages (plan / feed assembly / device step / dump), which
+    ``StepProfiler`` accumulates per pass and reports like the reference's
+    ``log_for_profile`` lines.
+  * the framework profiler / CUPTI timeline (platform/profiler.cc,
+    device_tracer.cc) — subsumed by ``jax.profiler``: ``device_trace``
+    wraps a pass in a trace whose xplane dump is viewable in TensorBoard /
+    Perfetto, giving per-fusion device timing XLA-side.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+from paddlebox_tpu.utils.timer import Timer
+
+
+class NullProfiler:
+    """No-op stand-in so the train loop has ONE body regardless of
+    profiling (the two modes must never diverge behaviorally)."""
+
+    enabled = False
+
+    def stage(self, name: str):
+        return contextlib.nullcontext()
+
+    def step_done(self) -> None:
+        pass
+
+
+class StepProfiler:
+    """Named stage timers + step counter (TrainFilesWithProfiler analog)."""
+
+    STAGES = ("plan", "feed", "step", "dump")
+    enabled = True
+
+    def __init__(self):
+        self.timers = {s: Timer() for s in self.STAGES}
+        self.n_steps = 0
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t = self.timers[name]
+        t.resume()
+        try:
+            yield
+        finally:
+            t.pause()
+
+    def step_done(self) -> None:
+        self.n_steps += 1
+
+    def report(self) -> dict:
+        """Per-stage totals and means (seconds)."""
+        out = {"steps": self.n_steps}
+        for name, t in self.timers.items():
+            out[f"{name}_sec"] = t.elapsed_sec()
+            if self.n_steps:
+                out[f"{name}_ms_per_step"] = 1e3 * t.elapsed_sec() / self.n_steps
+        return out
+
+    def log_line(self) -> str:
+        """One-line summary (the reference's log_for_profile format spirit)."""
+        r = self.report()
+        parts = [f"steps={r['steps']}"]
+        for s in self.STAGES:
+            if f"{s}_ms_per_step" in r:
+                parts.append(f"{s}={r[f'{s}_ms_per_step']:.2f}ms")
+        return " ".join(parts)
+
+
+@contextlib.contextmanager
+def device_trace(logdir: Optional[str]) -> Iterator[None]:
+    """jax.profiler trace capture around a pass (None -> no-op).  View the
+    dump with TensorBoard's profile plugin or Perfetto."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

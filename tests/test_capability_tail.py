@@ -158,3 +158,19 @@ def test_disk_spill_roundtrip(tmp_path):
     ds.release_memory()
     assert not list((tmp_path / "spill").glob("*.bin"))
     ds.close()
+
+
+def test_profiler_report(tmp_path):
+    _, ds, trainer, table = _world(tmp_path)
+    ds.load_into_memory()
+    trainer.conf.profile = True
+    table.begin_pass(ds.unique_keys())
+    m = trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    prof = m["profile"]
+    assert prof["steps"] == m["steps"]
+    for stage in ("plan", "feed", "step"):
+        assert prof[f"{stage}_sec"] >= 0.0
+        assert f"{stage}_ms_per_step" in prof
+    assert prof["step_sec"] > 0.0
+    ds.close()
