@@ -1,0 +1,140 @@
+"""Pallas TPU kernels for the sparse-table hot ops (SURVEY.md §7 stage 4).
+
+The reference's equivalents are the closed-lib HBM hash lookup plus the
+pull/push CUDA copy kernels (reference: box_wrapper.cu:36-1034 PullCopy*/
+PushCopy*, behind PullSparseGPU/PushSparseGPU).  Here the table working set
+is a dense HBM array and the host has already resolved keys to row indices
+(sparse/table.py plan), so the device-side ops are:
+
+  * ``pallas_pull_rows(values, idx)``   — row gather: values[idx] with the
+    table kept in HBM and rows DMA'd to VMEM per grid tile, indices scalar-
+    prefetched so the DMA addresses are known before the tile body runs.
+  * ``pallas_scatter_add(values, idx, delta)`` — in-place row
+    read-modify-write accumulate (the push).  TPU grids execute
+    sequentially on a core, so duplicate indices (the dead padding row)
+    accumulate correctly without atomics — the ordering guarantee CUDA
+    needs atomics for.
+
+Enabled via ``flags.use_pallas_sparse`` (default off): XLA's native
+gather/scatter is already tuned for these shapes, so these kernels are the
+explicit-DMA variant to benchmark against it on real hardware; correctness
+is covered everywhere by interpret mode.  ``interpret=True`` is forced
+automatically off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TILE = 8  # rows gathered per grid step (f32 sublane tile)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _gather_kernel(idx_ref, values_ref, out_ref, scratch, sems):
+    """One grid step gathers _TILE rows: start all row DMAs, wait, emit."""
+    g = pl.program_id(0)
+    dmas = []
+    for i in range(_TILE):
+        row = idx_ref[g * _TILE + i]
+        dma = pltpu.make_async_copy(
+            values_ref.at[pl.ds(row, 1), :],
+            scratch.at[pl.ds(i, 1), :],
+            sems.at[i],
+        )
+        dma.start()
+        dmas.append(dma)
+    for dma in dmas:
+        dma.wait()
+    out_ref[:] = scratch[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_pull_rows(values: jax.Array, idx: jax.Array,
+                     interpret: bool = False) -> jax.Array:
+    """values: [P, W] (HBM); idx: int32 [K], K % _TILE == 0 (the host plan
+    pads key buffers to power-of-two capacities, so this holds).
+    Returns [K, W] — identical to ``jnp.take(values, idx, axis=0)``."""
+    k = idx.shape[0]
+    w = values.shape[1]
+    assert k % _TILE == 0, f"key capacity {k} not a multiple of {_TILE}"
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # idx is known before tile bodies run
+        grid=(k // _TILE,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # table stays in HBM
+        out_specs=pl.BlockSpec(
+            (_TILE, w), lambda g, idx: (g, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((_TILE, w), values.dtype),
+            pltpu.SemaphoreType.DMA((_TILE,)),
+        ],
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        out_shape=jax.ShapeDtypeStruct((k, w), values.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret or not _on_tpu(),
+    )(idx, values)
+
+
+def _scatter_kernel(idx_ref, delta_ref, values_ref, out_ref, row, sems):
+    """One grid step accumulates one delta row into its table row in HBM:
+    DMA row in -> add -> DMA row back.  Grid steps run sequentially, so
+    repeated indices (dead row) are safe read-modify-writes."""
+    g = pl.program_id(0)
+    r = idx_ref[g]
+    load = pltpu.make_async_copy(
+        values_ref.at[pl.ds(r, 1), :], row, sems.at[0]
+    )
+    load.start()
+    load.wait()
+    row[:] = row[:] + delta_ref[:]
+    store = pltpu.make_async_copy(
+        row, values_ref.at[pl.ds(r, 1), :], sems.at[1]
+    )
+    store.start()
+    store.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_scatter_add(values: jax.Array, idx: jax.Array, delta: jax.Array,
+                       interpret: bool = False) -> jax.Array:
+    """In-place ``values[idx] += delta`` (donating values via aliasing).
+
+    values: [P, W]; idx: int32 [U]; delta: [U, W].  Semantics identical to
+    ``values.at[idx].add(delta)`` including duplicate indices.
+    """
+    u = idx.shape[0]
+    w = values.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(u,),
+        in_specs=[
+            pl.BlockSpec((1, w), lambda g, idx: (g, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # table aliased in HBM
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((1, w), values.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        _scatter_kernel,
+        out_shape=jax.ShapeDtypeStruct(values.shape, values.dtype),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},  # (idx, delta, values) -> values out
+        interpret=interpret or not _on_tpu(),
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(idx, delta, values)
