@@ -80,11 +80,19 @@ inline bool parse_i64(const char* t, size_t n, int64_t* out) {
   size_t i = 0;
   if (t[0] == '-') { neg = true; i = 1; if (n == 1) return false; }
   uint64_t v = 0;
+  // reject magnitudes outside int64 instead of silently wrapping (the
+  // Python parser raises on the same input — parity on malformed data)
+  const uint64_t limit =
+      neg ? (static_cast<uint64_t>(INT64_MAX) + 1u)
+          : static_cast<uint64_t>(INT64_MAX);
   for (; i < n; ++i) {
     if (t[i] < '0' || t[i] > '9') return false;
-    v = v * 10u + static_cast<uint64_t>(t[i] - '0');
+    uint64_t d = static_cast<uint64_t>(t[i] - '0');
+    if (v > (limit - d) / 10u) return false;
+    v = v * 10u + d;
   }
-  *out = neg ? -static_cast<int64_t>(v) : static_cast<int64_t>(v);
+  // negate in unsigned: -static_cast<int64_t>(2^63) would be signed overflow
+  *out = static_cast<int64_t>(neg ? 0u - v : v);
   return true;
 }
 
@@ -150,7 +158,9 @@ void* pbx_parse_buffer(const char* data, int64_t len, const int8_t* kinds,
       int64_t rk, cm;
       if (!parse_u64(tok, static_cast<size_t>(c1 - tok), &sid) ||
           !parse_i64(c1 + 1, static_cast<size_t>(c2 - c1 - 1), &rk) ||
-          !parse_i64(c2 + 1, static_cast<size_t>(tok + tl - c2 - 1), &cm)) {
+          !parse_i64(c2 + 1, static_cast<size_t>(tok + tl - c2 - 1), &cm) ||
+          rk < INT32_MIN || rk > INT32_MAX || cm < INT32_MIN ||
+          cm > INT32_MAX) {
         set_err(err, errlen, lineno, "bad logkey"); delete r; return nullptr;
       }
       r->search_ids.push_back(sid);

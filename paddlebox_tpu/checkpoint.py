@@ -137,8 +137,18 @@ class CheckpointManager:
             json.dump(full_meta, fh)
         if self.n_shards == 1:
             if os.path.exists(dirname):
-                shutil.rmtree(dirname)
-            os.replace(tmp, dirname)
+                # keep the old checkpoint alive until the new one is in
+                # place: rename aside, swap in, then drop the old copy.  A
+                # crash between the two renames leaves only the .old dir;
+                # list_checkpoints() recovers it back to dirname on read.
+                old = dirname + ".old"
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                os.replace(dirname, old)
+                os.replace(tmp, dirname)
+                shutil.rmtree(old)
+            else:
+                os.replace(tmp, dirname)
         else:
             # shard files have disjoint names: create-if-absent then move each
             # file atomically, so concurrent shard saves never collide
@@ -194,6 +204,10 @@ class CheckpointManager:
                 if meta.get("shard", 0) != self.shard:
                     continue
                 dirname = os.path.join(self.root, f"{meta['kind']}-{meta['tag']}")
+                if not os.path.isdir(dirname) and os.path.isdir(dirname + ".old"):
+                    # crash landed between the overwrite swap's two renames:
+                    # the previous copy is intact under .old — restore it
+                    os.replace(dirname + ".old", dirname)
                 if os.path.isdir(dirname):
                     out.append(CheckpointInfo(meta["kind"], meta["tag"], dirname, meta))
         return out

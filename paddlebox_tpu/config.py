@@ -107,9 +107,11 @@ class DataFeedConfig:
     enable_pv_merge: bool = False
     rank_offset: str = ""  # name of the rank-offset tensor for rank_attention
     rank_offset_cols: int = 7  # reference: data_feed.cc max_rank 3 -> 7 cols
-    # cmatch codes whose instances participate in PV ranking; None = all
-    # (reference hard-codes ad channels {222, 223}, data_feed.cu:219)
-    rank_cmatch_filter: Optional[Sequence[int]] = None
+    # cmatch codes whose instances participate in PV ranking; None = all.
+    # Default matches the reference kernel, which hard-codes ad channels
+    # {222, 223} (data_feed.cu:219) — pass None explicitly to rank every
+    # cmatch code.
+    rank_cmatch_filter: Optional[Sequence[int]] = (222, 223)
     parse_ins_id: bool = False
     parse_logkey: bool = False  # search_id / rank / cmatch packed key
     label_slot: str = "click"  # float slot whose first value is the label

@@ -361,14 +361,21 @@ class MultiChipTrainer:
         """Per-device metric streams, each leaf stacked [n_dev, ...] and
         mesh-sharded (merged by summing over devices at read time)."""
         if isinstance(auc_state, dict):
-            return auc_state
+            # the step donates mstate: copy so the caller's reference (often
+            # trainer.last_metric_state itself) is not invalidated by the
+            # first step's buffer donation
+            return jax.tree.map(jnp.array, auc_state)
         if auc_state is not None and (self.n_tasks > 1 or self.metric_group):
             raise ValueError(
                 "pass trainer.last_metric_state (dict) to continue metrics "
                 "across passes — a bare AucState would reset the task/group "
                 "streams while continuing the primary one"
             )
-        mstate = {"auc": auc_state if auc_state is not None else self.init_auc()}
+        mstate = {
+            "auc": jax.tree.map(jnp.array, auc_state)
+            if auc_state is not None
+            else self.init_auc()
+        }
         if self.n_tasks > 1:
             base = stack_auc_states(
                 init_auc_state(self.conf.auc_buckets), self.n_tasks

@@ -176,7 +176,10 @@ class Trainer:
         a bare AucState continues only the primary stream and is rejected
         when task/group streams exist (they would silently reset)."""
         if isinstance(auc_state, dict):
-            return auc_state
+            # the step donates mstate: copy so the caller's reference (often
+            # trainer.last_metric_state itself) is not invalidated by the
+            # first step's buffer donation
+            return jax.tree.map(jnp.array, auc_state)
         if auc_state is not None and (self.n_tasks > 1 or self.metric_group):
             raise ValueError(
                 "pass trainer.last_metric_state (dict) to continue metrics "
@@ -184,7 +187,7 @@ class Trainer:
                 "streams while continuing the primary one"
             )
         mstate = {
-            "auc": auc_state
+            "auc": jax.tree.map(jnp.array, auc_state)
             if auc_state is not None
             else init_auc_state(self.conf.auc_buckets)
         }
