@@ -26,8 +26,10 @@ class _Flags:
         "dataset_shuffle_thread_num": 10,
         # reference: FLAGS_padbox_dataset_merge_thread_num
         "dataset_merge_thread_num": 10,
-        # reference: FLAGS_enable_pullpush_dedup_keys (flags.cc:603)
-        "enable_pullpush_dedup_keys": True,
+        # NOTE: the reference's FLAGS_enable_pullpush_dedup_keys (flags.cc:603)
+        # has no flag here on purpose: batch dedup happens host-side in
+        # SparseTable.plan_keys where np.unique is essentially free, so it is
+        # unconditionally on — there is no faster no-dedup path to toggle to.
         # reference: FLAGS_check_nan_inf (boxps_worker.cc:575-581)
         "check_nan_inf": False,
         # reference: FLAGS_enable_pull_box_padding_zero (pull_box_sparse_op.h)
@@ -240,6 +242,11 @@ class TrainerConfig:
     dump_param: Sequence[str] = ()
     need_dump_field: bool = False
     need_dump_param: bool = False
+    # dense-tower compute dtype: "" keeps the model's own setting (which
+    # defaults to flags.compute_dtype / PBOX_COMPUTE_DTYPE); "bfloat16" is
+    # the TPU AMP analog (params/accum stay f32) — reference:
+    # meta_optimizers/amp_optimizer.py, SURVEY.md §2.9 "bf16 by default"
+    compute_dtype: str = ""
     # nan check after each batch (reference: FLAGS_check_nan_inf)
     check_nan_inf: bool = False
     # per-stage host timing (reference: TrainFilesWithProfiler — a slower

@@ -19,27 +19,56 @@ import numpy as np
 
 
 class AucState(NamedTuple):
-    """Bucketed pos/neg tables + moment accumulators (a jit-friendly pytree)."""
+    """Bucketed pos/neg tables + moment accumulators (a jit-friendly pytree).
 
-    pos: jax.Array  # f64-safe f32 [n_buckets]
-    neg: jax.Array  # [n_buckets]
-    abserr: jax.Array  # scalar: sum |pred - label|
-    sqrerr: jax.Array  # scalar: sum (pred - label)^2
-    pred_sum: jax.Array  # scalar
-    label_sum: jax.Array  # scalar
-    count: jax.Array  # scalar
+    The reference accumulates in double tables (box_wrapper.h:61); with x64
+    off on TPU, exactness comes from integer counts instead: pos/neg/count
+    hold uint32 counts (weights are exactly {0,1}), so ``x + 1`` never
+    saturates the way an f32 does past 2^24.  The real-valued moment sums are
+    Kahan pairs ``[sum, compensation]`` so per-instance increments survive
+    far beyond 2^24 accumulated magnitude.
+
+    Ceiling: uint32 wraps at 2^32 ≈ 4.29B increments per counter (the
+    reference's doubles are exact to 2^53).  A single metric stream is
+    pass/day-scoped in practice; ``compute_metrics`` warns as ``count``
+    approaches the ceiling so a stream held open past it is not silent.
+    """
+
+    pos: jax.Array  # uint32 [n_buckets] — exact counts
+    neg: jax.Array  # uint32 [n_buckets]
+    abserr: jax.Array  # f32 [2] Kahan: sum |pred - label|
+    sqrerr: jax.Array  # f32 [2] Kahan: sum (pred - label)^2
+    pred_sum: jax.Array  # f32 [2] Kahan
+    label_sum: jax.Array  # uint32 scalar (labels are {0,1})
+    count: jax.Array  # uint32 scalar
 
 
 def init_auc_state(n_buckets: int = 1 << 20) -> AucState:
     """n_buckets defaults to the reference's 1M-entry table."""
     # distinct buffers per field: the train step donates the whole state, and
     # a shared zeros() scalar would be the same buffer donated five times
+    u32 = jnp.uint32
     return AucState(
-        pos=jnp.zeros(n_buckets),
-        neg=jnp.zeros(n_buckets),
-        abserr=jnp.zeros(()), sqrerr=jnp.zeros(()), pred_sum=jnp.zeros(()),
-        label_sum=jnp.zeros(()), count=jnp.zeros(()),
+        pos=jnp.zeros(n_buckets, dtype=u32),
+        neg=jnp.zeros(n_buckets, dtype=u32),
+        abserr=jnp.zeros(2), sqrerr=jnp.zeros(2), pred_sum=jnp.zeros(2),
+        label_sum=jnp.zeros((), dtype=u32), count=jnp.zeros((), dtype=u32),
     )
+
+
+def _kahan_add(acc: jax.Array, x: jax.Array) -> jax.Array:
+    """acc = [sum, comp]; add scalar x with compensated summation."""
+    s, c = acc[0], acc[1]
+    y = x - c
+    t = s + y
+    c = (t - s) - y
+    return jnp.stack([t, c])
+
+
+def kahan_value(acc) -> float:
+    """Host-side read of a Kahan pair (sum minus residual compensation)."""
+    a = np.asarray(acc, dtype=np.float64)
+    return float(a[0] - a[1])
 
 
 def update_auc_state(
@@ -52,17 +81,18 @@ def update_auc_state(
     """
     nb = state.pos.shape[0]
     idx = jnp.clip((preds * nb).astype(jnp.int32), 0, nb - 1)
-    pos_w = mask * labels
-    neg_w = mask * (1.0 - labels)
+    pos_w = (mask * labels).astype(jnp.uint32)
+    neg_w = (mask * (1.0 - labels)).astype(jnp.uint32)
     err = (preds - labels) * mask
     return AucState(
         pos=state.pos.at[idx].add(pos_w),
         neg=state.neg.at[idx].add(neg_w),
-        abserr=state.abserr + jnp.abs(err).sum(),
-        sqrerr=state.sqrerr + (err * err).sum(),
-        pred_sum=state.pred_sum + (preds * mask).sum(),
-        label_sum=state.label_sum + (labels * mask).sum(),
-        count=state.count + mask.sum(),
+        abserr=_kahan_add(state.abserr, jnp.abs(err).sum()),
+        sqrerr=_kahan_add(state.sqrerr, (err * err).sum()),
+        pred_sum=_kahan_add(state.pred_sum, (preds * mask).sum()),
+        label_sum=state.label_sum
+        + (mask * labels).sum().astype(jnp.uint32),
+        count=state.count + mask.sum().astype(jnp.uint32),
     )
 
 
@@ -110,11 +140,20 @@ def compute_metrics(state: AucState) -> dict:
     area = float((pos * (neg_below + 0.5 * neg)).sum())
     auc = area / (tot_pos * tot_neg) if tot_pos > 0 and tot_neg > 0 else 0.5
     n = max(float(state.count), 1.0)
+    if n > 3e9:  # approaching the uint32 wrap at ~4.29e9
+        import warnings
+
+        warnings.warn(
+            f"AUC stream count={n:.3g} is nearing the uint32 ceiling "
+            "(2^32): reset the metric state (per pass/day) before it wraps",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     return {
         "auc": auc,
-        "mae": float(state.abserr) / n,
-        "rmse": float(np.sqrt(float(state.sqrerr) / n)),
+        "mae": kahan_value(state.abserr) / n,
+        "rmse": float(np.sqrt(max(kahan_value(state.sqrerr), 0.0) / n)),
         "actual_ctr": float(state.label_sum) / n,
-        "predicted_ctr": float(state.pred_sum) / n,
+        "predicted_ctr": kahan_value(state.pred_sum) / n,
         "count": float(state.count),
     }

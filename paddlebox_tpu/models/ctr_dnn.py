@@ -15,7 +15,7 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
-from paddlebox_tpu.models.layers import init_mlp, mlp
+from paddlebox_tpu.models.layers import init_mlp, mlp, resolve_compute_dtype
 from paddlebox_tpu.ops import fused_seqpool_cvm, fused_seqpool_cvm_extended
 
 
@@ -31,7 +31,9 @@ class CtrDnn:
         use_cvm: bool = True,
         cvm_offset: int = 2,
         expand_dim: int = 0,  # extended embedding tail width (pull_box_extended)
+        compute_dtype: str = "",  # "" -> flags.compute_dtype (PBOX_COMPUTE_DTYPE)
     ):
+        self.compute_dtype = resolve_compute_dtype(compute_dtype)
         self.n_sparse_slots = n_sparse_slots
         self.emb_width = emb_width
         self.dense_dim = dense_dim
@@ -68,4 +70,4 @@ class CtrDnn:
                 use_cvm=self.use_cvm, cvm_offset=self.cvm_offset,
             )
         x = jnp.concatenate([pooled, dense], axis=1) if self.dense_dim else pooled
-        return mlp(params["tower"], x)[:, 0]
+        return mlp(params["tower"], x, self.compute_dtype)[:, 0]

@@ -13,7 +13,13 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
-from paddlebox_tpu.models.layers import init_mlp, init_linear, linear, mlp
+from paddlebox_tpu.models.layers import (
+    init_mlp,
+    init_linear,
+    linear,
+    mlp,
+    resolve_compute_dtype,
+)
 from paddlebox_tpu.ops import fused_seqpool_cvm
 
 
@@ -27,7 +33,11 @@ class DCN:
         n_cross: int = 3,
         use_cvm: bool = True,
         cvm_offset: int = 2,
+        compute_dtype: str = "",
     ):
+        # cross layers stay f32 (cheap matvecs whose features compound
+        # multiplicatively); only the deep tower + head run in compute_dtype
+        self.compute_dtype = resolve_compute_dtype(compute_dtype)
         self.n_sparse_slots = n_sparse_slots
         self.emb_width = emb_width
         self.dense_dim = dense_dim
@@ -62,5 +72,8 @@ class DCN:
         x = feats
         for layer in params["cross"]:
             x = x0 * (x @ layer["w"])[:, None] + layer["b"] + x
-        deep = mlp(params["deep"], feats)
-        return linear(params["head"], jnp.concatenate([x, deep], axis=1))[:, 0]
+        deep = mlp(params["deep"], feats, self.compute_dtype)
+        return linear(
+            params["head"], jnp.concatenate([x, deep], axis=1),
+            self.compute_dtype,
+        )[:, 0]

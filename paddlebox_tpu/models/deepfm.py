@@ -18,7 +18,13 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
-from paddlebox_tpu.models.layers import init_linear, init_mlp, linear, mlp
+from paddlebox_tpu.models.layers import (
+    init_linear,
+    init_mlp,
+    linear,
+    mlp,
+    resolve_compute_dtype,
+)
 from paddlebox_tpu.ops.seqpool_cvm import _cvm_transform, seqpool
 
 
@@ -31,7 +37,9 @@ class DeepFM:
         hidden: Sequence[int] = (400, 400, 400),
         use_cvm: bool = True,
         cvm_offset: int = 2,
+        compute_dtype: str = "",
     ):
+        self.compute_dtype = resolve_compute_dtype(compute_dtype)
         self.n_sparse_slots = n_sparse_slots
         self.emb_width = emb_width
         self.dense_dim = dense_dim
@@ -64,6 +72,6 @@ class DeepFM:
         ).reshape(batch_size, -1)
         if self.dense_dim:
             feats = jnp.concatenate([feats, dense], axis=1)
-        fm1 = linear(params["fm1"], feats)[:, 0]
-        deep = mlp(params["tower"], feats)[:, 0]
+        fm1 = linear(params["fm1"], feats, self.compute_dtype)[:, 0]
+        deep = mlp(params["tower"], feats, self.compute_dtype)[:, 0]
         return fm1 + fm2 + deep

@@ -8,10 +8,63 @@ they checkpoint and psum trivially.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+
+
+def resolve_compute_dtype(name: Optional[str] = None):
+    """Map a config string to the tower compute dtype (None = f32 native).
+
+    The reference's AMP stack (operators/amp/*, meta_optimizers/
+    amp_optimizer.py) becomes a cast policy here: params and optimizer state
+    stay f32, the MXU matmul chain runs in the compute dtype, logits upcast
+    to f32 before the loss.  CVM counters and the seqpool segment_sum stay
+    f32 (exact show/clk sums; the pool reads f32 table rows so bf16 saves no
+    HBM traffic there).  Default comes from ``flags.compute_dtype``
+    (PBOX_COMPUTE_DTYPE).
+    """
+    if name is None or name == "":
+        from paddlebox_tpu.config import flags
+
+        name = flags.compute_dtype
+    canon = {
+        "float32": None, "f32": None, "fp32": None,
+        "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+        "float16": jnp.float16, "fp16": jnp.float16, "f16": jnp.float16,
+    }
+    if name not in canon:
+        raise ValueError(f"unknown compute_dtype {name!r}")
+    return canon[name]
+
+
+def apply_compute_dtype_override(model, dtype_name: str) -> None:
+    """Apply a trainer-config compute_dtype to a model (shared by Trainer and
+    MultiChipTrainer).  The override mutates the model instance — the trainer
+    owns training-time policy — and warns when the model predates the
+    compute_dtype contract so the setting is never silently ignored."""
+    if not dtype_name:
+        return
+    if not hasattr(model, "compute_dtype"):
+        import warnings
+
+        warnings.warn(
+            f"TrainerConfig.compute_dtype={dtype_name!r} ignored: "
+            f"{type(model).__name__} has no compute_dtype attribute",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return
+    model.compute_dtype = resolve_compute_dtype(dtype_name)
+
+
+def cast_tree(tree, dtype):
+    """Cast every float leaf of a param pytree (int leaves untouched)."""
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree,
+    )
 
 
 def init_linear(key: jax.Array, in_dim: int, out_dim: int, scale: str = "xavier"):
@@ -26,7 +79,10 @@ def init_linear(key: jax.Array, in_dim: int, out_dim: int, scale: str = "xavier"
     }
 
 
-def linear(params: dict, x: jax.Array) -> jax.Array:
+def linear(params: dict, x: jax.Array, compute_dtype=None) -> jax.Array:
+    if compute_dtype is not None:
+        out = x.astype(compute_dtype) @ params["w"].astype(compute_dtype)
+        return (out + params["b"].astype(compute_dtype)).astype(jnp.float32)
     return x @ params["w"] + params["b"]
 
 
@@ -36,11 +92,20 @@ def init_mlp(key: jax.Array, in_dim: int, hidden: Sequence[int], out_dim: int = 
     return [init_linear(k, dims[i], dims[i + 1]) for i, k in enumerate(keys)]
 
 
-def mlp(params: list, x: jax.Array) -> jax.Array:
-    """ReLU MLP; final layer linear.  Returns [..., out_dim]."""
+def mlp(params: list, x: jax.Array, compute_dtype=None) -> jax.Array:
+    """ReLU MLP; final layer linear.  Returns [..., out_dim] in f32.
+
+    With a compute_dtype the whole chain (casts included) runs in that dtype
+    and upcasts once at the output — one cast in, one cast out, so XLA keeps
+    every matmul on the MXU in bf16/f16.
+    """
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        params = cast_tree(params, compute_dtype)
     for layer in params[:-1]:
-        x = jax.nn.relu(linear(layer, x))
-    return linear(params[-1], x)
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    out = x @ params[-1]["w"] + params[-1]["b"]
+    return out.astype(jnp.float32) if compute_dtype is not None else out
 
 
 def bce_with_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:

@@ -16,7 +16,13 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
-from paddlebox_tpu.models.layers import init_linear, init_mlp, linear, mlp
+from paddlebox_tpu.models.layers import (
+    init_linear,
+    init_mlp,
+    linear,
+    mlp,
+    resolve_compute_dtype,
+)
 from paddlebox_tpu.ops import fused_seqpool_cvm
 
 
@@ -33,7 +39,9 @@ class MMoE:
         tower_hidden: Sequence[int] = (32,),
         use_cvm: bool = True,
         cvm_offset: int = 2,
+        compute_dtype: str = "",
     ):
+        self.compute_dtype = resolve_compute_dtype(compute_dtype)
         self.n_sparse_slots = n_sparse_slots
         self.emb_width = emb_width
         self.dense_dim = dense_dim
@@ -71,12 +79,13 @@ class MMoE:
         )
         if self.dense_dim:
             feats = jnp.concatenate([feats, dense], axis=1)
+        dt = self.compute_dtype
         expert_out = jnp.stack(
-            [mlp(e, feats) for e in params["experts"]], axis=1
+            [mlp(e, feats, dt) for e in params["experts"]], axis=1
         )  # [B, E, expert_dim]
         logits = []
         for gate, tower in zip(params["gates"], params["towers"]):
-            g = jax.nn.softmax(linear(gate, feats), axis=-1)  # [B, E]
+            g = jax.nn.softmax(linear(gate, feats, dt), axis=-1)  # [B, E]
             mixed = jnp.einsum("be,bed->bd", g, expert_out)
-            logits.append(mlp(tower, mixed)[:, 0])
+            logits.append(mlp(tower, mixed, dt)[:, 0])
         return jnp.stack(logits, axis=1)

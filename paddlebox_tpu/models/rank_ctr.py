@@ -16,7 +16,7 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
-from paddlebox_tpu.models.layers import init_mlp, mlp
+from paddlebox_tpu.models.layers import init_mlp, mlp, resolve_compute_dtype
 from paddlebox_tpu.ops import fused_seqpool_cvm
 from paddlebox_tpu.ops.rank_attention import rank_attention
 
@@ -34,7 +34,11 @@ class RankCtrDnn:
         att_out_dim: int = 64,
         use_cvm: bool = True,
         cvm_offset: int = 2,
+        compute_dtype: str = "",
     ):
+        # rank_attention stays f32 (parameter-block selection einsum with
+        # exact-parity tests); the tower runs in compute_dtype
+        self.compute_dtype = resolve_compute_dtype(compute_dtype)
         self.n_sparse_slots = n_sparse_slots
         self.emb_width = emb_width
         self.dense_dim = dense_dim
@@ -74,4 +78,7 @@ class RankCtrDnn:
         )
         x = jnp.concatenate([pooled, dense], axis=1) if self.dense_dim else pooled
         att = rank_attention(x, rank_offset, params["rank_param"], self.max_rank)
-        return mlp(params["tower"], jnp.concatenate([x, att], axis=1))[:, 0]
+        return mlp(
+            params["tower"], jnp.concatenate([x, att], axis=1),
+            self.compute_dtype,
+        )[:, 0]

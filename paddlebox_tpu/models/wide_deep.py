@@ -9,7 +9,13 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 
-from paddlebox_tpu.models.layers import init_linear, init_mlp, linear, mlp
+from paddlebox_tpu.models.layers import (
+    init_linear,
+    init_mlp,
+    linear,
+    mlp,
+    resolve_compute_dtype,
+)
 from paddlebox_tpu.ops import fused_seqpool_cvm
 
 
@@ -22,7 +28,9 @@ class WideDeep:
         hidden: Sequence[int] = (512, 256, 128),
         use_cvm: bool = True,
         cvm_offset: int = 2,
+        compute_dtype: str = "",
     ):
+        self.compute_dtype = resolve_compute_dtype(compute_dtype)
         self.n_sparse_slots = n_sparse_slots
         self.emb_width = emb_width
         self.dense_dim = dense_dim
@@ -46,4 +54,7 @@ class WideDeep:
         )
         if self.dense_dim:
             feats = jnp.concatenate([feats, dense], axis=1)
-        return linear(params["wide"], feats)[:, 0] + mlp(params["tower"], feats)[:, 0]
+        return (
+            linear(params["wide"], feats, self.compute_dtype)[:, 0]
+            + mlp(params["tower"], feats, self.compute_dtype)[:, 0]
+        )

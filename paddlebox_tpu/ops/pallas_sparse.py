@@ -91,17 +91,24 @@ def pallas_pull_rows(values: jax.Array, idx: jax.Array,
 def _scatter_kernel(idx_ref, delta_ref, values_ref, out_ref, row, sems):
     """One grid step accumulates one delta row into its table row in HBM:
     DMA row in -> add -> DMA row back.  Grid steps run sequentially, so
-    repeated indices (dead row) are safe read-modify-writes."""
+    repeated indices (dead row) are safe read-modify-writes.
+
+    All loads AND stores go through ``out_ref`` — the aliased output buffer
+    (initialized to the input table).  Reading the aliased *input* ref
+    instead would see stale rows for duplicate indices in interpret mode,
+    where input and output are distinct buffers.
+    """
+    del values_ref  # aliased into out_ref; never touched directly
     g = pl.program_id(0)
     r = idx_ref[g]
     load = pltpu.make_async_copy(
-        values_ref.at[pl.ds(r, 1), :], row, sems.at[0]
+        out_ref.at[pl.ds(r, 1), :], row, sems.at[0]
     )
     load.start()
     load.wait()
     row[:] = row[:] + delta_ref[:]
     store = pltpu.make_async_copy(
-        row, values_ref.at[pl.ds(r, 1), :], sems.at[1]
+        row, out_ref.at[pl.ds(r, 1), :], sems.at[1]
     )
     store.start()
     store.wait()

@@ -53,6 +53,7 @@ from paddlebox_tpu.models.layers import bce_with_logits
 from paddlebox_tpu.parallel.mesh import DATA_AXIS
 from paddlebox_tpu.parallel.sharded_table import ShardedBatchPlan, ShardedSparseTable
 from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
+from paddlebox_tpu.sparse.table import gather_rows, scatter_add_rows
 
 shard_map = jax.shard_map
 
@@ -107,7 +108,7 @@ def sharded_pull(values: jax.Array, serve_rows: jax.Array, occ_flat: jax.Array,
     """
     n, C = serve_rows.shape
     W = values.shape[1]
-    served = jnp.take(values, serve_rows.reshape(-1), axis=0)  # [n*C, W]
+    served = gather_rows(values, serve_rows.reshape(-1))  # [n*C, W]
     got = jax.lax.all_to_all(served.reshape(n, C, W), DATA_AXIS, 0, 0)
     got_flat = jnp.concatenate(
         [got.reshape(n * C, W), jnp.zeros((1, W), values.dtype)]
@@ -165,8 +166,8 @@ def sharded_push_and_update(
         conf.grad_clip,
     )
     delta = jnp.concatenate([acc[:, :co], w_delta], axis=1)
-    values = values.at[serve_uniq].add(delta)
-    g2sum = g2sum.at[serve_uniq].add(g2_delta)
+    values = scatter_add_rows(values, serve_uniq, delta)
+    g2sum = g2sum.at[serve_uniq].add(g2_delta)  # [cap] vector: XLA scatter
     # scrub the dead row: padding requests and census-missing keys land there
     values = values.at[cap - 1].set(0.0)
     g2sum = g2sum.at[cap - 1].set(0.0)
@@ -191,6 +192,9 @@ class MultiChipTrainer:
         self.mesh = mesh
         self.n_dev = int(mesh.devices.size)
         self.conf = trainer_conf or TrainerConfig()
+        from paddlebox_tpu.models.layers import apply_compute_dtype_override
+
+        apply_compute_dtype_override(model, self.conf.compute_dtype)
         self.metric_group = metric_group
         self.n_tasks = getattr(model, "n_tasks", 1)
         if self.conf.dense_optimizer == "adam":

@@ -304,6 +304,32 @@ class SparseTable:
 # ------------------------------------------------------------------------- #
 # Pure device functions (jit these, or call them inside a larger train_step)
 # ------------------------------------------------------------------------- #
+def gather_rows(values: jax.Array, idx: jax.Array) -> jax.Array:
+    """Row gather, routed to the Pallas DMA kernel when
+    ``flags.use_pallas_sparse`` is set (and the key capacity tiles evenly);
+    XLA's native gather otherwise.  Identical semantics either way."""
+    from paddlebox_tpu.config import flags
+
+    if flags.use_pallas_sparse:
+        from paddlebox_tpu.ops.pallas_sparse import _TILE, pallas_pull_rows
+
+        if idx.shape[0] % _TILE == 0:
+            return pallas_pull_rows(values, idx)
+    return jnp.take(values, idx, axis=0)
+
+
+def scatter_add_rows(values: jax.Array, idx: jax.Array, delta: jax.Array) -> jax.Array:
+    """Row scatter-add, routed like gather_rows.  Duplicate indices
+    accumulate identically on both paths."""
+    from paddlebox_tpu.config import flags
+
+    if flags.use_pallas_sparse:
+        from paddlebox_tpu.ops.pallas_sparse import pallas_scatter_add
+
+        return pallas_scatter_add(values, idx, delta)
+    return values.at[idx].add(delta)
+
+
 def pull_rows(
     values: jax.Array,
     idx: jax.Array,
@@ -314,7 +340,7 @@ def pull_rows(
     PullCopy kernels).  With create_threshold > 0, embeddings of rows whose
     show count is below it read as zero (feature admission: embedx is not
     materialized until the feature is frequent enough)."""
-    rows = jnp.take(values, idx, axis=0)
+    rows = gather_rows(values, idx)
     if create_threshold > 0.0:
         visible = (rows[..., 0:1] >= create_threshold).astype(rows.dtype)
         rows = jnp.concatenate(
@@ -363,8 +389,8 @@ def push_and_update(
             [counter_delta, jnp.zeros((U, co - 2), counter_delta.dtype)], axis=1
         )
     delta = jnp.concatenate([counter_delta, w_delta], axis=1)
-    values = values.at[plan_uniq_idx].add(delta)
-    g2sum = g2sum.at[plan_uniq_idx].add(g2_delta)
+    values = scatter_add_rows(values, plan_uniq_idx, delta)
+    g2sum = g2sum.at[plan_uniq_idx].add(g2_delta)  # [P] vector: XLA scatter
     # the dead row must stay zero: padding slots scatter only zeros, but keys
     # missing from the pass census carry real grads — scrub them.
     dead = values.shape[0] - 1

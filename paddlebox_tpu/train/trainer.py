@@ -84,6 +84,9 @@ class Trainer:
         self.model = model
         self.table_conf = table_conf
         self.conf = trainer_conf or TrainerConfig()
+        from paddlebox_tpu.models.layers import apply_compute_dtype_override
+
+        apply_compute_dtype_override(model, self.conf.compute_dtype)
         self.metric_group = metric_group
         self.n_tasks = getattr(model, "n_tasks", 1)
         if self.conf.dense_optimizer == "adam":

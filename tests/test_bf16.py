@@ -1,0 +1,90 @@
+"""Mixed-precision (bf16 compute) tests.
+
+The reference's AMP stack (operators/amp/check_finite_and_unscale_op.cc,
+meta_optimizers/amp_optimizer.py) maps to a cast policy on TPU (SURVEY.md
+§2.9 "bf16 by default on TPU"): params/optimizer/CVM counters stay f32, the
+dense towers compute in bf16.  These tests pin (1) the cast policy at the
+layer level and (2) training parity — bf16 reaches an AUC close to the f32
+run on the same synth data.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.models.layers import init_mlp, mlp, resolve_compute_dtype
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train.trainer import Trainer
+
+import jax
+
+
+def test_resolve_compute_dtype():
+    assert resolve_compute_dtype("float32") is None
+    assert resolve_compute_dtype("bf16") == jnp.bfloat16
+    assert resolve_compute_dtype("bfloat16") == jnp.bfloat16
+    assert resolve_compute_dtype() is None  # flag default is float32
+    with pytest.raises(ValueError):
+        resolve_compute_dtype("int8")
+
+
+def test_mlp_bf16_close_to_f32_and_returns_f32():
+    key = jax.random.PRNGKey(0)
+    params = init_mlp(key, 16, (32, 16), 1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    out32 = mlp(params, x)
+    out16 = mlp(params, x, jnp.bfloat16)
+    assert out16.dtype == jnp.float32  # logits upcast before the loss
+    assert np.allclose(np.asarray(out32), np.asarray(out16), atol=0.15)
+
+
+def test_bf16_grads_and_params_stay_f32():
+    params = init_mlp(jax.random.PRNGKey(0), 8, (16,), 1)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+
+    def loss(p):
+        return mlp(p, x, jnp.bfloat16).sum()
+
+    grads = jax.grad(loss)(params)
+    for leaf in jax.tree.leaves(grads):
+        assert leaf.dtype == jnp.float32  # cast transpose restores f32
+
+
+def _train_auc(tmp_path, compute_dtype, n_passes=3):
+    B, S, DENSE = 64, 4, 3
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+        max_feasigns_per_ins=16,
+    )
+    files = write_synth_files(
+        str(tmp_path), n_files=2, ins_per_file=256, n_sparse_slots=S,
+        vocab_per_slot=100, dense_dim=DENSE, seed=3,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=2)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    tconf = SparseTableConfig(embedding_dim=8)
+    trconf = TrainerConfig(auc_buckets=1 << 12, compute_dtype=compute_dtype)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(32, 16))
+    table = SparseTable(tconf, seed=0)
+    trainer = Trainer(model, tconf, trconf, seed=0)
+    metrics = {}
+    for _ in range(n_passes):
+        table.begin_pass(ds.unique_keys())
+        metrics = trainer.train_from_dataset(ds, table)
+        table.end_pass()
+    ds.close()
+    return metrics
+
+
+def test_bf16_training_parity(tmp_path):
+    m32 = _train_auc(tmp_path / "f32", "float32")
+    m16 = _train_auc(tmp_path / "bf16", "bfloat16")
+    assert np.isfinite(m16["loss"])
+    # same data, same seeds: bf16 must land in the same quality regime
+    assert abs(m32["auc"] - m16["auc"]) < 0.03, (m32["auc"], m16["auc"])
+    assert abs(m32["loss"] - m16["loss"]) < 0.05
