@@ -213,7 +213,13 @@ class SparseTableConfig:
     grad_clip: float = 10.0
 
     # CVM companions stored per row ahead of the embedding: [show, clk]
+    # (3 = conv layout [show, clk, conv]; 4+p = pcoc layout — SURVEY §2.6
+    # feature-type dispatch, box_wrapper.h:523-534)
     cvm_offset: int = 2
+    # quantized-table descale applied to embed columns at pull time
+    # (reference: pull_embedx_scale_ in the FeaturePullValueGpuQuant copy
+    # kernels, box_wrapper.cu:1223-1256).  1.0 = no-op (unquantized table).
+    pull_embedx_scale: float = 1.0
 
     @property
     def row_width(self) -> int:
@@ -242,6 +248,15 @@ class TrainerConfig:
     dump_param: Sequence[str] = ()
     need_dump_field: bool = False
     need_dump_param: bool = False
+    # task-label columns (indices into the batch's task_labels matrix, whose
+    # col 0 is the primary label and cols 1.. are the configured
+    # task_label_slots) that feed the extra CVM counters of a cvm_offset > 2
+    # table: counter 2+i of each pushed key increments by
+    # task_labels[:, counter_label_tasks[i]] of the key's instance.  The conv
+    # layout's conversion counter (reference: FeaturePushValueGpuConv,
+    # box_wrapper.cu PushCopy conv variants) is counter_label_tasks=(1,)
+    # with task-label slot 0 holding the conversion event.
+    counter_label_tasks: Sequence[int] = ()
     # dense-tower compute dtype: "" keeps the model's own setting (which
     # defaults to flags.compute_dtype / PBOX_COMPUTE_DTYPE); "bfloat16" is
     # the TPU AMP analog (params/accum stay f32) — reference:

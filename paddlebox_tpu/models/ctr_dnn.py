@@ -16,7 +16,11 @@ import jax
 import jax.numpy as jnp
 
 from paddlebox_tpu.models.layers import init_mlp, mlp, resolve_compute_dtype
-from paddlebox_tpu.ops import fused_seqpool_cvm, fused_seqpool_cvm_extended
+from paddlebox_tpu.ops import (
+    fused_seqpool_cvm,
+    fused_seqpool_cvm_extended,
+    fused_seqpool_cvm_with_conv,
+)
 
 
 class CtrDnn:
@@ -32,8 +36,21 @@ class CtrDnn:
         cvm_offset: int = 2,
         expand_dim: int = 0,  # extended embedding tail width (pull_box_extended)
         compute_dtype: str = "",  # "" -> flags.compute_dtype (PBOX_COMPUTE_DTYPE)
+        layout: str = "default",  # "default" | "conv" (show/clk/conv counters)
+        show_filter: bool = False,  # conv layout: drop the show column
     ):
         self.compute_dtype = resolve_compute_dtype(compute_dtype)
+        if layout not in ("default", "conv"):
+            raise ValueError(f"unknown layout {layout!r}")
+        if layout == "conv" and expand_dim:
+            raise ValueError("conv layout does not support expand_dim")
+        if layout == "conv" and cvm_offset < 3:
+            raise ValueError(
+                "conv layout needs cvm_offset >= 3 ([show, clk, conv, ...]); "
+                f"got {cvm_offset}"
+            )
+        self.layout = layout
+        self.show_filter = show_filter
         self.n_sparse_slots = n_sparse_slots
         self.emb_width = emb_width
         self.dense_dim = dense_dim
@@ -43,6 +60,8 @@ class CtrDnn:
         self.expand_dim = expand_dim
         base_w = emb_width - expand_dim
         pooled_w = base_w if use_cvm else base_w - cvm_offset
+        if layout == "conv" and use_cvm and show_filter:
+            pooled_w -= 1
         self.input_dim = n_sparse_slots * (pooled_w + expand_dim) + dense_dim
 
     def init(self, key: jax.Array) -> dict:
@@ -64,6 +83,12 @@ class CtrDnn:
                 cvm_offset=self.cvm_offset,
             )
             pooled = jnp.concatenate([base, expand], axis=1)
+        elif self.layout == "conv":
+            pooled = fused_seqpool_cvm_with_conv(
+                rows, key_segments, batch_size, self.n_sparse_slots,
+                use_cvm=self.use_cvm, cvm_offset=self.cvm_offset,
+                show_filter=self.show_filter,
+            )
         else:
             pooled = fused_seqpool_cvm(
                 rows, key_segments, batch_size, self.n_sparse_slots,

@@ -1,24 +1,39 @@
 """TPU-native CTR operator set.
 
 Replaces the reference's fused CUDA CTR ops (SURVEY.md §2.8:
-operators/fused/fused_seqpool_cvm_op.cu, operators/cvm_op.cu,
-operators/pull_box_sparse_op.*) with jittable JAX functions that XLA fuses.
+operators/fused/fused_seqpool_cvm_op.cu and its _with_conv/_with_diff_thres/
+_with_pcoc variants, operators/fused/fused_concat_op.cu, operators/cvm_op.cu,
+operators/rank_attention_op.*, operators/pull_box_sparse_op.*) with jittable
+JAX functions that XLA fuses.
 """
 
 from paddlebox_tpu.ops.cvm import cvm, cvm_decayed_show
-from paddlebox_tpu.ops.rank_attention import ins_rank, rank_attention
+from paddlebox_tpu.ops.fused_concat import fused_concat
+from paddlebox_tpu.ops.rank_attention import (
+    ins_rank,
+    rank_attention,
+    rank_attention2,
+)
 from paddlebox_tpu.ops.seqpool_cvm import (
     fused_seqpool_cvm,
     fused_seqpool_cvm_extended,
+    fused_seqpool_cvm_with_conv,
+    fused_seqpool_cvm_with_diff_thres,
+    fused_seqpool_cvm_with_pcoc,
     seqpool,
 )
 
 __all__ = [
     "cvm",
     "cvm_decayed_show",
+    "fused_concat",
     "fused_seqpool_cvm",
     "fused_seqpool_cvm_extended",
+    "fused_seqpool_cvm_with_conv",
+    "fused_seqpool_cvm_with_diff_thres",
+    "fused_seqpool_cvm_with_pcoc",
     "seqpool",
     "rank_attention",
+    "rank_attention2",
     "ins_rank",
 ]

@@ -1,0 +1,55 @@
+"""Column-select concat over paired feature blocks.
+
+TPU-native implementation of ``fused_concat`` / ``fusion_seqpool_concat``
+(reference: paddle/fluid/operators/fused/fused_concat_op.cu:34-50
+FusedSeqpoolConcatKernel; Python wrapper contrib/layers/nn.py:2459): for
+every slot the reference gathers ``total_cols`` output columns, each drawn
+from one of two per-slot input tensors (X1 = base embedding, X2 = expand
+embedding is the production pairing) by a (which-input, which-column) spec,
+into one [B, total_cols] tensor per slot.
+
+Here that is a plain column gather + stack per slot — XLA fuses the gathers
+and autodiff provides the split/scatter backward the reference hand-writes
+(FusedSeqpoolSplitKernel).
+
+``fusion_seqpool_cvm_concat`` (reference: fusion_seqpool_cvm_concat_op.cc)
+is subsumed by ``fused_seqpool_cvm`` itself: pooling all slots in one
+segment_sum already yields the concatenated [B, S * W] layout the fusion op
+exists to produce.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_concat(
+    x1: Sequence[jax.Array],
+    x2: Sequence[jax.Array],
+    output_cols: Sequence[Tuple[int, int]],
+) -> list[jax.Array]:
+    """Per-slot column-select concat.
+
+    x1, x2: parallel lists of per-slot feature blocks, [B, D1] and [B, D2].
+    output_cols: for each output column, ``(which, col)`` — which input
+        (0 = x1, 1 = x2) and which column of it.
+    Returns one [B, len(output_cols)] tensor per slot.  Differentiable.
+    """
+    if len(x1) != len(x2):
+        raise ValueError(f"slot count mismatch: {len(x1)} vs {len(x2)}")
+    for which, _col in output_cols:
+        if which not in (0, 1):
+            raise ValueError(
+                f"output_cols 'which' must be 0 (x1) or 1 (x2), got {which}"
+            )
+    outs = []
+    for a, b in zip(x1, x2):
+        cols = []
+        for which, col in output_cols:
+            src = a if which == 0 else b
+            cols.append(src[:, col])
+        outs.append(jnp.stack(cols, axis=1))
+    return outs

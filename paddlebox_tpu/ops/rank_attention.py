@@ -54,3 +54,13 @@ def rank_attention(
 def ins_rank(rank_offset: jax.Array) -> jax.Array:
     """[N, 1] own-rank column (the reference's InsRank output)."""
     return rank_offset[:, 0:1].astype(jnp.float32)
+
+
+# The reference ships two ops with identical math: ``rank_attention``
+# materializes InputHelp/ParamHelp scratch and runs a batched GEMM summing
+# over (peer slot k, feature f) (rank_attention.cu.h:27-110), while
+# ``rank_attention2`` computes the same double sum directly with atomics in
+# the backward (rank_attention_op.cu:218-292 kernel_rank_feed_forward /
+# kernel_rank_back_propagate).  One einsum covers both here; the alias keeps
+# the reference API surface.
+rank_attention2 = rank_attention

@@ -335,17 +335,27 @@ def pull_rows(
     idx: jax.Array,
     create_threshold: float = 0.0,
     cvm_offset: int = 2,
+    pull_embedx_scale: float = 1.0,
 ) -> jax.Array:
     """Gather pulled value rows [K, W] (reference: PullSparseCase +
     PullCopy kernels).  With create_threshold > 0, embeddings of rows whose
     show count is below it read as zero (feature admission: embedx is not
-    materialized until the feature is frequent enough)."""
+    materialized until the feature is frequent enough).
+    pull_embedx_scale != 1 descales the embedx columns of a quantized table
+    — but NOT the first embed column (embed_w), which the reference stores
+    unquantized (pulled layout [show, click, embed_w, embedx...],
+    SURVEY.md §2.6; FeaturePullValueGpuQuant, box_wrapper.cu:1223-1256)."""
     rows = gather_rows(values, idx)
-    if create_threshold > 0.0:
-        visible = (rows[..., 0:1] >= create_threshold).astype(rows.dtype)
-        rows = jnp.concatenate(
-            [rows[..., :cvm_offset], rows[..., cvm_offset:] * visible], axis=-1
-        )
+    if create_threshold > 0.0 or pull_embedx_scale != 1.0:
+        embed = rows[..., cvm_offset:]
+        if pull_embedx_scale != 1.0:
+            embed = jnp.concatenate(
+                [embed[..., :1], embed[..., 1:] * pull_embedx_scale], axis=-1
+            )
+        if create_threshold > 0.0:
+            visible = (rows[..., 0:1] >= create_threshold).astype(rows.dtype)
+            embed = embed * visible
+        rows = jnp.concatenate([rows[..., :cvm_offset], embed], axis=-1)
     return rows
 
 
@@ -359,6 +369,7 @@ def push_and_update(
     key_mask: jax.Array,
     key_clicks: jax.Array,
     conf: SparseTableConfig,
+    key_extras: Optional[jax.Array] = None,
 ):
     """Merge per-occurrence gradients by unique key and apply the sparse
     optimizer + show/clk counter update (reference: PushSparseGradCase,
@@ -368,6 +379,9 @@ def push_and_update(
     row_grads: [K, W] cotangent of the pulled rows (show/clk columns are
         zero thanks to stop_gradient in the CVM transform).
     key_clicks: [K] click/label of each occurrence's instance (masked).
+    key_extras: [K, cvm_offset - 2] extra counter increments per occurrence
+        (e.g. conversion events for the conv layout's third counter,
+        reference FeaturePushValueGpuConv); zeros when absent.
     Returns (values, g2sum) updated.
     """
     del plan_idx  # pull-side only; kept in the signature for symmetry
@@ -385,9 +399,13 @@ def push_and_update(
     )
     counter_delta = jnp.stack([show_inc, clk_inc], axis=1)
     if co > 2:
-        counter_delta = jnp.concatenate(
-            [counter_delta, jnp.zeros((U, co - 2), counter_delta.dtype)], axis=1
-        )
+        if key_extras is not None:
+            extra_inc = jax.ops.segment_sum(
+                key_extras, plan_inverse, num_segments=U
+            )
+        else:
+            extra_inc = jnp.zeros((U, co - 2), counter_delta.dtype)
+        counter_delta = jnp.concatenate([counter_delta, extra_inc], axis=1)
     delta = jnp.concatenate([counter_delta, w_delta], axis=1)
     values = scatter_add_rows(values, plan_uniq_idx, delta)
     g2sum = g2sum.at[plan_uniq_idx].add(g2_delta)  # [P] vector: XLA scatter

@@ -48,7 +48,9 @@ class TrainState:
     auc: AucState
 
 
-def _device_batch(batch: HostBatch, plan, n_slots: int) -> dict:
+def _device_batch(
+    batch: HostBatch, plan, n_slots: int, counter_label_tasks=()
+) -> dict:
     """Assemble the static-shape device feed from a HostBatch + BatchPlan."""
     ins = np.minimum(batch.key_segments // n_slots, batch.batch_size - 1)
     key_clicks = batch.labels[ins] * plan.key_mask
@@ -67,6 +69,28 @@ def _device_batch(batch: HostBatch, plan, n_slots: int) -> dict:
         dev["rank_offset"] = jnp.asarray(batch.rank_offset)
     if batch.task_labels is not None:
         dev["task_labels"] = jnp.asarray(batch.task_labels)
+    if counter_label_tasks:
+        if batch.task_labels is None:
+            raise RuntimeError(
+                "counter_label_tasks configured but the batch carries no "
+                "task labels: set DataFeedConfig.task_label_slots"
+            )
+        n_cols = batch.task_labels.shape[1]
+        bad = [t for t in counter_label_tasks if not 0 <= t < n_cols]
+        if bad:
+            raise ValueError(
+                f"counter_label_tasks {bad} out of range: the batch has "
+                f"{n_cols} task-label columns (col 0 = primary label)"
+            )
+        # per-occurrence extra counter increments (conv/pcoc layouts)
+        extras = np.stack(
+            [
+                batch.task_labels[ins, t] * plan.key_mask
+                for t in counter_label_tasks
+            ],
+            axis=1,
+        ).astype(np.float32)
+        dev["key_extras"] = jnp.asarray(extras)
     return dev
 
 
@@ -87,6 +111,13 @@ class Trainer:
         from paddlebox_tpu.models.layers import apply_compute_dtype_override
 
         apply_compute_dtype_override(model, self.conf.compute_dtype)
+        n_extra = len(self.conf.counter_label_tasks)
+        if n_extra and n_extra != table_conf.cvm_offset - 2:
+            raise ValueError(
+                f"counter_label_tasks has {n_extra} entries but the table's "
+                f"cvm_offset={table_conf.cvm_offset} leaves "
+                f"{table_conf.cvm_offset - 2} extra counter column(s)"
+            )
         self.metric_group = metric_group
         self.n_tasks = getattr(model, "n_tasks", 1)
         if self.conf.dense_optimizer == "adam":
@@ -116,6 +147,7 @@ class Trainer:
                 values, batch["idx"],
                 create_threshold=tconf.create_threshold,
                 cvm_offset=tconf.cvm_offset,
+                pull_embedx_scale=tconf.pull_embedx_scale,
             )
             bsz = batch["labels"].shape[0]
             extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
@@ -145,6 +177,7 @@ class Trainer:
             values, g2sum = push_and_update(
                 values, g2sum, row_grads, batch["idx"], batch["uniq_idx"],
                 batch["inverse"], batch["key_mask"], batch["key_clicks"], tconf,
+                key_extras=batch.get("key_extras"),
             )
             primary = preds[:, 0] if n_tasks > 1 else preds
             mstate = dict(mstate)
@@ -275,7 +308,10 @@ class Trainer:
                 with prof.stage("plan"):
                     plan = table.plan_batch(batch)
                 with prof.stage("feed"):
-                    dev = _device_batch(batch, plan, batch.n_sparse_slots)
+                    dev = _device_batch(
+                        batch, plan, batch.n_sparse_slots,
+                        self.conf.counter_label_tasks,
+                    )
                     if self.metric_group is not None:
                         dev["metric_masks"] = jnp.asarray(
                             self.metric_group.masks(batch)
@@ -347,6 +383,7 @@ class Trainer:
                 values, batch["idx"],
                 create_threshold=tconf.create_threshold,
                 cvm_offset=tconf.cvm_offset,
+                pull_embedx_scale=tconf.pull_embedx_scale,
             )
             bsz = batch["labels"].shape[0]
             extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}

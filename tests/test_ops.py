@@ -68,18 +68,20 @@ def test_fused_seqpool_cvm_no_cvm_drops_counters():
     np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
 
 
-def test_fused_seqpool_cvm_embed_filter():
-    """need_filter zeroes the embedding of low show/clk slots, keeps counters."""
+def test_fused_seqpool_cvm_occurrence_filter():
+    """need_filter drops a low-score occurrence entirely before pooling
+    (reference formula fused_seqpool_cvm_op.cu:104:
+    (show - click) * show_coeff + click * clk_coeff < threshold)."""
     B, S, W = 1, 2, 4
     rows = np.zeros((4, W), dtype=np.float32)
-    rows[0] = [1, 0, 5.0, 5.0]  # slot 0: show 1 -> score 0.2 < 1.0 -> filtered
-    rows[1] = [10, 3, 2.0, 2.0]  # slot 1: score 10*0.2+3 = 5 >= 1.0 -> kept
+    rows[0] = [1, 0, 5.0, 5.0]  # slot 0: (1-0)*0.2 = 0.2 < 1.0 -> filtered
+    rows[1] = [10, 3, 2.0, 2.0]  # slot 1: (10-3)*0.2+3 = 4.4 >= 1.0 -> kept
     segs = np.array([0, 1, B * S, B * S], dtype=np.int32)
     got = np.asarray(
         fused_seqpool_cvm(
             jnp.asarray(rows), jnp.asarray(segs), B, S,
             use_cvm=False, need_filter=True, show_coeff=0.2, clk_coeff=1.0,
-            embed_threshold=1.0,
+            threshold=1.0,
         )
     ).reshape(B, S, W - 2)
     np.testing.assert_allclose(got[0, 0], [0.0, 0.0])
