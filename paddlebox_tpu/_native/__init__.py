@@ -71,8 +71,50 @@ def get_lib():
         lib.pbx_fill.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 10
         lib.pbx_free.restype = None
         lib.pbx_free.argtypes = [ctypes.c_void_p]
+        try:
+            # absent from pre-hash builds of the .so (a stale cache with a
+            # flattened mtime): parser keeps working, hashing falls back
+            lib.pbx_hash_ids.restype = None
+            lib.pbx_hash_ids.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+            ]
+        except AttributeError:
+            lib = _LibWithoutHash(lib)
         _lib = lib
         return _lib
+
+
+class _LibWithoutHash:
+    """Wraps a stale .so lacking pbx_hash_ids; every other symbol passes
+    through, hash callers see None and use the numpy fallback."""
+
+    pbx_hash_ids = None
+
+    def __init__(self, lib):
+        self._lib = lib
+
+    def __getattr__(self, name):
+        return getattr(self._lib, name)
+
+
+def hash_ids_native(ins_ids) -> Optional[np.ndarray]:
+    """Batch FNV-1a 64 via the native lib; None when it is unavailable."""
+    lib = get_lib()
+    if lib is None or getattr(lib, "pbx_hash_ids", None) is None:
+        return None
+    enc = [s.encode() for s in ins_ids]
+    buf = b"".join(enc)
+    offs = np.zeros(len(enc) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in enc], out=offs[1:])
+    out = np.empty(len(enc), dtype=np.uint64)
+    lib.pbx_hash_ids(
+        buf,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(enc),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return out
 
 
 _KIND_CODE = {"skip": 0, "label": 1, "task": 2, "dense": 3, "sparse": 4}

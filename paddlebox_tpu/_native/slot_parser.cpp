@@ -246,4 +246,20 @@ void pbx_fill(void* h, uint64_t* keys, int64_t* offsets, float* dense,
 
 void pbx_free(void* h) { delete static_cast<Result*>(h); }
 
+// Batch FNV-1a 64 over concatenated ids (offs: n+1 byte offsets).  Used for
+// shuffle routing (reference: XXH64(ins_id) at data_set.cc:1934-1942); the
+// pure-numpy fallback in data/shuffle.py implements the identical function
+// so routing never depends on whether the native library built.
+void pbx_hash_ids(const char* buf, const int64_t* offs, int64_t n,
+                  uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t h = 14695981039346656037ULL;
+    for (int64_t j = offs[i]; j < offs[i + 1]; ++j) {
+      h ^= static_cast<unsigned char>(buf[j]);
+      h *= 1099511628211ULL;
+    }
+    out[i] = h;
+  }
+}
+
 }  // extern "C"

@@ -25,7 +25,6 @@ exchanged at load time, making ``global_shuffle`` meaningful across workers.
 
 from __future__ import annotations
 
-import hashlib
 import socket
 import struct
 import threading
@@ -36,19 +35,42 @@ import numpy as np
 from paddlebox_tpu.data.archive import block_from_bytes, block_to_bytes
 from paddlebox_tpu.data.record import RecordBlock
 
+_FNV_OFFSET = np.uint64(14695981039346656037)
+_FNV_PRIME = np.uint64(1099511628211)
 
-# --------------------------------------------------------------------------- #
-# routing
-# --------------------------------------------------------------------------- #
+
 def _hash_ins_ids(ins_ids: Sequence[str]) -> np.ndarray:
-    """Stable 64-bit hash per ins_id (the reference uses XXH64; any stable
-    hash serves — blake2b is in the stdlib and seedable)."""
-    out = np.empty(len(ins_ids), dtype=np.uint64)
-    for i, s in enumerate(ins_ids):
-        out[i] = np.frombuffer(
-            hashlib.blake2b(s.encode(), digest_size=8).digest(), dtype=np.uint64
-        )[0]
-    return out
+    """Stable batch 64-bit FNV-1a per ins_id (the reference routes by
+    XXH64(ins_id), data_set.cc:1934-1942; any stable hash serves).  Native
+    C++ when available; the numpy fallback computes the IDENTICAL function
+    column-by-column over a padded byte matrix, so multi-host routing is
+    consistent even when only some hosts built the native lib."""
+    if not len(ins_ids):
+        return np.empty(0, dtype=np.uint64)
+    from paddlebox_tpu._native import hash_ids_native
+
+    native = hash_ids_native(ins_ids)
+    if native is not None:
+        return native
+    enc = [s.encode() for s in ins_ids]
+    lens = np.asarray([len(e) for e in enc], dtype=np.int64)
+    offs = np.zeros(len(enc) + 1, dtype=np.int64)
+    np.cumsum(lens, out=offs[1:])
+    flat = np.frombuffer(b"".join(enc), dtype=np.uint8)
+    max_len = int(lens.max(initial=0))
+    h = np.full(len(enc), _FNV_OFFSET, dtype=np.uint64)
+    # column sweep with O(surviving rows) temporaries per step — no padded
+    # [n, max_len] matrices (they would cost GBs at pass scale)
+    starts = offs[:-1]
+    alive = np.arange(len(enc))
+    with np.errstate(over="ignore"):
+        for j in range(max_len):
+            alive = alive[lens[alive] > j]
+            if alive.shape[0] == 0:
+                break
+            c = flat[starts[alive] + j].astype(np.uint64)
+            h[alive] = (h[alive] ^ c) * _FNV_PRIME
+    return h
 
 
 def route_ids(

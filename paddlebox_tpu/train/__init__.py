@@ -1,5 +1,6 @@
 """Training runtime (SURVEY.md §2.5 analog)."""
 
+from paddlebox_tpu.train.auto_checkpoint import AutoCheckpointer
 from paddlebox_tpu.train.trainer import Trainer, TrainState
 
-__all__ = ["Trainer", "TrainState"]
+__all__ = ["AutoCheckpointer", "Trainer", "TrainState"]
