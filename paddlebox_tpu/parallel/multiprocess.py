@@ -1,0 +1,94 @@
+"""Multi-process (multi-host) glue: global arrays from process-local data.
+
+The reference's multi-node story is MPI inside libbox_ps (box_wrapper.h:415)
+plus NCCL rings spanning nodes (c_comm_init_multitrainer); its test tier
+fakes a cluster with localhost subprocesses (test_dist_base.py:754-900).
+Here the cluster layer is the JAX coordination service: each process holds
+the shards of every global array that live on its local devices, and these
+helpers convert between that process-local view and the global view the
+jitted step consumes.
+
+Single-process runs short-circuit to plain device_put, so the single-host
+path pays nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def local_device_indices(mesh: Mesh) -> np.ndarray:
+    """Positions along the (one-axis) mesh owned by this process, in mesh
+    order.  With the default device order these are contiguous."""
+    pid = jax.process_index()
+    flat = mesh.devices.reshape(-1)
+    return np.asarray(
+        [i for i, d in enumerate(flat) if d.process_index == pid],
+        dtype=np.int64,
+    )
+
+
+def global_from_local(sharding: NamedSharding, local: Any):
+    """Build a global array (tree) from each process's local slice of the
+    leading (device) axis.  local leaves: [L, ...] where L = local device
+    count; the global shape is [D, ...]."""
+    if not is_multiprocess():
+        return jax.device_put(local, sharding)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)
+        ),
+        local,
+    )
+
+
+def host_allgather(x: np.ndarray) -> np.ndarray:
+    """All-processes gather of a same-shaped host array -> [P, ...].
+    Single-process: adds the leading axis without a collective."""
+    if not is_multiprocess():
+        return np.asarray(x)[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x))
+
+
+def host_allgather_varlen(x: np.ndarray) -> np.ndarray:
+    """Gather 1-D arrays of differing lengths from every process and
+    concatenate.  Two collectives: sizes, then padded payload."""
+    if not is_multiprocess():
+        return np.asarray(x)
+    sizes = host_allgather(np.asarray([x.shape[0]], dtype=np.int64))[:, 0]
+    cap = int(sizes.max(initial=0))
+    pad = np.zeros(cap, dtype=x.dtype)
+    pad[: x.shape[0]] = x
+    stacked = host_allgather(pad)  # [P, cap]
+    return np.concatenate([stacked[p, : sizes[p]] for p in range(len(sizes))])
+
+
+def read_replicated(x) -> np.ndarray:
+    """Host value of an array that is identical on every device of the
+    sharded leading axis (e.g. a psummed scalar stacked [D]): reads this
+    process's first addressable shard."""
+    shard = x.addressable_shards[0]
+    return np.asarray(shard.data)
+
+
+def merge_device_axis(tree: Any) -> Any:
+    """Sum a [D, ...]-sharded tree over its device axis and return host
+    numpy — the cross-device metric merge (reference: collect_data_nccl,
+    box_wrapper.cc:230-273).  Works regardless of process count: the jitted
+    sum produces a fully-replicated (hence addressable) result."""
+    if not is_multiprocess():
+        return jax.tree.map(lambda x: np.asarray(x).sum(0), tree)
+    summed = jax.jit(
+        lambda t: jax.tree.map(lambda x: x.sum(axis=0), t)
+    )(tree)
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), summed)
