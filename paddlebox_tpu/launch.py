@@ -1,0 +1,164 @@
+"""Multi-process training launcher (``python -m paddlebox_tpu.launch``).
+
+The ``paddle.distributed.launch`` analog (reference:
+/root/reference/python/paddle/distributed/launch_utils.py — per-rank process
+spawn, env injection, log files, failure watch-and-kill).  On TPU there is no
+per-rank GPU list to carve up: each host process owns all of its local chips
+and joins the job through the JAX coordination service, so the launcher's
+whole job is (1) pick a coordinator address, (2) spawn N processes with
+``PBOX_COORDINATOR_ADDRESS / PBOX_NUM_PROCESSES / PBOX_PROCESS_ID`` set —
+which ``parallel.mesh.initialize_distributed()`` consumes — and (3) babysit
+them: tee per-rank logs, kill the survivors when any rank dies, propagate
+the first bad exit code.
+
+Single-host multi-process (the localhost test tier, and CPU-mesh dev runs)
+and one-process-per-host pods use the same entry:
+
+    python -m paddlebox_tpu.launch --nproc 2 train.py --epochs 1
+    python -m paddlebox_tpu.launch --nproc 2 --devices-per-proc 4 train.py
+
+``--devices-per-proc K`` forces each child onto a K-device virtual CPU mesh
+(sets XLA_FLAGS host-platform device count + JAX_PLATFORMS=cpu) — the
+multi-host simulation the reference runs with localhost pservers
+(test_dist_base.py:754-900).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional
+
+
+def find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def rank_env(
+    rank: int,
+    nproc: int,
+    coordinator: str,
+    devices_per_proc: Optional[int] = None,
+    base_env: Optional[dict] = None,
+) -> dict:
+    """Child environment for one rank (exported for tests/embedders)."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env["PBOX_COORDINATOR_ADDRESS"] = coordinator
+    env["PBOX_NUM_PROCESSES"] = str(nproc)
+    env["PBOX_PROCESS_ID"] = str(rank)
+    if devices_per_proc:
+        import re
+
+        flags = env.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={devices_per_proc}"
+        pat = r"--xla_force_host_platform_device_count=\d+"
+        if re.search(pat, flags):
+            flags = re.sub(pat, want, flags)  # replace an inherited count
+        else:
+            flags = (flags + " " + want).strip()
+        env["XLA_FLAGS"] = flags
+        env["JAX_PLATFORMS"] = "cpu"
+        # this image's sitecustomize forces jax_platforms="axon,cpu" via
+        # jax.config.update, outranking JAX_PLATFORMS; PBOX_FORCE_CPU tells
+        # initialize_distributed to re-override it in the child
+        env["PBOX_FORCE_CPU"] = "1"
+    return env
+
+
+def launch(
+    script_args: list[str],
+    nproc: int,
+    coordinator: Optional[str] = None,
+    devices_per_proc: Optional[int] = None,
+    log_dir: Optional[str] = None,
+    poll_interval: float = 0.2,
+) -> int:
+    """Spawn nproc ranks of ``python script_args...``; return the first
+    non-zero exit code (0 if all ranks succeed).  Any rank dying kills the
+    rest — a half-alive job would hang in the next collective forever
+    (reference: watch_local_trainers + terminate_local_procs)."""
+    coordinator = coordinator or f"127.0.0.1:{find_free_port()}"
+    procs: list[subprocess.Popen] = []
+    logs = []
+    for rank in range(nproc):
+        env = rank_env(rank, nproc, coordinator, devices_per_proc)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            out = open(os.path.join(log_dir, f"rank{rank}.log"), "wb")
+            logs.append(out)
+            stdout, stderr = out, subprocess.STDOUT
+        else:
+            stdout = stderr = None  # inherit: interleaved console
+        procs.append(
+            subprocess.Popen(
+                [sys.executable] + script_args,
+                env=env, stdout=stdout, stderr=stderr,
+            )
+        )
+    rc = 0
+    try:
+        live = set(range(nproc))
+        while live:
+            for r in sorted(live):
+                code = procs[r].poll()
+                if code is None:
+                    continue
+                live.discard(r)
+                if code != 0 and rc == 0:
+                    rc = code
+                    # first failure: kill the survivors
+                    for other in live:
+                        procs[other].send_signal(signal.SIGTERM)
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:
+        rc = 130
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+    finally:
+        deadline = time.time() + 10.0
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        for f in logs:
+            f.close()
+    return rc
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddlebox_tpu.launch",
+        description="spawn an N-process distributed training job",
+    )
+    ap.add_argument("--nproc", type=int, required=True,
+                    help="number of processes (one per host on a pod)")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of rank 0 (default: free local port)")
+    ap.add_argument("--devices-per-proc", type=int, default=None,
+                    help="virtual CPU devices per process (test/dev tier)")
+    ap.add_argument("--log-dir", default=None,
+                    help="write per-rank logs here instead of the console")
+    ap.add_argument("script", help="training script to run")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    return launch(
+        [args.script] + args.script_args,
+        nproc=args.nproc,
+        coordinator=args.coordinator,
+        devices_per_proc=args.devices_per_proc,
+        log_dir=args.log_dir,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

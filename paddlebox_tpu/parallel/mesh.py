@@ -30,8 +30,21 @@ def initialize_distributed(
     rendezvous).  No-op for single-process runs; on a multi-host TPU pod the
     launcher provides the coordinator address (or JAX infers it from the TPU
     metadata service when all args are None)."""
+    import os
+
+    if os.environ.get("PBOX_FORCE_CPU") == "1":
+        # launcher test/dev tier: must outrank this image's sitecustomize
+        # (which forces jax_platforms="axon,cpu" over the env var) BEFORE
+        # any backend init
+        jax.config.update("jax_platforms", "cpu")
     if jax.distributed.is_initialized():
         return
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("PBOX_COORDINATOR_ADDRESS")
+    if num_processes is None and "PBOX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["PBOX_NUM_PROCESSES"])
+    if process_id is None and "PBOX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PBOX_PROCESS_ID"])
     if coordinator_address is None and num_processes is None:
         # Single-process default: JAX infers cluster membership from the TPU
         # metadata service when present; a true single-host run raises

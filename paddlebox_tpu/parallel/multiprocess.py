@@ -73,6 +73,16 @@ def host_allgather_varlen(x: np.ndarray) -> np.ndarray:
     return np.concatenate([stacked[p, : sizes[p]] for p in range(len(sizes))])
 
 
+def local_view(x) -> np.ndarray:
+    """Host numpy of this process's slice of a leading-axis-sharded global
+    array: addressable shards concatenated in mesh order -> [L, ...].
+    Single-process this equals np.asarray(x) (L == D)."""
+    shards = sorted(
+        x.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+
+
 def read_replicated(x) -> np.ndarray:
     """Host value of an array that is identical on every device of the
     sharded leading axis (e.g. a psummed scalar stacked [D]): reads this

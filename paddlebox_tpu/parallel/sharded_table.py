@@ -20,6 +20,17 @@ exchange at all (the reference pays a CopyKeys + DedupKeysAndFillIdx round
 trip per batch, box_wrapper_impl.h:95-122): just two all_to_alls total, one
 returning pulled rows, one delivering pushed gradients.
 
+Multi-host (jax.process_count() > 1): every process plans only its LOCAL
+devices' batches — shard ownership stays global (``key % n_global``) — and
+two small host collectives glue the plans together: begin_pass allgathers
+the local key censuses into one global census (so row numbering agrees
+everywhere), and plan_group allgathers the per-device request matrices (so
+each local shard knows which rows remote requesters want before the device
+all_to_all runs).  Each process materializes, serves, persists and
+checkpoints only its own shards; this is the reference's per-node sparse
+shard discipline (box_wrapper.h:415 MPI cluster membership) on the JAX
+coordination service.
+
 Plan layout over n shards, per-device key capacity K, bucket capacity C,
 US = n * C:
 
@@ -52,6 +63,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from paddlebox_tpu.config import SparseTableConfig
 from paddlebox_tpu.data.feed import HostBatch
 from paddlebox_tpu.parallel.mesh import DATA_AXIS
+from paddlebox_tpu.parallel.multiprocess import (
+    global_from_local,
+    host_allgather,
+    host_allgather_varlen,
+    is_multiprocess,
+    local_device_indices,
+    local_view,
+)
 from paddlebox_tpu.sparse.table import SparseTable, _next_pow2
 
 
@@ -59,7 +78,8 @@ from paddlebox_tpu.sparse.table import SparseTable, _next_pow2
 class ShardedBatchPlan:
     """Stacked host plans for one group of per-device batches.
 
-    Leading axis D == n_shards (one batch per device); sharded over the mesh.
+    Leading axis D == devices this process owns (== n_shards single-process);
+    stacked into the mesh-sharded feed by the trainer.
     """
 
     serve_rows: np.ndarray  # int32 [D, n, C]
@@ -91,12 +111,38 @@ class ShardedSparseTable(SparseTable):
         self.bucket_slack = float(bucket_slack)
         self._shard_keys: Optional[list[np.ndarray]] = None
         self.overflow_key_count = 0  # unique keys dropped by bucket overflow
+        # mesh positions (== global shard ids) whose devices this process
+        # owns; single-process: every position.  The want-matrix allgather in
+        # plan_group assumes each process's positions are one contiguous run
+        # in process order (JAX's default device order guarantees it).
+        self._local_pos = local_device_indices(mesh)
+        L = self._local_pos.shape[0]
+        pid = jax.process_index()
+        if not np.array_equal(
+            self._local_pos, np.arange(pid * L, pid * L + L)
+        ):
+            raise RuntimeError(
+                f"process {pid} owns non-contiguous mesh positions "
+                f"{self._local_pos.tolist()}: build the mesh from "
+                "jax.devices() default order"
+            )
+
+    @property
+    def n_local(self) -> int:
+        """Devices (== shards) owned by this process."""
+        return self._local_pos.shape[0]
 
     # -- pass lifecycle --------------------------------------------------- #
     def begin_pass(self, pass_keys: np.ndarray) -> None:
+        """Promote the pass working set (this process's shards) to device.
+
+        pass_keys: the keys THIS process saw in its dataset shard; the
+        global census is the allgather-union (multi-host collective #1).
+        """
         if self._in_pass:
             raise RuntimeError("end_pass the previous pass first")
         pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
+        pk = np.unique(host_allgather_varlen(pk))  # no-op single-process
         n = self.n_shards
         owner = (pk % np.uint64(n)).astype(np.int64)
         shard_keys = [pk[owner == o] for o in range(n)]  # each stays sorted
@@ -108,28 +154,38 @@ class ShardedSparseTable(SparseTable):
             row_within[m] = np.arange(int(m.sum()), dtype=np.int32)
         w = self.conf.row_width
         cap = _next_pow2(max((sk.shape[0] for sk in shard_keys), default=0) + 1)
-        vals = np.zeros((n, cap, w + 1), dtype=np.float32)
-        for o, sk in enumerate(shard_keys):
-            vals[o, : sk.shape[0]] = self._resolve_or_init(sk)
+        # materialize only the local shards: rows come from this process's
+        # host store (each process persists exactly its owned shards), and
+        # fresh keys init key-deterministically (_key_uniform), so any
+        # process layout produces identical row values
+        lvals = np.zeros((self.n_local, cap, w + 1), dtype=np.float32)
+        for i, o in enumerate(self._local_pos):
+            sk = shard_keys[o]
+            lvals[i, : sk.shape[0]] = self._resolve_or_init(sk)
         sharding = NamedSharding(self.mesh, P(DATA_AXIS))
-        self.values = jax.device_put(jnp.asarray(vals[:, :, :w]), sharding)
-        self.g2sum = jax.device_put(jnp.asarray(vals[:, :, w]), sharding)
+        self.values = global_from_local(sharding, jnp.asarray(lvals[:, :, :w]))
+        self.g2sum = global_from_local(sharding, jnp.asarray(lvals[:, :, w]))
         self._shard_keys = shard_keys
         self._pass_owner = owner.astype(np.int32)
         self._pass_row = row_within
         self._pass_keys = pk
         self._in_pass = True
-        self._delta_keys.append(pk)
+        self._delta_keys.append(
+            np.concatenate([shard_keys[o] for o in self._local_pos])
+            if is_multiprocess()
+            else pk
+        )
 
     def end_pass(self) -> None:
         if not self._in_pass:
             raise RuntimeError("no pass in flight")
-        vals = np.asarray(self.values)  # [n, cap, W]
-        g2 = np.asarray(self.g2sum)  # [n, cap]
-        for o, sk in enumerate(self._shard_keys):
+        vals = local_view(self.values)  # [L, cap, W]
+        g2 = local_view(self.g2sum)  # [L, cap]
+        for i, o in enumerate(self._local_pos):
+            sk = self._shard_keys[o]
             m = sk.shape[0]
             if m:
-                merged = np.concatenate([vals[o, :m], g2[o, :m, None]], axis=1)
+                merged = np.concatenate([vals[i, :m], g2[i, :m, None]], axis=1)
                 self._merge_into_store(sk, merged)
         self.values = None
         self.g2sum = None
@@ -140,17 +196,21 @@ class ShardedSparseTable(SparseTable):
         self._in_pass = False
 
     def pass_state_dict(self) -> dict:
-        """Mid-pass snapshot over the stacked [n_shards, cap, W] layout."""
+        """Mid-pass snapshot over the stacked [n_shards, cap, W] layout.
+
+        Multi-host: this process's shards only — checkpoints are per-process
+        sharded, the reference's per-node SaveBase discipline."""
         if not self._in_pass:
             return self.state_dict()
-        vals = np.asarray(self.values)
-        g2 = np.asarray(self.g2sum)
+        vals = local_view(self.values)
+        g2 = local_view(self.g2sum)
         keys, rows = [], []
-        for o, sk in enumerate(self._shard_keys):
+        for i, o in enumerate(self._local_pos):
+            sk = self._shard_keys[o]
             m = sk.shape[0]
             if m:
                 keys.append(sk)
-                rows.append(np.concatenate([vals[o, :m], g2[o, :m, None]], axis=1))
+                rows.append(np.concatenate([vals[i, :m], g2[i, :m, None]], axis=1))
         if not keys:
             return {
                 "keys": np.empty(0, np.uint64),
@@ -197,21 +257,24 @@ class ShardedSparseTable(SparseTable):
     def plan_group(
         self, batches: Sequence[HostBatch], bucket_capacity: Optional[int] = None
     ) -> ShardedBatchPlan:
-        """Resolve one per-device batch group into the stacked a2a plan."""
+        """Resolve one batch group (one batch per LOCAL device) into the
+        stacked a2a plan.  All plan arrays carry this process's leading axis
+        [L, ...]; multi-host, the per-device request matrices are allgathered
+        (collective #2) so each local shard knows every requester's rows."""
         if not self._in_pass:
             raise RuntimeError("begin_pass before planning batches")
-        if len(batches) != self.n_shards:
+        L = self.n_local
+        if len(batches) != L:
             raise ValueError(
-                f"need {self.n_shards} batches (one per device), got {len(batches)}"
+                f"need {L} batches (one per local device), got {len(batches)}"
             )
         K = batches[0].keys.shape[0]
         C = bucket_capacity or self.bucket_capacity(K)
         n = self.n_shards
-        D = len(batches)
         dead = self.shard_capacity - 1
-        want = np.full((D, n, C), dead, dtype=np.int32)
-        occ = np.full((D, K), n * C, dtype=np.int32)
-        mask = np.zeros((D, K), dtype=np.float32)
+        want = np.full((L, n, C), dead, dtype=np.int32)
+        occ = np.full((L, K), n * C, dtype=np.int32)
+        mask = np.zeros((L, K), dtype=np.float32)
         n_missing = n_overflow = 0
         for d, b in enumerate(batches):
             if b.n_keys == 0:
@@ -227,13 +290,18 @@ class ShardedSparseTable(SparseTable):
             flat = np.where(ok, owner * C + slot, n * C).astype(np.int32)
             occ[d, : b.n_keys] = flat[inv]
             mask[d, : b.n_keys] = 1.0
-        # the serve side: shard o serves want[:, o, :]; dedup rows so the
-        # push-side optimizer touches each row once (dead row shares one
-        # segment — it is scrubbed after every push anyway)
-        serve_rows = np.ascontiguousarray(want.transpose(1, 0, 2))  # [D, n, C]
-        serve_map = np.empty((D, n, C), dtype=np.int32)
-        serve_uniq = np.full((D, n * C), dead, dtype=np.int32)
-        for o in range(D):
+        # every requester's matrix, in mesh order (processes own contiguous
+        # runs — asserted in __init__); single-process: want itself
+        want_all = host_allgather(want).reshape(n, n, C)
+        # the serve side: local shard o serves want_all[:, o, :]; dedup rows
+        # so the push-side optimizer touches each row once (dead row shares
+        # one segment — it is scrubbed after every push anyway)
+        serve_rows = np.ascontiguousarray(
+            want_all[:, self._local_pos, :].transpose(1, 0, 2)
+        )  # [L, n, C]
+        serve_map = np.empty((L, n, C), dtype=np.int32)
+        serve_uniq = np.full((L, n * C), dead, dtype=np.int32)
+        for o in range(L):
             uq, inv = np.unique(serve_rows[o].reshape(-1), return_inverse=True)
             serve_uniq[o, : uq.shape[0]] = uq
             serve_map[o] = inv.reshape(n, C).astype(np.int32)

@@ -51,6 +51,12 @@ from paddlebox_tpu.metrics.auc import (
 from paddlebox_tpu.metrics.variants import MetricGroup
 from paddlebox_tpu.models.layers import bce_with_logits
 from paddlebox_tpu.parallel.mesh import DATA_AXIS
+from paddlebox_tpu.parallel.multiprocess import (
+    global_from_local,
+    host_allgather,
+    local_view,
+    read_replicated,
+)
 from paddlebox_tpu.parallel.sharded_table import ShardedBatchPlan, ShardedSparseTable
 from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
 from paddlebox_tpu.sparse.table import gather_rows, scatter_add_rows
@@ -191,6 +197,12 @@ class MultiChipTrainer:
         self.table_conf = table_conf
         self.mesh = mesh
         self.n_dev = int(mesh.devices.size)
+        # local (this-process) device count: feeds/params are assembled from
+        # per-process slices, so multi-host runs need no global host arrays
+        self.n_local = sum(
+            1 for d in mesh.devices.reshape(-1)
+            if d.process_index == jax.process_index()
+        )
         self.conf = trainer_conf or TrainerConfig()
         from paddlebox_tpu.models.layers import apply_compute_dtype_override
 
@@ -210,14 +222,16 @@ class MultiChipTrainer:
         p0 = model.init(jax.random.PRNGKey(seed))
         o0 = self.optimizer.init(p0)
         self._sharding = NamedSharding(mesh, P(DATA_AXIS))
-        stack = lambda t: jax.device_put(
-            jax.tree.map(lambda x: jnp.stack([x] * self.n_dev), t), self._sharding
+        stack = lambda t: global_from_local(
+            self._sharding,
+            jax.tree.map(lambda x: jnp.stack([x] * self.n_local), t),
         )
         self.params = stack(p0)
         self.opt_state = stack(o0)
         self._step_fn = None
         self._sync_fn = None
         self._eval_fn = None
+        self._copy_fn = None
         self.global_step = 0
 
     # -- jitted bodies ----------------------------------------------------- #
@@ -300,6 +314,11 @@ class MultiChipTrainer:
                 for leaf in jax.tree.leaves(pgrads):
                     finite &= jnp.isfinite(leaf).all()
                 finite &= jnp.isfinite(row_grads).all()
+                # globalize: every device (hence every process) sees the same
+                # verdict, so a multi-host raise can't strand the other ranks
+                # mid-collective
+                bad = jax.lax.psum((~finite).astype(jnp.int32), DATA_AXIS)
+                finite = bad == 0
             else:
                 finite = jnp.array(True)
             restack = lambda t: jax.tree.map(lambda x: x[None], t)
@@ -339,15 +358,18 @@ class MultiChipTrainer:
 
     # -- dense persistence -------------------------------------------------- #
     def dense_state(self) -> tuple:
-        """(params, opt_state) with the device axis dropped — replica 0 (in
-        kstep mode call sync first if drift matters)."""
-        take0 = lambda t: jax.tree.map(lambda x: np.asarray(x[0]), t)
+        """(params, opt_state) with the device axis dropped — this process's
+        first local replica (in kstep mode call sync first if drift
+        matters; in step mode every replica is identical)."""
+        take0 = lambda t: jax.tree.map(lambda x: local_view(x)[0], t)
         return take0(self.params), take0(self.opt_state)
 
     def load_dense_state(self, params, opt_state=None) -> None:
-        stack = lambda t: jax.device_put(
-            jax.tree.map(lambda x: jnp.stack([jnp.asarray(x)] * self.n_dev), t),
+        stack = lambda t: global_from_local(
             self._sharding,
+            jax.tree.map(
+                lambda x: jnp.stack([jnp.asarray(x)] * self.n_local), t
+            ),
         )
         if params is not None:
             self.params = stack(params)
@@ -355,11 +377,25 @@ class MultiChipTrainer:
             self.opt_state = stack(opt_state)
 
     # -- public API --------------------------------------------------------- #
-    def init_auc(self) -> AucState:
-        return jax.device_put(
-            stack_auc_states(init_auc_state(self.conf.auc_buckets), self.n_dev),
+    def _stack_local(self, tree):
+        """Stack one per-device copy for each LOCAL device and assemble the
+        global [n_dev, ...] mesh-sharded tree."""
+        return global_from_local(
             self._sharding,
+            jax.tree.map(lambda x: jnp.stack([x] * self.n_local), tree),
         )
+
+    def _copy_state(self, tree):
+        """Fresh buffers for a donated-state continuation (works on
+        non-fully-addressable multi-host arrays, where jnp.array would not)."""
+        if self._copy_fn is None:
+            self._copy_fn = jax.jit(
+                lambda t: jax.tree.map(lambda x: x + jnp.zeros((), x.dtype), t)
+            )
+        return self._copy_fn(tree)
+
+    def init_auc(self) -> AucState:
+        return self._stack_local(init_auc_state(self.conf.auc_buckets))
 
     def _init_mstate(self, auc_state=None) -> dict:
         """Per-device metric streams, each leaf stacked [n_dev, ...] and
@@ -368,7 +404,7 @@ class MultiChipTrainer:
             # the step donates mstate: copy so the caller's reference (often
             # trainer.last_metric_state itself) is not invalidated by the
             # first step's buffer donation
-            return jax.tree.map(jnp.array, auc_state)
+            return self._copy_state(auc_state)
         if auc_state is not None and (self.n_tasks > 1 or self.metric_group):
             raise ValueError(
                 "pass trainer.last_metric_state (dict) to continue metrics "
@@ -376,7 +412,7 @@ class MultiChipTrainer:
                 "streams while continuing the primary one"
             )
         mstate = {
-            "auc": jax.tree.map(jnp.array, auc_state)
+            "auc": self._copy_state(auc_state)
             if auc_state is not None
             else self.init_auc()
         }
@@ -384,14 +420,9 @@ class MultiChipTrainer:
             base = stack_auc_states(
                 init_auc_state(self.conf.auc_buckets), self.n_tasks
             )
-            mstate["task"] = jax.device_put(
-                stack_auc_states(base, self.n_dev), self._sharding
-            )
+            mstate["task"] = self._stack_local(base)
         if self.metric_group is not None:
-            mstate["group"] = jax.device_put(
-                stack_auc_states(self.metric_group.init_state(), self.n_dev),
-                self._sharding,
-            )
+            mstate["group"] = self._stack_local(self.metric_group.init_state())
         return mstate
 
     def train_from_dataset(
@@ -401,10 +432,14 @@ class MultiChipTrainer:
         auc_state: Optional[AucState] = None,
         drop_last: bool = False,
     ) -> dict:
-        """One pass over the dataset, n_dev batches at a time (the caller owns
-        begin_pass/end_pass, as in the single-chip Trainer)."""
+        """One pass over the dataset, one batch per LOCAL device at a time
+        (the caller owns begin_pass/end_pass, as in the single-chip Trainer).
+        Multi-host: each process feeds its own dataset shard; group counts
+        may differ across processes only by the ragged tail, which
+        train_groups pads to a common step count."""
         return self.train_groups(
-            table, _group_batches(dataset.batches(drop_last=drop_last), self.n_dev),
+            table,
+            _group_batches(dataset.batches(drop_last=drop_last), self.n_local),
             auc_state=auc_state,
         )
 
@@ -418,13 +453,39 @@ class MultiChipTrainer:
             self._step_fn = self._build_step()
         if self._sync_fn is None and self.conf.sync_dense_mode == "kstep":
             self._sync_fn = self._build_sync()
+        from paddlebox_tpu.parallel.multiprocess import is_multiprocess
+
+        multiproc = is_multiprocess()
         mstate = self._init_mstate(auc_state)
         values, g2sum = table.values, table.g2sum
         losses, counts, n_steps = [], [], 0
         n_slots = None
         uses_rank = getattr(self.model, "uses_rank_offset", False)
+        template = None  # last real batch: shapes for tail-padding groups
+        groups = iter(groups)
         try:
-            for group in groups:
+            while True:
+                group = next(groups, None)
+                if multiproc:
+                    # ragged-tail barrier: a process out of groups must keep
+                    # stepping with empty batches while any peer still has
+                    # data, or the peers hang in the next all_to_all
+                    left = host_allgather(
+                        np.asarray([0 if group is None else 1], np.int64)
+                    )
+                    if int(left.sum()) == 0:
+                        break
+                    if group is None:
+                        if template is None:
+                            raise RuntimeError(
+                                "this process received no batches at all: "
+                                "give every process at least one file"
+                            )
+                        group = [empty_like(template)] * self.n_local
+                    else:
+                        template = group[0]
+                elif group is None:
+                    break
                 if n_slots is None:
                     n_slots = group[0].n_sparse_slots
                 if uses_rank and group[0].rank_offset is None:
@@ -448,11 +509,13 @@ class MultiChipTrainer:
                     )
                 plan = table.plan_group(group)
                 feed = _stack_group(group, plan, n_slots, self.metric_group)
-                feed = jax.device_put(feed, self._sharding)
+                feed = global_from_local(self._sharding, feed)
                 (self.params, self.opt_state, values, g2sum, mstate, loss, cnt, finite) = (
                     self._step_fn(self.params, self.opt_state, values, g2sum, mstate, feed)
                 )
-                if self.conf.check_nan_inf and not bool(np.asarray(finite).all()):
+                if self.conf.check_nan_inf and not bool(
+                    local_view(finite).all()
+                ):
                     raise FloatingPointError(
                         f"non-finite loss/grad at step {self.global_step} "
                         "(FLAGS_check_nan_inf analog)"
@@ -473,29 +536,37 @@ class MultiChipTrainer:
             # hand the live ones back so end_pass() can salvage the pass even
             # when check_nan_inf raises mid-loop
             table.values, table.g2sum = values, g2sum
-        # cross-device merge: sum each stream's histograms over the device axis
-        merged = jax.tree.map(lambda x: np.asarray(x).sum(0), mstate["auc"])
+        # cross-device merge: sum each stream's histograms over the device
+        # axis (multi-host: jitted replicated sum + local read,
+        # collect_data_nccl analog)
+        from paddlebox_tpu.parallel.multiprocess import merge_device_axis
+
+        merged = merge_device_axis(mstate["auc"])
         metrics = compute_metrics(merged)
         if self.n_tasks > 1:
-            task_merged = jax.tree.map(
-                lambda x: np.asarray(x).sum(0), mstate["task"]
-            )
+            task_merged = merge_device_axis(mstate["task"])
             metrics.update(
                 compute_metrics_stacked(
                     task_merged, [f"task{t}" for t in range(self.n_tasks)]
                 )
             )
         if self.metric_group is not None:
-            group_merged = jax.tree.map(
-                lambda x: np.asarray(x).sum(0), mstate["group"]
-            )
+            group_merged = merge_device_axis(mstate["group"])
             metrics.update(self.metric_group.compute(group_merged))
         if losses:
-            per_step = np.stack([np.asarray(l) for l in losses])  # [T, D]
+            # [T, L] local views; multi-host: gather to [T, D]
+            per_step = np.stack([local_view(l) for l in losses])
+            cnts = np.stack([local_view(c) for c in counts])
+            if multiproc:
+                per_step = np.moveaxis(
+                    host_allgather(per_step), 0, 1
+                ).reshape(len(losses), -1)
+                cnts = np.moveaxis(
+                    host_allgather(cnts), 0, 1
+                ).reshape(len(counts), -1)
             if self.conf.sync_dense_mode == "kstep":
                 # local losses are local means: recombine weighted by real
                 # instance counts so padded empty batches don't bias the pass
-                cnts = np.stack([np.asarray(c) for c in counts])  # [T, D]
                 num = (per_step * cnts).sum(axis=1)
                 den = np.maximum(cnts.sum(axis=1), 1.0)
                 metrics["loss"] = float((num / den).mean())
@@ -547,10 +618,36 @@ class MultiChipTrainer:
         table/param updates, per-device AUC merged at the end."""
         if self._eval_fn is None:
             self._eval_fn = self._build_eval()
+        from paddlebox_tpu.parallel.multiprocess import (
+            is_multiprocess,
+            merge_device_axis,
+        )
+
+        multiproc = is_multiprocess()
         uses_rank = getattr(self.model, "uses_rank_offset", False)
         auc = self.init_auc()
         n_slots = None
-        for group in _group_batches(dataset.batches(drop_last=drop_last), self.n_dev):
+        template = None
+        groups = _group_batches(dataset.batches(drop_last=drop_last), self.n_local)
+        while True:
+            group = next(groups, None)
+            if multiproc:
+                left = host_allgather(
+                    np.asarray([0 if group is None else 1], np.int64)
+                )
+                if int(left.sum()) == 0:
+                    break
+                if group is None:
+                    if template is None:
+                        raise RuntimeError(
+                            "this process received no batches at all: "
+                            "give every process at least one file"
+                        )
+                    group = [empty_like(template)] * self.n_local
+                else:
+                    template = group[0]
+            elif group is None:
+                break
             if n_slots is None:
                 n_slots = group[0].n_sparse_slots
             if uses_rank and group[0].rank_offset is None:
@@ -560,10 +657,9 @@ class MultiChipTrainer:
                 )
             plan = table.plan_group(group)
             feed = _stack_group(group, plan, n_slots)
-            feed = jax.device_put(feed, self._sharding)
+            feed = global_from_local(self._sharding, feed)
             auc = self._eval_fn(self.params, table.values, auc, feed)
-        merged = jax.tree.map(lambda x: np.asarray(x).sum(0), auc)
-        return compute_metrics(merged)
+        return compute_metrics(merge_device_axis(auc))
 
 
 def _group_batches(

@@ -1,0 +1,103 @@
+"""Multi-process (multi-host tier) parity: N localhost processes x K virtual
+CPU devices each must reproduce the single-process N*K-device run.
+
+This is the reference's localhost-pserver test discipline
+(test_dist_base.py:754-900 spawns local subprocesses, :642 asserts dist loss
+== local loss) applied to the JAX coordination service: the parent runs the
+8-device single-process reference in-process (conftest's CPU mesh), then
+launches 2 ranks x 4 devices via paddlebox_tpu.launch running
+tests/_mp_child.py, and compares pass metrics + trained dense params.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import CtrDnn
+
+S, DENSE, B = 3, 2, 8
+HERE = os.path.dirname(__file__)
+
+
+def _write_data(tmp_path):
+    # 20 batches of 8 -> 2 full device groups + 1 ragged (padded) group
+    return write_synth_files(
+        str(tmp_path / "data"), n_files=4, ins_per_file=40, n_sparse_slots=S,
+        vocab_per_slot=200, dense_dim=DENSE, seed=3,
+    )
+
+
+def _reference_run(files):
+    """Single-process 8-device run (the 'local' side of the parity)."""
+    import jax
+
+    from paddlebox_tpu.parallel import (
+        MultiChipTrainer,
+        ShardedSparseTable,
+        make_mesh,
+    )
+
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B, max_feasigns_per_ins=16
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    mesh = make_mesh(8)
+    tconf = SparseTableConfig(embedding_dim=8)
+    trconf = TrainerConfig(auc_buckets=1 << 10)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(32, 16))
+    trainer = MultiChipTrainer(model, tconf, mesh, trconf, seed=0)
+    table = ShardedSparseTable(tconf, mesh, seed=0)
+    table.begin_pass(ds.unique_keys())
+    metrics = trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    ds.close()
+    params, _ = trainer.dense_state()
+    metrics["param_abs_sum"] = float(
+        sum(np.abs(np.asarray(l)).sum() for l in jax.tree.leaves(params))
+    )
+    metrics["total_features"] = table.n_features
+    return metrics
+
+
+@pytest.mark.slow
+def test_two_process_parity(tmp_path):
+    files = _write_data(tmp_path)
+    ref = _reference_run(files)
+
+    from paddlebox_tpu.launch import launch
+
+    out_json = str(tmp_path / "rank0.json")
+    log_dir = str(tmp_path / "logs")
+    rc = launch(
+        [os.path.join(HERE, "_mp_child.py"), os.path.dirname(files[0]), out_json],
+        nproc=2,
+        devices_per_proc=4,
+        log_dir=log_dir,
+    )
+    if rc != 0:
+        logs = "\n".join(
+            f"--- {f} ---\n" + open(os.path.join(log_dir, f)).read()[-3000:]
+            for f in sorted(os.listdir(log_dir))
+        )
+        pytest.fail(f"launch rc={rc}\n{logs}")
+    with open(out_json) as f:
+        got = json.load(f)
+
+    assert got["steps"] == ref["steps"]
+    assert got["count"] == ref["count"]
+    # same data, same deterministic key init, same collective math -> metrics
+    # agree to float tolerance; AUC histograms are integer so near-exact
+    assert np.isclose(got["loss"], ref["loss"], rtol=1e-4), (got, ref["loss"])
+    assert abs(got["auc"] - ref["auc"]) < 2e-3, (got["auc"], ref["auc"])
+    assert np.isclose(
+        got["param_abs_sum"], ref["param_abs_sum"], rtol=1e-4
+    ), (got["param_abs_sum"], ref["param_abs_sum"])
+    # the two rank-local stores partition the global feature census
+    assert got["total_features"] == ref["total_features"]
