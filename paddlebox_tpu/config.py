@@ -234,7 +234,11 @@ class SparseTableConfig:
 @dataclasses.dataclass
 class TrainerConfig:
     # dense sync cadence: psum gradients every step (sync_dense_mode="step"),
-    # or average params every K steps ("kstep", reference DenseKStepNode)
+    # average params every K steps ("kstep", reference DenseKStepNode), or
+    # "async": psummed grads feed a CPU-hosted AsyncDenseTable whose
+    # background thread applies the optimizer off the device critical path,
+    # with params re-pulled every sync_weight_step steps (reference
+    # BoxPSAsynDenseTable, boxps_worker.cc:37-297)
     sync_dense_mode: str = "step"
     sync_weight_step: int = 1
     # dense optimizer

@@ -1,6 +1,7 @@
 from paddlebox_tpu.parallel.mesh import make_mesh, initialize_distributed
 from paddlebox_tpu.parallel.sharded_table import ShardedSparseTable, ShardedBatchPlan
 from paddlebox_tpu.parallel.trainer import MultiChipTrainer
+from paddlebox_tpu.parallel.async_dense import AsyncDenseTable
 
 __all__ = [
     "make_mesh",
@@ -8,4 +9,5 @@ __all__ = [
     "ShardedSparseTable",
     "ShardedBatchPlan",
     "MultiChipTrainer",
+    "AsyncDenseTable",
 ]

@@ -1,0 +1,189 @@
+"""Async dense parameter server: CPU-hosted master params + background
+optimizer thread (the ``BoxPSAsynDenseTable`` analog, reference:
+boxps_worker.cc:37-297).
+
+The reference's async dense path exists because a big dense net's NCCL
+allreduce + optimizer can dominate the step: workers instead PUSH dense
+grads into a CPU double-buffered queue and a background thread applies the
+update sharded across threads, while training continues on slightly stale
+params; workers PULL fresh params every few steps.
+
+TPU translation (SURVEY.md §2.9 scopes this as optional-but-present):
+
+  * the device step still psums grads over the mesh (ICI is the right place
+    to aggregate), but applies NO dense optimizer on device — the jitted
+    step gets shorter, and the optimizer maths move off the critical path;
+  * ``push()`` enqueues the replicated grad (host numpy) into a bounded
+    queue — ``queue.Queue(maxsize=queue_depth)`` IS the reference's double
+    buffer: a full queue blocks the producer, bounding staleness exactly
+    like ``_buffer_size = 2`` does there (boxps_worker.cc:86);
+  * a daemon thread drains the queue and applies a numpy optimizer to the
+    master copy, leaf-sharded across a small pool (AsyncUpdate's sharded
+    worker loop, boxps_worker.cc:150-220) — numpy, not jax, so the update
+    never contends for the TPU or traces under jit;
+  * ``pull()`` snapshots the master params for the periodic device refresh
+    (the worker's PullDense every ``pull_interval`` steps).
+
+Staleness contract: with queue_depth q and pull_interval k, a step's params
+lag at most q + k pushes — same bound as the reference's double buffer +
+per-batch pull. Set pull_interval=1, queue_depth=1 for the tightest lag.
+
+The trainer integration (``sync_dense_mode="async"`` in MultiChipTrainer)
+keeps device dispatch asynchronous by fetching grads one step BEHIND: step
+t's grad transfer overlaps step t+1's compute, so the TPU never idles on a
+host round-trip.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _tree_leaves_np(tree: Any) -> list[np.ndarray]:
+    import jax
+
+    return [np.asarray(l) for l in jax.tree.leaves(tree)]
+
+
+class _NumpyAdam:
+    """optax.adam semantics (scale_by_adam: bias-corrected m/v) in numpy."""
+
+    def __init__(self, lr: float, b1: float = 0.9, b2: float = 0.999,
+                 eps: float = 1e-8):
+        self.lr, self.b1, self.b2, self.eps = lr, b1, b2, eps
+        self.m: Optional[list[np.ndarray]] = None
+        self.v: Optional[list[np.ndarray]] = None
+        self.t = 0
+
+    def init(self, leaves: list[np.ndarray]) -> None:
+        self.m = [np.zeros_like(l) for l in leaves]
+        self.v = [np.zeros_like(l) for l in leaves]
+
+    def update_leaf(self, i: int, param: np.ndarray, grad: np.ndarray) -> None:
+        m = self.m[i] = self.b1 * self.m[i] + (1 - self.b1) * grad
+        v = self.v[i] = self.b2 * self.v[i] + (1 - self.b2) * grad * grad
+        mh = m / (1 - self.b1 ** self.t)
+        vh = v / (1 - self.b2 ** self.t)
+        param -= self.lr * mh / (np.sqrt(vh) + self.eps)
+
+    def step_begin(self) -> None:
+        self.t += 1
+
+
+class _NumpySgd:
+    def __init__(self, lr: float):
+        self.lr = lr
+
+    def init(self, leaves: list[np.ndarray]) -> None:
+        pass
+
+    def update_leaf(self, i: int, param: np.ndarray, grad: np.ndarray) -> None:
+        param -= self.lr * grad
+
+    def step_begin(self) -> None:
+        pass
+
+
+class AsyncDenseTable:
+    """CPU master params + bounded grad queue + background update thread.
+
+    params: a pytree of arrays (the initial dense state). The table owns a
+    private copy; readers get snapshots via pull().
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        optimizer: str = "adam",
+        lr: float = 1e-3,
+        queue_depth: int = 2,
+        update_threads: int = 4,
+    ):
+        import jax
+
+        self._treedef = jax.tree.structure(params)
+        self._leaves = _tree_leaves_np(jax.tree.map(np.array, params))
+        if optimizer == "adam":
+            self._opt = _NumpyAdam(lr)
+        elif optimizer == "sgd":
+            self._opt = _NumpySgd(lr)
+        else:
+            raise ValueError(f"unknown async dense optimizer {optimizer!r}")
+        self._opt.init(self._leaves)
+        self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
+        self._lock = threading.Lock()  # guards _leaves vs pull()
+        self._pool = ThreadPoolExecutor(
+            max_workers=update_threads, thread_name_prefix="async-dense"
+        )
+        self._stop = False
+        self._err: Optional[BaseException] = None
+        self.pushes = 0  # grads enqueued
+        self.applied = 0  # grads folded into the master copy
+        self._thread = threading.Thread(
+            target=self._update_loop, name="async-dense-master", daemon=True
+        )
+        self._thread.start()
+
+    # -- worker-facing API -------------------------------------------------- #
+    def push(self, grads: Any) -> None:
+        """Enqueue one aggregated dense gradient (pytree or flat leaves).
+        Blocks when queue_depth grads are already in flight — the double
+        buffer's backpressure, which bounds staleness."""
+        if self._err is not None:
+            raise RuntimeError("async dense update thread died") from self._err
+        leaves = (
+            list(grads)
+            if isinstance(grads, list)
+            else _tree_leaves_np(grads)
+        )
+        self._q.put(leaves)
+        self.pushes += 1
+
+    def pull(self) -> Any:
+        """Snapshot of the master params as the original pytree structure."""
+        import jax
+
+        if self._err is not None:
+            raise RuntimeError("async dense update thread died") from self._err
+        with self._lock:
+            leaves = [l.copy() for l in self._leaves]
+        return jax.tree.unflatten(self._treedef, leaves)
+
+    def drain(self) -> None:
+        """Block until every pushed grad has been applied (pass boundary)."""
+        self._q.join()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._q.put(None)  # wake the thread
+        self._thread.join(timeout=10.0)
+        self._pool.shutdown(wait=False)
+
+    # -- background update -------------------------------------------------- #
+    def _update_loop(self) -> None:
+        try:
+            while True:
+                leaves = self._q.get()
+                if leaves is None or self._stop:
+                    self._q.task_done()
+                    return
+                self._opt.step_begin()
+                with self._lock:
+                    futures = [
+                        self._pool.submit(
+                            self._opt.update_leaf, i, self._leaves[i], g
+                        )
+                        for i, g in enumerate(leaves)
+                    ]
+                    for f in futures:
+                        f.result()
+                self.applied += 1
+                self._q.task_done()
+        except BaseException as e:  # surface on the next push/pull
+            self._err = e
+            self._q.task_done()

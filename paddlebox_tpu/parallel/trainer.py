@@ -232,6 +232,7 @@ class MultiChipTrainer:
         self._sync_fn = None
         self._eval_fn = None
         self._copy_fn = None
+        self.async_dense = None  # lazily created in "async" mode
         self.global_step = 0
 
     # -- jitted bodies ----------------------------------------------------- #
@@ -240,7 +241,13 @@ class MultiChipTrainer:
         tconf = self.table_conf
         optimizer = self.optimizer
         conf = self.conf
-        sync_step = conf.sync_dense_mode == "step"
+        # "async" shares the "step" loss/denominator math (psummed grads and
+        # loss, replicated across the axis) but applies NO dense optimizer on
+        # device: the psummed grad is returned for the host-side
+        # AsyncDenseTable push (reference: BoxPSAsynDenseTable, the NCCL
+        # aggregate feeding the CPU double buffer, boxps_worker.cc:37-297)
+        sync_step = conf.sync_dense_mode in ("step", "async")
+        async_dense = conf.sync_dense_mode == "async"
         check_nan = conf.check_nan_inf
         uses_rank = getattr(model, "uses_rank_offset", False)
         n_tasks = self.n_tasks
@@ -287,8 +294,9 @@ class MultiChipTrainer:
                 pgrads = jax.lax.psum(pgrads, DATA_AXIS)
                 loss = jax.lax.psum(loss, DATA_AXIS)
 
-            updates, opt_state = optimizer.update(pgrads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            if not async_dense:
+                updates, opt_state = optimizer.update(pgrads, opt_state, params)
+                params = optax.apply_updates(params, updates)
             values, g2sum = sharded_push_and_update(
                 values, g2sum, row_grads, batch["occ_flat"], batch["serve_map"],
                 batch["serve_uniq"], batch["key_mask"], batch["key_clicks"], tconf,
@@ -323,17 +331,21 @@ class MultiChipTrainer:
                 finite = jnp.array(True)
             restack = lambda t: jax.tree.map(lambda x: x[None], t)
             cnt = batch["ins_mask"].sum()
-            return (
+            out = (
                 restack(params), restack(opt_state), values[None], g2sum[None],
                 restack(mstate), loss[None], cnt[None], finite[None],
             )
+            if async_dense:
+                out = out + (restack(pgrads),)
+            return out
 
         spec = P(DATA_AXIS)
+        n_out = 9 if async_dense else 8
         mapped = shard_map(
             body,
             mesh=self.mesh,
             in_specs=(spec, spec, spec, spec, spec, spec),
-            out_specs=(spec, spec, spec, spec, spec, spec, spec, spec),
+            out_specs=(spec,) * n_out,
         )
         return jax.jit(mapped, donate_argnums=(0, 1, 2, 3, 4))
 
@@ -393,6 +405,17 @@ class MultiChipTrainer:
                 lambda t: jax.tree.map(lambda x: x + jnp.zeros((), x.dtype), t)
             )
         return self._copy_fn(tree)
+
+    def _push_async_grad(self, g) -> None:
+        """Hand one replicated [D, ...] grad tree to the host table (reads
+        this process's first shard — the psum made every shard identical)."""
+        self.async_dense.push(jax.tree.map(lambda x: local_view(x)[0], g))
+
+    def close(self) -> None:
+        """Stop background machinery (the async dense update thread)."""
+        if self.async_dense is not None:
+            self.async_dense.stop()
+            self.async_dense = None
 
     def init_auc(self) -> AucState:
         return self._stack_local(init_auc_state(self.conf.auc_buckets))
@@ -456,6 +479,19 @@ class MultiChipTrainer:
         from paddlebox_tpu.parallel.multiprocess import is_multiprocess
 
         multiproc = is_multiprocess()
+        async_dense = self.conf.sync_dense_mode == "async"
+        if async_dense and self.async_dense is None:
+            from paddlebox_tpu.parallel.async_dense import AsyncDenseTable
+
+            # every process hosts an identical table fed identical replicated
+            # grads, so multi-host needs no extra dense comm (the reference
+            # runs one table per node the same way)
+            p0 = jax.tree.map(lambda x: local_view(x)[0], self.params)
+            self.async_dense = AsyncDenseTable(
+                p0, optimizer=self.conf.dense_optimizer, lr=self.conf.dense_lr,
+            )
+        pending_grads: list = []  # device grads fetched one step behind
+        pull_every = max(self.conf.sync_weight_step, 1)
         mstate = self._init_mstate(auc_state)
         values, g2sum = table.values, table.g2sum
         losses, counts, n_steps = [], [], 0
@@ -510,9 +546,20 @@ class MultiChipTrainer:
                 plan = table.plan_group(group)
                 feed = _stack_group(group, plan, n_slots, self.metric_group)
                 feed = global_from_local(self._sharding, feed)
-                (self.params, self.opt_state, values, g2sum, mstate, loss, cnt, finite) = (
-                    self._step_fn(self.params, self.opt_state, values, g2sum, mstate, feed)
+                out = self._step_fn(
+                    self.params, self.opt_state, values, g2sum, mstate, feed
                 )
+                (self.params, self.opt_state, values, g2sum, mstate, loss,
+                 cnt, finite) = out[:8]
+                if async_dense:
+                    # push one step BEHIND: step t's grad is already computed
+                    # when step t+1 dispatches, so reading it never stalls
+                    # the device pipeline
+                    pending_grads.append(out[8])
+                    if len(pending_grads) > 1:
+                        self._push_async_grad(pending_grads.pop(0))
+                    if (self.global_step + 1) % pull_every == 0:
+                        self.params = self._stack_local(self.async_dense.pull())
                 if self.conf.check_nan_inf and not bool(
                     local_view(finite).all()
                 ):
@@ -531,6 +578,14 @@ class MultiChipTrainer:
                     self.params, self.opt_state = self._sync_fn(
                         self.params, self.opt_state
                     )
+            if async_dense:
+                # pass boundary: flush the lagged grad, wait for the master
+                # copy to absorb everything, refresh device params
+                for g in pending_grads:
+                    self._push_async_grad(g)
+                pending_grads.clear()
+                self.async_dense.drain()
+                self.params = self._stack_local(self.async_dense.pull())
         finally:
             # the old table buffers were donated to the jitted step: always
             # hand the live ones back so end_pass() can salvage the pass even

@@ -1,0 +1,142 @@
+"""Async dense parameter server (BoxPSAsynDenseTable analog) tests."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.parallel.async_dense import AsyncDenseTable
+
+
+def _params():
+    rng = np.random.default_rng(0)
+    return {
+        "w": rng.normal(size=(4, 3)).astype(np.float32),
+        "b": np.zeros(3, dtype=np.float32),
+    }
+
+
+class TestAsyncDenseTable:
+    def test_sgd_matches_serial(self):
+        p0 = _params()
+        table = AsyncDenseTable(p0, optimizer="sgd", lr=0.1)
+        grads = [
+            {"w": np.full((4, 3), g, np.float32), "b": np.full(3, g, np.float32)}
+            for g in (1.0, -0.5, 0.25)
+        ]
+        for g in grads:
+            table.push(g)
+        table.drain()
+        got = table.pull()
+        table.stop()
+        want_w = p0["w"] - 0.1 * (1.0 - 0.5 + 0.25)
+        np.testing.assert_allclose(got["w"], want_w, rtol=1e-6)
+        assert table.pushes == 3 and table.applied == 3
+
+    def test_adam_matches_optax(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        p0 = _params()
+        lr = 0.01
+        table = AsyncDenseTable(p0, optimizer="adam", lr=lr)
+        opt = optax.adam(lr)
+        ref = jax.tree.map(jnp.asarray, p0)
+        state = opt.init(ref)
+        rng = np.random.default_rng(1)
+        for _ in range(5):
+            g = {
+                "w": rng.normal(size=(4, 3)).astype(np.float32),
+                "b": rng.normal(size=3).astype(np.float32),
+            }
+            table.push(g)
+            updates, state = opt.update(
+                jax.tree.map(jnp.asarray, g), state, ref
+            )
+            ref = optax.apply_updates(ref, updates)
+        table.drain()
+        got = table.pull()
+        table.stop()
+        np.testing.assert_allclose(got["w"], np.asarray(ref["w"]), rtol=1e-4,
+                                   atol=1e-6)
+        np.testing.assert_allclose(got["b"], np.asarray(ref["b"]), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_pull_is_snapshot(self):
+        table = AsyncDenseTable(_params(), optimizer="sgd", lr=1.0)
+        snap = table.pull()
+        table.push({"w": np.ones((4, 3), np.float32),
+                    "b": np.ones(3, np.float32)})
+        table.drain()
+        after = table.pull()
+        table.stop()
+        assert not np.allclose(snap["w"], after["w"])
+
+    def test_error_surfaces_on_push(self):
+        table = AsyncDenseTable(_params(), optimizer="sgd", lr=1.0)
+        # wrong leaf count kills the update thread; next ops must raise
+        table.push([np.ones(3, np.float32)] * 5)
+        table._thread.join(timeout=5.0)
+        with pytest.raises(RuntimeError):
+            table.push({"w": np.ones((4, 3), np.float32),
+                        "b": np.ones(3, np.float32)})
+
+
+class TestAsyncTrainingMode:
+    def test_multichip_async_learns(self):
+        """Full multi-chip pass in sync_dense_mode='async': machinery works,
+        staleness-bounded updates still learn on the synthetic task."""
+        import tempfile
+
+        from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+        from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+        from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+        from paddlebox_tpu.models import CtrDnn
+        from paddlebox_tpu.parallel import (
+            MultiChipTrainer,
+            ShardedSparseTable,
+            make_mesh,
+        )
+
+        S, DENSE, B, n_dev = 3, 2, 8, 8
+        conf = make_synth_config(
+            n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+            max_feasigns_per_ins=16,
+        )
+        tconf = SparseTableConfig(embedding_dim=8)
+        trconf = TrainerConfig(
+            auc_buckets=1 << 10, sync_dense_mode="async", sync_weight_step=2,
+        )
+        mesh = make_mesh(n_dev)
+        model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(32, 16))
+        trainer = MultiChipTrainer(model, tconf, mesh, trconf, seed=0)
+        table = ShardedSparseTable(tconf, mesh, seed=0)
+        with tempfile.TemporaryDirectory() as td:
+            files = write_synth_files(
+                td, n_files=2, ins_per_file=400, n_sparse_slots=S,
+                vocab_per_slot=100, dense_dim=DENSE, seed=5,
+            )
+            ds = PadBoxSlotDataset(conf, read_threads=1)
+            ds.set_filelist(files)
+            ds.load_into_memory()
+            auc_state = None
+            for _ in range(3):  # multiple passes: re-pull + continue
+                table.begin_pass(ds.unique_keys())
+                metrics = trainer.train_from_dataset(
+                    ds, table, auc_state=auc_state
+                )
+                auc_state = trainer.last_metric_state
+                table.end_pass()
+            ds.close()
+        assert trainer.async_dense is not None
+        assert trainer.async_dense.pushes == trainer.async_dense.applied > 0
+        # every step's grad was pushed (lagged by one, flushed at pass end)
+        assert trainer.async_dense.pushes == trainer.global_step
+        assert np.isfinite(metrics["loss"])
+        # async training is timing-nondeterministic by design (a pull races
+        # the background apply — same in the reference's double buffer), so
+        # assert a margin that holds across schedules: clearly better than
+        # random on the learnable synth task
+        assert metrics["auc"] > 0.52, metrics
+        assert metrics["loss"] < 0.693, metrics  # below untrained BCE
+        trainer.close()
+        assert trainer.async_dense is None
