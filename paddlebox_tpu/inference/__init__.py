@@ -1,0 +1,4 @@
+from paddlebox_tpu.inference.export import export_model
+from paddlebox_tpu.inference.predictor import Predictor
+
+__all__ = ["export_model", "Predictor"]

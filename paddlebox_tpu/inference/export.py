@@ -1,0 +1,112 @@
+"""Model export: a self-contained serving artifact.
+
+The reference ships a full C++ inference stack
+(/root/reference/paddle/fluid/inference/, ~37k LoC: analysis passes, a
+NativePredictor/AnalysisPredictor pair, C/Go/R client bindings) because its
+serving path must re-execute the fluid graph outside the trainer.  On TPU
+the trained step is already one compiled XLA program, so export collapses
+to:
+
+  * ``serving.stablehlo`` — the forward function, lowered and serialized
+    with ``jax.export``.  Dense params are closed over as constants, so the
+    blob is self-contained: serving needs NO Python model code, only JAX (or
+    any StableHLO runtime) — the analog of the reference's frozen
+    ``__model__`` + param files (save_inference_model,
+    python/paddle/fluid/io.py).
+  * ``sparse/keys.npy + values.npy`` — the embedding table snapshot (the
+    xbox-base dump the reference's serving-side PS loads); show/clk
+    counters are kept so feature-admission (create_threshold) behaves
+    exactly as in training.
+  * ``meta.json`` — shapes + CVM layout the predictor needs to resolve
+    batches.
+
+Layout-stable: everything is numpy + JSON + StableHLO; no pickled pytrees.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def export_model(
+    model,
+    params,
+    table,
+    out_dir: str,
+    *,
+    batch_size: int,
+    key_capacity: int,
+    dense_dim: int,
+) -> None:
+    """Write a serving artifact for ``model`` + ``table`` to ``out_dir``.
+
+    params: the trained dense pytree (e.g. ``trainer.params``; for a
+    MultiChipTrainer pass ``trainer.dense_state()[0]``).
+    table: SparseTable/ShardedSparseTable OUTSIDE a pass (end_pass first) —
+    its host store is snapshotted.  Multi-host callers export per-process
+    shard files (rank in the filename) and merge at load.
+    """
+    if getattr(model, "uses_rank_offset", False):
+        raise NotImplementedError(
+            "rank_offset-consuming models need the PV-merged serving feed; "
+            "export only the standard feed models for now"
+        )
+    conf = table.conf
+    os.makedirs(out_dir, exist_ok=True)
+    os.makedirs(os.path.join(out_dir, "sparse"), exist_ok=True)
+
+    # sparse snapshot (sorted keys + full value rows, g2sum dropped: the
+    # optimizer state has no serving meaning)
+    state = table.state_dict()
+    w = conf.row_width
+    pid = jax.process_index()
+    np.save(os.path.join(out_dir, "sparse", f"keys-{pid:05d}.npy"),
+            np.asarray(state["keys"], dtype=np.uint64))
+    np.save(os.path.join(out_dir, "sparse", f"values-{pid:05d}.npy"),
+            np.asarray(state["values"], dtype=np.float32)[:, :w])
+
+    # the forward program, params frozen in as constants
+    B, K = batch_size, key_capacity
+    frozen = jax.tree.map(jnp.asarray, params)
+
+    def serve(rows, key_segments, dense):
+        logits = model.apply(frozen, rows, key_segments, dense, B)
+        return jax.nn.sigmoid(logits)
+
+    if pid != 0:
+        return  # replicated artifacts are rank 0's to write (multi-host:
+        # every rank contributed its sparse shard above; the program and
+        # meta are identical everywhere — same convention as checkpoint.py)
+    # lower for both serving platforms: a TPU-trained artifact must run on
+    # a CPU-only serving host too
+    exp = jax.export.export(jax.jit(serve), platforms=("cpu", "tpu"))(
+        jax.ShapeDtypeStruct((K, w), jnp.float32),
+        jax.ShapeDtypeStruct((K,), jnp.int32),
+        jax.ShapeDtypeStruct((B, dense_dim), jnp.float32),
+    )
+    with open(os.path.join(out_dir, "serving.stablehlo"), "wb") as f:
+        f.write(exp.serialize())
+
+    n_tasks = int(getattr(model, "n_tasks", 1))
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "model_class": type(model).__name__,
+        "batch_size": B,
+        "key_capacity": K,
+        "dense_dim": dense_dim,
+        "n_sparse_slots": int(getattr(model, "n_sparse_slots", 0)),
+        "n_tasks": n_tasks,
+        "row_width": w,
+        "cvm_offset": conf.cvm_offset,
+        "create_threshold": conf.create_threshold,
+        "pull_embedx_scale": conf.pull_embedx_scale,
+    }
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)

@@ -1,0 +1,103 @@
+"""Serving-side predictor: loads an export_model artifact and scores batches.
+
+The AnalysisPredictor analog (reference:
+/root/reference/paddle/fluid/inference/api/analysis_predictor.cc — load
+frozen program + params, feed named tensors, fetch outputs), reduced to the
+TPU-native essentials: deserialize the StableHLO program (params inside),
+resolve sparse keys against the table snapshot on the host, run.
+
+The embedding resolve duplicates training's pull semantics exactly
+(sparse/table.py pull_rows): missing/padding keys read zero rows,
+create_threshold hides embeddings of under-shown features, and
+pull_embedx_scale descales a quantized table — all applied here on the
+host gather since serving has no device-resident table.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Iterator
+
+import numpy as np
+
+from paddlebox_tpu.data.feed import HostBatch
+
+
+class Predictor:
+    def __init__(self, meta: dict, keys: np.ndarray, values: np.ndarray,
+                 exported) -> None:
+        self.meta = meta
+        self._keys = keys  # sorted uint64
+        self._values = values  # [n, W] f32
+        self._exported = exported
+        self._call = exported.call
+
+    @classmethod
+    def load(cls, artifact_dir: str) -> "Predictor":
+        import jax
+
+        with open(os.path.join(artifact_dir, "meta.json")) as f:
+            meta = json.load(f)
+        key_files = sorted(glob.glob(os.path.join(artifact_dir, "sparse", "keys-*.npy")))
+        val_files = sorted(glob.glob(os.path.join(artifact_dir, "sparse", "values-*.npy")))
+        keys = np.concatenate([np.load(p) for p in key_files])
+        values = np.concatenate([np.load(p) for p in val_files])
+        order = np.argsort(keys)  # per-process shards -> one sorted table
+        keys, values = keys[order], values[order]
+        with open(os.path.join(artifact_dir, "serving.stablehlo"), "rb") as f:
+            exported = jax.export.deserialize(f.read())
+        return cls(meta, keys, values, exported)
+
+    # -- feature resolve (host) -------------------------------------------- #
+    def _resolve_rows(self, batch_keys: np.ndarray, n_keys: int) -> np.ndarray:
+        m = self.meta
+        K, W = m["key_capacity"], m["row_width"]
+        rows = np.zeros((K, W), dtype=np.float32)
+        if n_keys and self._keys.shape[0]:
+            bk = batch_keys[:n_keys]
+            pos = np.searchsorted(self._keys, bk)
+            pos_c = np.minimum(pos, self._keys.shape[0] - 1)
+            found = self._keys[pos_c] == bk
+            got = self._values[pos_c] * found[:, None]
+            co = m["cvm_offset"]
+            if m["pull_embedx_scale"] != 1.0:
+                got[:, co + 1 :] *= m["pull_embedx_scale"]
+            if m["create_threshold"] > 0.0:
+                visible = got[:, 0] >= m["create_threshold"]
+                got[:, co:] *= visible[:, None]
+            rows[:n_keys] = got
+        return rows
+
+    # -- scoring ------------------------------------------------------------ #
+    def predict(self, batch: HostBatch) -> np.ndarray:
+        """Probabilities for the batch's REAL instances: [b] (primary task)
+        or [b, n_tasks]."""
+        m = self.meta
+        if batch.batch_size != m["batch_size"]:
+            raise ValueError(
+                f"artifact was exported for batch_size={m['batch_size']}, "
+                f"got {batch.batch_size}"
+            )
+        if batch.keys.shape[0] != m["key_capacity"]:
+            raise ValueError(
+                f"artifact was exported for key_capacity={m['key_capacity']}, "
+                f"got a batch with key buffer {batch.keys.shape[0]} — set "
+                "DataFeedConfig.batch_key_capacity to match the export"
+            )
+        rows = self._resolve_rows(batch.keys, batch.n_keys)
+        preds = np.asarray(
+            self._call(
+                rows,
+                np.asarray(batch.key_segments, np.int32),
+                np.asarray(batch.dense, np.float32),
+            )
+        )
+        b = int(batch.ins_mask.sum())
+        return preds[:b]
+
+    def predict_dataset(self, dataset) -> Iterator[np.ndarray]:
+        """Score every batch of a loaded dataset (drop_last=False)."""
+        for batch in dataset.batches(drop_last=False):
+            yield self.predict(batch)

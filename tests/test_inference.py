@@ -1,0 +1,132 @@
+"""Export/serving tests: train -> export_model -> Predictor parity."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.inference import Predictor, export_model
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train.trainer import Trainer
+
+S, DENSE, B = 3, 2, 8
+
+
+def _train_small(td, create_threshold=0.0):
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B, max_feasigns_per_ins=16
+    )
+    files = write_synth_files(
+        td, n_files=1, ins_per_file=64, n_sparse_slots=S, vocab_per_slot=50,
+        dense_dim=DENSE, seed=11,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    tconf = SparseTableConfig(
+        embedding_dim=8, create_threshold=create_threshold
+    )
+    trconf = TrainerConfig(auc_buckets=1 << 10)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(16, 8))
+    table = SparseTable(tconf, seed=0)
+    trainer = Trainer(model, tconf, trconf, seed=0)
+    table.begin_pass(ds.unique_keys())
+    trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    return conf, ds, model, table, trainer
+
+
+def test_export_predict_parity(tmp_path):
+    """Predictor output == trainer-side forward on the same batch."""
+    import jax
+    import jax.numpy as jnp
+
+    conf, ds, model, table, trainer = _train_small(str(tmp_path / "data"))
+    art = str(tmp_path / "artifact")
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    export_model(
+        model, trainer.params, table, art,
+        batch_size=B, key_capacity=kcap, dense_dim=DENSE,
+    )
+    assert os.path.exists(os.path.join(art, "serving.stablehlo"))
+    assert os.path.exists(os.path.join(art, "meta.json"))
+
+    pred = Predictor.load(art)
+    batch = next(ds.batches(drop_last=False))
+    got = pred.predict(batch)
+    assert got.shape[0] == int(batch.ins_mask.sum())
+
+    # trainer-side reference forward: resolve rows through the live table
+    table.begin_pass(table.state_dict()["keys"])
+    plan = table.plan_batch(batch)
+    from paddlebox_tpu.sparse.table import pull_rows
+
+    rows = pull_rows(table.values, jnp.asarray(plan.idx))
+    logits = model.apply(
+        trainer.params, rows, jnp.asarray(batch.key_segments),
+        jnp.asarray(batch.dense), B,
+    )
+    want = np.asarray(jax.nn.sigmoid(logits))[: got.shape[0]]
+    table.end_pass()
+    ds.close()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predict_unseen_keys_and_batch_size_guard(tmp_path):
+    conf, ds, model, table, trainer = _train_small(str(tmp_path / "data"))
+    art = str(tmp_path / "artifact")
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    export_model(
+        model, trainer.params, table, art,
+        batch_size=B, key_capacity=kcap, dense_dim=DENSE,
+    )
+    pred = Predictor.load(art)
+    batch = next(ds.batches(drop_last=False))
+    # poison the keys: unseen features must resolve to zero rows, not crash
+    batch.keys = batch.keys + np.uint64(10_000_000)
+    out = pred.predict(batch)
+    assert np.all(np.isfinite(out)) and out.shape[0] > 0
+
+    batch.batch_size = B + 1
+    with pytest.raises(ValueError):
+        pred.predict(batch)
+    ds.close()
+
+
+def test_predict_dataset_streams_all(tmp_path):
+    conf, ds, model, table, trainer = _train_small(str(tmp_path / "data"))
+    art = str(tmp_path / "artifact")
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    export_model(
+        model, trainer.params, table, art,
+        batch_size=B, key_capacity=kcap, dense_dim=DENSE,
+    )
+    pred = Predictor.load(art)
+    total = sum(p.shape[0] for p in pred.predict_dataset(ds))
+    assert total == 64
+    ds.close()
+
+
+def test_export_respects_create_threshold(tmp_path):
+    """Feature admission carries into serving: under-shown features read
+    zero embeddings through the predictor's host resolve."""
+    conf, ds, model, table, trainer = _train_small(
+        str(tmp_path / "data"), create_threshold=1e9  # nothing admitted
+    )
+    art = str(tmp_path / "artifact")
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    export_model(
+        model, trainer.params, table, art,
+        batch_size=B, key_capacity=kcap, dense_dim=DENSE,
+    )
+    pred = Predictor.load(art)
+    batch = next(ds.batches(drop_last=False))
+    rows = pred._resolve_rows(batch.keys, batch.n_keys)
+    co = pred.meta["cvm_offset"]
+    assert np.all(rows[:, co:] == 0.0)  # embeddings hidden
+    assert rows[:, :co].any()  # counters still visible
+    ds.close()
