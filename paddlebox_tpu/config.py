@@ -268,6 +268,14 @@ class TrainerConfig:
     compute_dtype: str = ""
     # nan check after each batch (reference: FLAGS_check_nan_inf)
     check_nan_inf: bool = False
+    # device-feed double buffering: a background thread runs key planning +
+    # host->device transfer for the next batches while the current step
+    # computes, bounded at this queue depth (the pinned-arena/double-buffered
+    # staging analog, SURVEY.md §2.3 — reference data_feed pipelines blocks
+    # through SlotObjPool + a CUDA copy stream).  0 = serial feed; profiling
+    # (profile=True) always runs serial so the plan/feed/step split stays
+    # honest.
+    prefetch_batches: int = 2
     # per-stage host timing (reference: TrainFilesWithProfiler — a slower
     # diagnostic mode: the device step is synchronized every batch)
     profile: bool = False

@@ -94,6 +94,66 @@ def _device_batch(
     return dev
 
 
+class _FeedPrefetcher:
+    """Bounded background feed assembly: the producer thread runs host key
+    planning + H2D staging up to ``depth`` batches ahead of the consumer
+    (the pinned-arena double buffer of SURVEY.md §2.3, as a thread + queue;
+    JAX's device_put already stages through pinned runtime buffers, so the
+    missing piece was only the OVERLAP, provided here).  Exceptions raised
+    by the producer re-raise at the consumer's next() call."""
+
+    _SENTINEL = object()
+
+    def __init__(self, gen, depth: int):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._stop = False
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._run, args=(gen,), name="feed-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, gen) -> None:
+        try:
+            for item in gen:
+                if self._stop:
+                    return
+                self._q.put(item)
+            self._q.put(self._SENTINEL)
+        except BaseException as e:  # surfaced to the consumer
+            self._q.put(e)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:  # keep raising after exhaustion/producer death —
+            raise StopIteration  # the producer will never put again
+        item = self._q.get()
+        if item is self._SENTINEL:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._done = True
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Unblock and retire the producer (call on early exit)."""
+        import queue
+
+        self._stop = True
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+
 class Trainer:
     """Drives model + SparseTable over a dataset's batches."""
 
@@ -283,8 +343,9 @@ class Trainer:
 
         prof = StepProfiler() if self.conf.profile else NullProfiler()
 
-        try:
-          with device_trace(self.conf.trace_dir or None):
+        def feeds():
+            """(batch, device feed) stream: validation + host planning + H2D
+            staging.  Runs inline, or on the prefetch thread when enabled."""
             for batch in dataset.batches(drop_last=drop_last):
                 if uses_rank and batch.rank_offset is None:
                     raise RuntimeError(
@@ -316,6 +377,25 @@ class Trainer:
                         dev["metric_masks"] = jnp.asarray(
                             self.metric_group.masks(batch)
                         )
+                yield batch, dev
+
+        # profiling/tracing keep the serial path so the plan/feed/step split
+        # (and the captured timeline) stay honest; otherwise feed assembly
+        # overlaps the device step
+        prefetcher = None
+        if (
+            self.conf.prefetch_batches > 0
+            and not prof.enabled
+            and not self.conf.trace_dir
+        ):
+            prefetcher = _FeedPrefetcher(feeds(), self.conf.prefetch_batches)
+            feed_iter = prefetcher
+        else:
+            feed_iter = feeds()
+
+        try:
+          with device_trace(self.conf.trace_dir or None):
+            for batch, dev in feed_iter:
                 with prof.stage("step"):
                     (self.params, self.opt_state, values, g2sum, mstate,
                      loss, finite, preds) = (
@@ -340,6 +420,8 @@ class Trainer:
             # old buffers were donated to the jitted step: always hand the
             # live ones back so end_pass() works even after a NaN raise
             table.values, table.g2sum = values, g2sum
+            if prefetcher is not None:
+                prefetcher.close()
             if dumper is not None:
                 dumper.close()
         if self.conf.need_dump_param and self.conf.dump_fields_path:

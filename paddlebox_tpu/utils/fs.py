@@ -1,0 +1,200 @@
+"""Remote filesystem surface: ls/exists/upload/download for HDFS/AFS.
+
+The ``BoxFileMgr`` analog (reference: fleet/box_wrapper.h:788-812 — a thin
+veneer over libbox_ps giving the trainer ls/exists/upload/download/remove on
+AFS — and framework/io/fs.{h,cc}, whose hadoop path shells out to the
+``hadoop fs`` CLI with retries exactly as done here; the python side is
+fleet_util's HDFSClient).  The READ path for training data does not need
+this surface: ``DataFeedConfig.pipe_command="hadoop fs -cat ..."`` streams
+files through the parser (data/slot_parser.py).  This module serves the
+WRITE/admin path — publishing checkpoints, donefiles, dumps — plus remote
+listing for filelist construction.
+
+Two implementations behind one duck-typed surface:
+
+  * ``LocalFS``  — os/shutil, for tests and single-host runs.
+  * ``HadoopFS`` — subprocess ``hadoop fs`` (the reference's own transport;
+    there is no hdfs wire-protocol client in this image and none is needed:
+    checkpoint publishing is minutes-granular, fork cost is irrelevant).
+
+``resolve_fs(path)`` picks by scheme: ``hdfs://`` / ``afs://`` ->
+HadoopFS configured from PBOX_HADOOP_BIN / PBOX_FS_NAME / PBOX_FS_UGI env
+(the reference's fs.default.name / hadoop.job.ugi job confs), anything else
+-> LocalFS.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+
+class FsError(RuntimeError):
+    pass
+
+
+class LocalFS:
+    """Local filesystem with the same surface as HadoopFS."""
+
+    def ls(self, path: str) -> list[str]:
+        if not os.path.isdir(path):
+            raise FsError(f"ls: not a directory: {path}")
+        return sorted(
+            os.path.join(path, name) for name in os.listdir(path)
+        )
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def is_dir(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+    def mkdir(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def upload(self, local: str, remote: str) -> None:
+        self.mkdir(os.path.dirname(remote) or ".")
+        if os.path.isdir(local):
+            shutil.copytree(local, remote, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local, remote)
+
+    def download(self, remote: str, local: str) -> None:
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        if os.path.isdir(remote):
+            shutil.copytree(remote, local, dirs_exist_ok=True)
+        else:
+            shutil.copy2(remote, local)
+
+    def rm(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def touch(self, path: str) -> None:
+        self.mkdir(os.path.dirname(path) or ".")
+        with open(path, "a"):
+            pass
+
+    def cat(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class HadoopFS:
+    """``hadoop fs`` CLI transport (reference: framework/io/fs.cc hadoop
+    commands; HDFSClient in fleet_util).  Every call shells one command with
+    the job confs prepended and retries transient failures."""
+
+    def __init__(
+        self,
+        fs_name: str = "",
+        fs_ugi: str = "",
+        hadoop_bin: Optional[str] = None,
+        retries: int = 2,
+    ):
+        self.hadoop_bin = hadoop_bin or os.environ.get(
+            "PBOX_HADOOP_BIN", "hadoop"
+        )
+        self.fs_name = fs_name or os.environ.get("PBOX_FS_NAME", "")
+        self.fs_ugi = fs_ugi or os.environ.get("PBOX_FS_UGI", "")
+        self.retries = retries
+
+    def _base(self) -> list[str]:
+        cmd = [self.hadoop_bin, "fs"]
+        if self.fs_name:
+            cmd += ["-D", f"fs.default.name={self.fs_name}"]
+        if self.fs_ugi:
+            cmd += ["-D", f"hadoop.job.ugi={self.fs_ugi}"]
+        return cmd
+
+    def _run(
+        self, args: list[str], check: bool = True, text: bool = True
+    ) -> subprocess.CompletedProcess:
+        # check=False callers (-test probes) treat rc=1 as a definitive
+        # answer, not a transient failure: no retry, one JVM fork
+        tries = (self.retries + 1) if check else 1
+        last: Optional[subprocess.CompletedProcess] = None
+        for _ in range(tries):
+            proc = subprocess.run(
+                self._base() + args, capture_output=True, text=text
+            )
+            if proc.returncode == 0:
+                return proc
+            last = proc
+        if check:
+            err = last.stderr if text else last.stderr.decode(errors="replace")
+            raise FsError(
+                f"hadoop fs {' '.join(args)} failed rc={last.returncode}: "
+                f"{err.strip()[-500:]}"
+            )
+        return last
+
+    def ls(self, path: str) -> list[str]:
+        out = self._run(["-ls", path]).stdout
+        names = []
+        for line in out.splitlines():
+            # "drwxr-xr-x - user group size date time /path"; split the 7
+            # metadata fields only, so paths containing spaces survive; skip
+            # the "Found N items" header
+            parts = line.split(None, 7)
+            if len(parts) == 8 and parts[7].startswith(("/", "hdfs:", "afs:")):
+                names.append(parts[7])
+        return sorted(names)
+
+    def exists(self, path: str) -> bool:
+        return self._run(["-test", "-e", path], check=False).returncode == 0
+
+    def is_dir(self, path: str) -> bool:
+        return self._run(["-test", "-d", path], check=False).returncode == 0
+
+    def mkdir(self, path: str) -> None:
+        self._run(["-mkdir", "-p", path])
+
+    def upload(self, local: str, remote: str) -> None:
+        self._run(["-put", "-f", local, remote])
+
+    def download(self, remote: str, local: str) -> None:
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        self._run(["-get", remote, local])
+
+    def rm(self, path: str) -> None:
+        self._run(["-rm", "-r", "-f", path])
+
+    def touch(self, path: str) -> None:
+        self._run(["-touchz", path])
+
+    def cat(self, path: str) -> bytes:
+        return self._run(["-cat", path], text=False).stdout
+
+
+def resolve_fs(path: str):
+    """FileSystem for a path: remote schemes -> HadoopFS (env-configured),
+    everything else -> LocalFS."""
+    if path.startswith(("hdfs://", "afs://")):
+        return HadoopFS()
+    return LocalFS()
+
+
+def publish_checkpoint(manager, tag: str, remote_root: str, fs=None) -> None:
+    """Upload a saved checkpoint tag + refreshed donefile to a remote root
+    (the reference's post-SaveBase xbox publish: upload the day dir, then
+    the donefile last so consumers never see a donefile entry whose data is
+    still uploading — fleet_util write_model_donefile discipline)."""
+    fs = fs or resolve_fs(remote_root)
+    entries = [e for e in manager.list_checkpoints() if e.tag == tag]
+    if not entries:
+        raise FsError(f"tag {tag!r} not in {manager.root}/donefile.txt")
+    fs.mkdir(remote_root)
+    for e in entries:  # a tag may have both a base and a delta entry
+        fs.upload(
+            e.dirname,
+            os.path.join(remote_root, os.path.basename(e.dirname)),
+        )
+    fs.upload(
+        os.path.join(manager.root, "donefile.txt"),
+        os.path.join(remote_root, "donefile.txt"),
+    )

@@ -1,0 +1,140 @@
+"""FS manager tests: LocalFS semantics, HadoopFS command construction
+against a fake hadoop binary, checkpoint publishing."""
+
+import os
+import stat
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.utils.fs import (
+    FsError,
+    HadoopFS,
+    LocalFS,
+    publish_checkpoint,
+    resolve_fs,
+)
+
+FAKE_HADOOP = r"""#!/bin/bash
+# fake `hadoop fs` backed by a local directory tree under $FAKE_ROOT.
+# Strips -D confs (recording them) then emulates the fs verbs.
+echo "$@" >> "$FAKE_ROOT/.calls"
+shift  # "fs"
+args=()
+while [[ $# -gt 0 ]]; do
+  if [[ "$1" == "-D" ]]; then shift 2; else args+=("$1"); shift; fi
+done
+set -- "${args[@]}"
+verb=$1; shift
+p() { echo "$FAKE_ROOT/${1#hdfs://ns/}"; }
+case "$verb" in
+  -ls)
+    d=$(p "$1"); [[ -d "$d" ]] || exit 1
+    echo "Found $(ls "$d" | wc -l) items"
+    for f in "$d"/*; do
+      echo "-rw-r--r-- 3 u g 0 2026-07-29 00:00 hdfs://ns/${f#$FAKE_ROOT/}"
+    done ;;
+  -test)
+    flag=$1; d=$(p "$2")
+    [[ "$flag" == "-d" ]] && { [[ -d "$d" ]]; exit $?; }
+    [[ -e "$d" ]] ;;
+  -mkdir) [[ "$1" == "-p" ]] && shift; mkdir -p "$(p "$1")" ;;
+  -put) [[ "$1" == "-f" ]] && shift; src=$1; dst=$(p "$2")
+        mkdir -p "$(dirname "$dst")"; cp -r "$src" "$dst" ;;
+  -get) src=$(p "$1"); cp -r "$src" "$2" ;;
+  -rm) while [[ "$1" == -* ]]; do shift; done; rm -rf "$(p "$1")" ;;
+  -touchz) d=$(p "$1"); mkdir -p "$(dirname "$d")"; : > "$d" ;;
+  -cat) cat "$(p "$1")" ;;
+  *) exit 2 ;;
+esac
+"""
+
+
+@pytest.fixture
+def fake_hadoop(tmp_path):
+    root = tmp_path / "remote"
+    root.mkdir()
+    bin_path = tmp_path / "hadoop"
+    bin_path.write_text(FAKE_HADOOP)
+    bin_path.chmod(bin_path.stat().st_mode | stat.S_IEXEC)
+    os.environ["FAKE_ROOT"] = str(root)
+    yield str(bin_path), str(root)
+    os.environ.pop("FAKE_ROOT", None)
+
+
+class TestLocalFS:
+    def test_roundtrip(self, tmp_path):
+        fs = LocalFS()
+        src = tmp_path / "a.txt"
+        src.write_text("hello")
+        fs.mkdir(str(tmp_path / "sub"))
+        fs.upload(str(src), str(tmp_path / "sub" / "b.txt"))
+        assert fs.exists(str(tmp_path / "sub" / "b.txt"))
+        assert fs.cat(str(tmp_path / "sub" / "b.txt")) == b"hello"
+        assert str(tmp_path / "sub") in fs.ls(str(tmp_path))
+        fs.download(str(tmp_path / "sub" / "b.txt"), str(tmp_path / "c.txt"))
+        assert (tmp_path / "c.txt").read_text() == "hello"
+        fs.rm(str(tmp_path / "sub"))
+        assert not fs.exists(str(tmp_path / "sub"))
+
+    def test_ls_non_dir_raises(self, tmp_path):
+        with pytest.raises(FsError):
+            LocalFS().ls(str(tmp_path / "nope"))
+
+
+class TestHadoopFS:
+    def test_verbs_and_confs(self, fake_hadoop, tmp_path):
+        bin_path, root = fake_hadoop
+        fs = HadoopFS(fs_name="hdfs://ns", fs_ugi="user,pass",
+                      hadoop_bin=bin_path)
+        assert not fs.exists("hdfs://ns/dir/x.txt")
+        local = tmp_path / "x.txt"
+        local.write_text("payload")
+        fs.mkdir("hdfs://ns/dir")
+        fs.upload(str(local), "hdfs://ns/dir/x.txt")
+        assert fs.exists("hdfs://ns/dir/x.txt")
+        assert fs.is_dir("hdfs://ns/dir")
+        assert fs.cat("hdfs://ns/dir/x.txt") == b"payload"
+        listing = fs.ls("hdfs://ns/dir")
+        assert listing == ["hdfs://ns/dir/x.txt"]
+        fs.download("hdfs://ns/dir/x.txt", str(tmp_path / "back.txt"))
+        assert (tmp_path / "back.txt").read_text() == "payload"
+        fs.rm("hdfs://ns/dir")
+        assert not fs.exists("hdfs://ns/dir")
+        # job confs went on every invocation
+        calls = (tmp_path / "remote" / ".calls").read_text()
+        assert "fs.default.name=hdfs://ns" in calls
+        assert "hadoop.job.ugi=user,pass" in calls
+
+    def test_failure_raises_fserror(self, fake_hadoop):
+        bin_path, _ = fake_hadoop
+        fs = HadoopFS(hadoop_bin=bin_path, retries=0)
+        with pytest.raises(FsError):
+            fs.ls("hdfs://ns/absent")
+
+
+class TestResolveAndPublish:
+    def test_resolve_by_scheme(self):
+        assert isinstance(resolve_fs("hdfs://ns/a"), HadoopFS)
+        assert isinstance(resolve_fs("afs://x/y"), HadoopFS)
+        assert isinstance(resolve_fs("/tmp/x"), LocalFS)
+
+    def test_publish_checkpoint(self, tmp_path):
+        from paddlebox_tpu.checkpoint import CheckpointManager
+        from paddlebox_tpu.config import SparseTableConfig
+        from paddlebox_tpu.sparse.table import SparseTable
+
+        tconf = SparseTableConfig(embedding_dim=4)
+        table = SparseTable(tconf, seed=0)
+        table.begin_pass(np.arange(10, dtype=np.uint64))
+        table.end_pass()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save_base("20260729", table, {"w": np.ones(3, np.float32)}, None)
+
+        remote = str(tmp_path / "published")
+        publish_checkpoint(mgr, "20260729", remote)
+        assert os.path.isdir(os.path.join(remote, "base-20260729"))
+        assert os.path.exists(os.path.join(remote, "donefile.txt"))
+
+        with pytest.raises(FsError):
+            publish_checkpoint(mgr, "absent-tag", remote)
