@@ -2,6 +2,7 @@ from paddlebox_tpu.parallel.mesh import make_mesh, initialize_distributed
 from paddlebox_tpu.parallel.sharded_table import ShardedSparseTable, ShardedBatchPlan
 from paddlebox_tpu.parallel.trainer import MultiChipTrainer
 from paddlebox_tpu.parallel.async_dense import AsyncDenseTable
+from paddlebox_tpu.parallel.pipeline import PipelineTrainer
 
 __all__ = [
     "make_mesh",
@@ -10,4 +11,5 @@ __all__ = [
     "ShardedBatchPlan",
     "MultiChipTrainer",
     "AsyncDenseTable",
+    "PipelineTrainer",
 ]
