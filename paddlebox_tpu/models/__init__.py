@@ -7,6 +7,7 @@ from paddlebox_tpu.models.layers import bce_with_logits, init_mlp, linear, mlp
 from paddlebox_tpu.models.mmoe import MMoE
 from paddlebox_tpu.models.rank_ctr import RankCtrDnn
 from paddlebox_tpu.models.wide_deep import WideDeep
+from paddlebox_tpu.models.xdeepfm import XDeepFM
 
 __all__ = [
     "CtrDnn",
@@ -15,6 +16,7 @@ __all__ = [
     "MMoE",
     "RankCtrDnn",
     "WideDeep",
+    "XDeepFM",
     "bce_with_logits",
     "init_mlp",
     "linear",

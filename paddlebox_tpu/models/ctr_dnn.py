@@ -59,9 +59,14 @@ class CtrDnn:
         self.cvm_offset = cvm_offset
         self.expand_dim = expand_dim
         base_w = emb_width - expand_dim
-        pooled_w = base_w if use_cvm else base_w - cvm_offset
-        if layout == "conv" and use_cvm and show_filter:
-            pooled_w -= 1
+        if not use_cvm:
+            pooled_w = base_w - cvm_offset
+        elif layout == "conv":
+            # conv CVM emits cvm_offset(=3) counter columns: width preserved
+            pooled_w = base_w - (1 if show_filter else 0)
+        else:
+            # default CVM emits 2 counter columns whatever cvm_offset is
+            pooled_w = 2 + base_w - cvm_offset
         self.input_dim = n_sparse_slots * (pooled_w + expand_dim) + dense_dim
 
     def init(self, key: jax.Array) -> dict:

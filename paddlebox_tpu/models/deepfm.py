@@ -47,7 +47,9 @@ class DeepFM:
         self.use_cvm = use_cvm
         self.cvm_offset = cvm_offset
         self.emb_dim = emb_width - cvm_offset  # FM acts on the embedding part
-        pooled_w = emb_width if use_cvm else self.emb_dim
+        # _cvm_transform emits [log_show, ctr, embed...]: 2 counter columns
+        # whatever cvm_offset is
+        pooled_w = (2 + self.emb_dim) if use_cvm else self.emb_dim
         self.deep_in = n_sparse_slots * pooled_w + dense_dim
 
     def init(self, key: jax.Array) -> dict:

@@ -47,7 +47,11 @@ class RankCtrDnn:
         self.att_out_dim = att_out_dim
         self.use_cvm = use_cvm
         self.cvm_offset = cvm_offset
-        pooled_w = emb_width if use_cvm else emb_width - cvm_offset
+        # seqpool-CVM emits [log_show, ctr, embed...] per slot with use_cvm
+        # (2 counter columns whatever cvm_offset is), bare embeds without
+        pooled_w = (
+            2 + emb_width - cvm_offset if use_cvm else emb_width - cvm_offset
+        )
         self.feat_dim = n_sparse_slots * pooled_w + dense_dim
         self.input_dim = self.feat_dim + att_out_dim
 

@@ -10,7 +10,7 @@ from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
 from paddlebox_tpu.data.dataset import PadBoxSlotDataset
 from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
 from paddlebox_tpu.metrics import MetricGroup, MetricSpec
-from paddlebox_tpu.models import DCN, DeepFM, MMoE, WideDeep
+from paddlebox_tpu.models import DCN, DeepFM, MMoE, WideDeep, XDeepFM
 from paddlebox_tpu.sparse.table import SparseTable
 from paddlebox_tpu.train.trainer import Trainer
 
@@ -59,8 +59,11 @@ WIDTH = SparseTableConfig(embedding_dim=4).row_width
         lambda: WideDeep(S, WIDTH, dense_dim=DENSE, hidden=(16,)),
         lambda: DeepFM(S, WIDTH, dense_dim=DENSE, hidden=(16,)),
         lambda: DCN(S, WIDTH, dense_dim=DENSE, hidden=(16,), n_cross=2),
+        lambda: XDeepFM(
+            S, WIDTH, dense_dim=DENSE, hidden=(16,), cin_layers=(8, 8)
+        ),
     ],
-    ids=["wide_deep", "deepfm", "dcn"],
+    ids=["wide_deep", "deepfm", "dcn", "xdeepfm"],
 )
 def test_model_learns(tmp_path, model_fn):
     _, ds = _dataset(tmp_path)
@@ -69,6 +72,51 @@ def test_model_learns(tmp_path, model_fn):
     assert losses[-1] < losses[0]
     assert metrics["auc"] > 0.5
     ds.close()
+
+
+def test_models_handle_wide_cvm_offset(tmp_path):
+    """cvm_offset > 2 (conv/pcoc row layouts): the default CVM transform
+    still emits exactly 2 counter columns, so every model's input_dim
+    accounting must shrink accordingly (regression: r3 review finding)."""
+    from paddlebox_tpu.models import CtrDnn
+
+    tconf = SparseTableConfig(embedding_dim=4, cvm_offset=3)
+    W = tconf.row_width
+    conf, ds = _dataset(tmp_path)
+    for model in (
+        CtrDnn(S, W, dense_dim=DENSE, hidden=(8,), cvm_offset=3),
+        DeepFM(S, W, dense_dim=DENSE, hidden=(8,), cvm_offset=3),
+        DCN(S, W, dense_dim=DENSE, hidden=(8,), n_cross=1, cvm_offset=3),
+        XDeepFM(S, W, dense_dim=DENSE, hidden=(8,), cin_layers=(4,),
+                cvm_offset=3),
+        WideDeep(S, W, dense_dim=DENSE, hidden=(8,), cvm_offset=3),
+    ):
+        trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10))
+        table = SparseTable(tconf, seed=0)
+        table.begin_pass(ds.unique_keys())
+        metrics = trainer.train_from_dataset(ds, table)
+        table.end_pass()
+        assert np.isfinite(metrics["loss"]), type(model).__name__
+    ds.close()
+
+
+def test_xdeepfm_cin_matches_naive():
+    """The CIN einsum == the textbook double sum over field pairs."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    B, m, D, H = 4, 3, 5, 6
+    x0 = rng.normal(size=(B, m, D)).astype(np.float32)
+    w = rng.normal(size=(H, m, m)).astype(np.float32)
+
+    got = np.asarray(jnp.einsum("hij,bid,bjd->bhd", w, jnp.asarray(x0), jnp.asarray(x0)))
+    want = np.zeros((B, H, D), np.float32)
+    for b in range(B):
+        for h in range(H):
+            for i in range(m):
+                for j in range(m):
+                    want[b, h] += w[h, i, j] * x0[b, i] * x0[b, j]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
 def test_mmoe_multitask(tmp_path):
