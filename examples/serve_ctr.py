@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Train -> export -> serve: the serving-side story end to end.
+
+The reference ships a C++ AnalysisPredictor + HTTP/Go/R clients
+(/root/reference/paddle/fluid/inference/); here the equivalent loop is a
+few lines over the exported StableHLO artifact: a stdlib HTTP server whose
+POST /score body is canonical slot-data text lines, scored through
+``Predictor`` (inference/predictor.py).
+
+    python examples/serve_ctr.py            # train + export + demo request
+    python examples/serve_ctr.py --port 0   # pick a free port and stay up
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_artifact(work: str) -> tuple[str, "object"]:
+    """Quick synth training run, then export; returns (artifact_dir, conf)."""
+    from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.inference import export_model
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.train.trainer import Trainer
+
+    S, DENSE, B = 4, 4, 32
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B, max_feasigns_per_ins=16
+    )
+    files = write_synth_files(
+        os.path.join(work, "data"), n_files=2, ins_per_file=512,
+        n_sparse_slots=S, vocab_per_slot=1000, dense_dim=DENSE, seed=1,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=2)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    tconf = SparseTableConfig(embedding_dim=8)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(64, 32))
+    table = SparseTable(tconf)
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 16))
+    table.begin_pass(ds.unique_keys())
+    metrics = trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    print(f"trained: auc={metrics['auc']:.4f}")
+    art = os.path.join(work, "artifact")
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    export_model(
+        model, trainer.params, table, art,
+        batch_size=B, key_capacity=kcap, dense_dim=DENSE,
+    )
+    ds.close()
+    return art, conf
+
+
+def make_handler(predictor, conf):
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            if self.path != "/score":
+                self.send_error(404)
+                return
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            # body = canonical slot text lines; run them through the same
+            # parser/batcher the trainer uses
+            with tempfile.TemporaryDirectory() as td:
+                p = os.path.join(td, "req.txt")
+                with open(p, "wb") as f:
+                    f.write(body)
+                ds = PadBoxSlotDataset(conf, read_threads=1)
+                ds.set_filelist([p])
+                ds.load_into_memory()
+                scores = [
+                    float(s)
+                    for out in predictor.predict_dataset(ds)
+                    for s in out
+                ]
+                ds.close()
+            payload = json.dumps({"scores": scores}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    return Handler
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=None,
+                    help="serve forever on this port (0 = pick free)")
+    args = ap.parse_args()
+
+    from paddlebox_tpu.data.synth import write_synth_files
+    from paddlebox_tpu.inference import Predictor
+
+    work = tempfile.mkdtemp(prefix="pbox_serve_")
+    art, conf = build_artifact(work)
+    predictor = Predictor.load(art)
+    server = HTTPServer(("127.0.0.1", args.port or 0), make_handler(predictor, conf))
+    host, port = server.server_address
+    print(f"serving on http://{host}:{port}/score")
+
+    if args.port is None:
+        # demo mode: fire one request against ourselves, print, exit
+        import threading
+        import urllib.request
+
+        t = threading.Thread(target=server.handle_request, daemon=True)
+        t.start()
+        demo_files = write_synth_files(
+            os.path.join(work, "demo"), n_files=1, ins_per_file=8,
+            n_sparse_slots=4, vocab_per_slot=1000, dense_dim=4, seed=9,
+        )
+        with open(demo_files[0], "rb") as f:
+            req = urllib.request.Request(
+                f"http://{host}:{port}/score", data=f.read(), method="POST"
+            )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            print("scores:", json.load(resp)["scores"])
+        t.join(timeout=30)
+    else:
+        server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from paddlebox_tpu.models.layers import init_mlp, mlp, resolve_compute_dtype
 from paddlebox_tpu.ops import (
+    pooled_width,
     fused_seqpool_cvm,
     fused_seqpool_cvm_extended,
     fused_seqpool_cvm_with_conv,
@@ -59,14 +60,9 @@ class CtrDnn:
         self.cvm_offset = cvm_offset
         self.expand_dim = expand_dim
         base_w = emb_width - expand_dim
-        if not use_cvm:
-            pooled_w = base_w - cvm_offset
-        elif layout == "conv":
-            # conv CVM emits cvm_offset(=3) counter columns: width preserved
-            pooled_w = base_w - (1 if show_filter else 0)
-        else:
-            # default CVM emits 2 counter columns whatever cvm_offset is
-            pooled_w = 2 + base_w - cvm_offset
+        pooled_w = pooled_width(
+            base_w, cvm_offset, use_cvm, layout=layout, show_filter=show_filter
+        )
         self.input_dim = n_sparse_slots * (pooled_w + expand_dim) + dense_dim
 
     def init(self, key: jax.Array) -> dict:

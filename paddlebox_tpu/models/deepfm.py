@@ -25,7 +25,7 @@ from paddlebox_tpu.models.layers import (
     mlp,
     resolve_compute_dtype,
 )
-from paddlebox_tpu.ops.seqpool_cvm import _cvm_transform, seqpool
+from paddlebox_tpu.ops.seqpool_cvm import _cvm_transform, pooled_width, seqpool
 
 
 class DeepFM:
@@ -47,9 +47,7 @@ class DeepFM:
         self.use_cvm = use_cvm
         self.cvm_offset = cvm_offset
         self.emb_dim = emb_width - cvm_offset  # FM acts on the embedding part
-        # _cvm_transform emits [log_show, ctr, embed...]: 2 counter columns
-        # whatever cvm_offset is
-        pooled_w = (2 + self.emb_dim) if use_cvm else self.emb_dim
+        pooled_w = pooled_width(emb_width, cvm_offset, use_cvm)
         self.deep_in = n_sparse_slots * pooled_w + dense_dim
 
     def init(self, key: jax.Array) -> dict:

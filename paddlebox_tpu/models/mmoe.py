@@ -23,7 +23,7 @@ from paddlebox_tpu.models.layers import (
     mlp,
     resolve_compute_dtype,
 )
-from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.ops import fused_seqpool_cvm, pooled_width
 
 
 class MMoE:
@@ -52,11 +52,7 @@ class MMoE:
         self.tower_hidden = tuple(tower_hidden)
         self.use_cvm = use_cvm
         self.cvm_offset = cvm_offset
-        # seqpool-CVM emits [log_show, ctr, embed...] per slot with use_cvm
-        # (2 counter columns whatever cvm_offset is), bare embeds without
-        pooled_w = (
-            2 + emb_width - cvm_offset if use_cvm else emb_width - cvm_offset
-        )
+        pooled_w = pooled_width(emb_width, cvm_offset, use_cvm)
         self.input_dim = n_sparse_slots * pooled_w + dense_dim
 
     def init(self, key: jax.Array) -> dict:

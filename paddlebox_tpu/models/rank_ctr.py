@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from paddlebox_tpu.models.layers import init_mlp, mlp, resolve_compute_dtype
-from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.ops import fused_seqpool_cvm, pooled_width
 from paddlebox_tpu.ops.rank_attention import rank_attention
 
 
@@ -47,11 +47,7 @@ class RankCtrDnn:
         self.att_out_dim = att_out_dim
         self.use_cvm = use_cvm
         self.cvm_offset = cvm_offset
-        # seqpool-CVM emits [log_show, ctr, embed...] per slot with use_cvm
-        # (2 counter columns whatever cvm_offset is), bare embeds without
-        pooled_w = (
-            2 + emb_width - cvm_offset if use_cvm else emb_width - cvm_offset
-        )
+        pooled_w = pooled_width(emb_width, cvm_offset, use_cvm)
         self.feat_dim = n_sparse_slots * pooled_w + dense_dim
         self.input_dim = self.feat_dim + att_out_dim
 

@@ -31,7 +31,7 @@ from paddlebox_tpu.models.layers import (
     mlp,
     resolve_compute_dtype,
 )
-from paddlebox_tpu.ops import fused_seqpool_cvm
+from paddlebox_tpu.ops import fused_seqpool_cvm, pooled_width
 
 
 class XDeepFM:
@@ -54,12 +54,9 @@ class XDeepFM:
         self.cin_layers = tuple(cin_layers)
         self.use_cvm = use_cvm
         self.cvm_offset = cvm_offset
-        # fused_seqpool_cvm emits, per slot: [log_show, ctr, embed...] with
-        # use_cvm (2 counter columns whatever cvm_offset is) or just the
-        # embed columns without it
         embed_w = emb_width - cvm_offset
-        self.pooled_w = (2 + embed_w) if use_cvm else embed_w
-        self.n_counter_cols = 2 if use_cvm else 0
+        self.pooled_w = pooled_width(emb_width, cvm_offset, use_cvm)
+        self.n_counter_cols = self.pooled_w - embed_w
         # field embedding width: the embed columns only (fields must share
         # one width for the CIN contraction)
         self.field_w = embed_w

@@ -16,6 +16,7 @@ from paddlebox_tpu.ops.rank_attention import (
 )
 from paddlebox_tpu.ops.seqpool_cvm import (
     fused_seqpool_cvm,
+    pooled_width,
     fused_seqpool_cvm_extended,
     fused_seqpool_cvm_with_conv,
     fused_seqpool_cvm_with_diff_thres,
@@ -28,6 +29,7 @@ __all__ = [
     "cvm_decayed_show",
     "fused_concat",
     "fused_seqpool_cvm",
+    "pooled_width",
     "fused_seqpool_cvm_extended",
     "fused_seqpool_cvm_with_conv",
     "fused_seqpool_cvm_with_diff_thres",

@@ -113,6 +113,28 @@ def _prepool(
     return rows
 
 
+def pooled_width(
+    emb_width: int,
+    cvm_offset: int = 2,
+    use_cvm: bool = True,
+    layout: str = "default",
+    show_filter: bool = False,
+) -> int:
+    """Per-slot output width of the fused seqpool-CVM family — THE width
+    contract model input_dim accounting must use.
+
+    default layout CVM emits 2 counter columns ([log_show, ctr]); the conv
+    layout emits 3 ([log_show, log_clk, cvr], minus one with show_filter);
+    without use_cvm all counter columns are dropped.
+    """
+    embed = emb_width - cvm_offset
+    if not use_cvm:
+        return embed
+    if layout == "conv":
+        return 3 + embed - (1 if show_filter else 0)
+    return 2 + embed
+
+
 def _cvm_transform(pooled: jax.Array, cvm_offset: int) -> jax.Array:
     """Default log-CVM on the pooled show/click columns; counters carry no
     gradient (the reference's cvm_grad writes the CVM values, not d/dshow of
@@ -141,7 +163,10 @@ def fused_seqpool_cvm(
     quant_ratio: int = 0,
 ) -> jax.Array:
     """Pool + CVM for all slots at once; returns [B, n_slots * out_width],
-    out_width = W with use_cvm else W - cvm_offset (counters dropped).
+    out_width = 2 + W - cvm_offset with use_cvm (the CVM transform emits
+    exactly [log_show, ctr] whatever cvm_offset is) else W - cvm_offset
+    (counters dropped) — see ``pooled_width()`` for the one authoritative
+    formula.
 
     ``threshold_vec`` (length n_slots) switches the show/clk filter to
     per-slot thresholds — this IS the _with_diff_thres variant

@@ -100,6 +100,42 @@ def test_models_handle_wide_cvm_offset(tmp_path):
     ds.close()
 
 
+def test_pooled_width_matches_op_output():
+    """pooled_width() == the actual fused-op per-slot width, across layouts
+    and cvm_offsets (regression: conv with cvm_offset=4 used to disagree)."""
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.ops import (
+        fused_seqpool_cvm,
+        fused_seqpool_cvm_with_conv,
+        pooled_width,
+    )
+
+    B, S_, K = 2, 3, 12
+    for W, co, use_cvm, layout, show_filter in [
+        (6, 2, True, "default", False),
+        (7, 3, True, "default", False),
+        (7, 3, False, "default", False),
+        (7, 3, True, "conv", False),
+        (8, 4, True, "conv", False),
+        (7, 3, True, "conv", True),
+    ]:
+        rows = jnp.ones((K, W))
+        segs = jnp.asarray(np.arange(K) % (B * S_), np.int32)
+        if layout == "conv":
+            out = fused_seqpool_cvm_with_conv(
+                rows, segs, B, S_, use_cvm=use_cvm, cvm_offset=co,
+                show_filter=show_filter,
+            )
+        else:
+            out = fused_seqpool_cvm(
+                rows, segs, B, S_, use_cvm=use_cvm, cvm_offset=co
+            )
+        want = pooled_width(W, co, use_cvm, layout=layout,
+                            show_filter=show_filter)
+        assert out.shape == (B, S_ * want), (W, co, use_cvm, layout, out.shape)
+
+
 def test_xdeepfm_cin_matches_naive():
     """The CIN einsum == the textbook double sum over field pairs."""
     import jax.numpy as jnp
