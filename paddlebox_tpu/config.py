@@ -276,6 +276,11 @@ class TrainerConfig:
     # (profile=True) always runs serial so the plan/feed/step split stays
     # honest.
     prefetch_batches: int = 2
+    # multi-step dispatch: run this many train steps per device program via
+    # lax.scan over host-stacked feeds — amortizes per-step Python/dispatch
+    # overhead (small models, remote devices).  1 = one dispatch per step.
+    # Per-batch dump (need_dump_field) and the step profiler force 1.
+    scan_steps: int = 1
     # per-stage host timing (reference: TrainFilesWithProfiler — a slower
     # diagnostic mode: the device step is synchronized every batch)
     profile: bool = False

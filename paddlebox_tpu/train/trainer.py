@@ -48,27 +48,29 @@ class TrainState:
     auc: AucState
 
 
-def _device_batch(
+def _host_batch_dict(
     batch: HostBatch, plan, n_slots: int, counter_label_tasks=()
 ) -> dict:
-    """Assemble the static-shape device feed from a HostBatch + BatchPlan."""
+    """Assemble the static-shape feed (numpy leaves) from a HostBatch +
+    BatchPlan — _device_batch without the H2D transfer, so multi-step scan
+    groups can stack on the host and transfer once."""
     ins = np.minimum(batch.key_segments // n_slots, batch.batch_size - 1)
     key_clicks = batch.labels[ins] * plan.key_mask
     dev = {
-        "idx": jnp.asarray(plan.idx),
-        "uniq_idx": jnp.asarray(plan.uniq_idx),
-        "inverse": jnp.asarray(plan.inverse),
-        "key_mask": jnp.asarray(plan.key_mask),
-        "key_clicks": jnp.asarray(key_clicks),
-        "key_segments": jnp.asarray(batch.key_segments),
-        "dense": jnp.asarray(batch.dense),
-        "labels": jnp.asarray(batch.labels),
-        "ins_mask": jnp.asarray(batch.ins_mask),
+        "idx": plan.idx,
+        "uniq_idx": plan.uniq_idx,
+        "inverse": plan.inverse,
+        "key_mask": plan.key_mask,
+        "key_clicks": key_clicks,
+        "key_segments": batch.key_segments,
+        "dense": batch.dense,
+        "labels": batch.labels,
+        "ins_mask": batch.ins_mask,
     }
     if batch.rank_offset is not None:
-        dev["rank_offset"] = jnp.asarray(batch.rank_offset)
+        dev["rank_offset"] = batch.rank_offset
     if batch.task_labels is not None:
-        dev["task_labels"] = jnp.asarray(batch.task_labels)
+        dev["task_labels"] = batch.task_labels
     if counter_label_tasks:
         if batch.task_labels is None:
             raise RuntimeError(
@@ -90,8 +92,21 @@ def _device_batch(
             ],
             axis=1,
         ).astype(np.float32)
-        dev["key_extras"] = jnp.asarray(extras)
+        dev["key_extras"] = extras
     return dev
+
+
+def _to_device(host: dict) -> dict:
+    """H2D staging of one (possibly stacked) host feed dict — the single
+    place a staging change (pinned device_put, dtype cast) must land."""
+    return {k: jnp.asarray(v) for k, v in host.items()}
+
+
+def _device_batch(
+    batch: HostBatch, plan, n_slots: int, counter_label_tasks=()
+) -> dict:
+    """Host feed + H2D transfer."""
+    return _to_device(_host_batch_dict(batch, plan, n_slots, counter_label_tasks))
 
 
 class _FeedPrefetcher:
@@ -189,6 +204,8 @@ class Trainer:
         self.params = model.init(jax.random.PRNGKey(seed))
         self.opt_state = self.optimizer.init(self.params)
         self._step_fn = None
+        self._step_body = None
+        self._scan_fn = None
         self._eval_fn = None
         self.global_step = 0
 
@@ -264,7 +281,35 @@ class Trainer:
                 finite = jnp.array(True)
             return params, opt_state, values, g2sum, mstate, loss, finite, primary
 
+        self._step_body = step
         return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
+
+    def _build_scan_step(self):
+        """k steps in ONE dispatch: lax.scan over stacked feeds.  Amortizes
+        per-step Python + runtime dispatch (pays off where dispatch is
+        expensive relative to the step: small models, remote/tunneled
+        devices, pods with deep software stacks).  XLA compiles the k-step
+        program once; preds/dump are unavailable (use scan_steps=1 when
+        dumping)."""
+        body = self._step_body
+
+        def scan_fn(params, opt_state, values, g2sum, mstate, feeds):
+            def tick(carry, feed):
+                p, o, v, g, m = carry
+                p, o, v, g, m, loss, finite, _ = body(p, o, v, g, m, feed)
+                return (p, o, v, g, m), (loss, finite)
+
+            (params, opt_state, values, g2sum, mstate), (losses, finites) = (
+                jax.lax.scan(
+                    tick, (params, opt_state, values, g2sum, mstate), feeds
+                )
+            )
+            return (
+                params, opt_state, values, g2sum, mstate, losses,
+                finites.all(),
+            )
+
+        return jax.jit(scan_fn, donate_argnums=(0, 1, 2, 3, 4))
 
     def _init_mstate(self, auc_state=None) -> dict:
         """Fresh metric state, or continuation: pass the previous pass's
@@ -343,9 +388,16 @@ class Trainer:
 
         prof = StepProfiler() if self.conf.profile else NullProfiler()
 
-        def feeds():
-            """(batch, device feed) stream: validation + host planning + H2D
-            staging.  Runs inline, or on the prefetch thread when enabled."""
+        # scan grouping: k steps per device dispatch (disabled while dumping
+        # per-batch fields or profiling per-step)
+        scan_k = self.conf.scan_steps
+        if dumper is not None or prof.enabled:
+            scan_k = 1
+        if scan_k > 1 and self._scan_fn is None:
+            self._scan_fn = self._build_scan_step()
+
+        def host_feeds():
+            """(batch, host feed dict) stream: validation + host planning."""
             for batch in dataset.batches(drop_last=drop_last):
                 if uses_rank and batch.rank_offset is None:
                     raise RuntimeError(
@@ -369,15 +421,34 @@ class Trainer:
                 with prof.stage("plan"):
                     plan = table.plan_batch(batch)
                 with prof.stage("feed"):
-                    dev = _device_batch(
+                    host = _host_batch_dict(
                         batch, plan, batch.n_sparse_slots,
                         self.conf.counter_label_tasks,
                     )
                     if self.metric_group is not None:
-                        dev["metric_masks"] = jnp.asarray(
-                            self.metric_group.masks(batch)
-                        )
-                yield batch, dev
+                        host["metric_masks"] = self.metric_group.masks(batch)
+                yield batch, host
+
+        def feeds():
+            """(kind, batch, device feed): "one" = a single-step feed, "scan"
+            = scan_k host-stacked feeds transferred as one [k, ...] block
+            (the tail shorter than scan_k falls back to single steps)."""
+            buf = []
+            for batch, host in host_feeds():
+                if scan_k <= 1:
+                    with prof.stage("feed"):
+                        dev = _to_device(host)
+                    yield "one", batch, dev
+                    continue
+                buf.append(host)
+                if len(buf) == scan_k:
+                    stacked = _to_device(
+                        {k: np.stack([h[k] for h in buf]) for k in buf[0]}
+                    )
+                    buf = []
+                    yield "scan", None, stacked
+            for host in buf:  # ragged tail: single-step dispatches
+                yield "one", None, _to_device(host)
 
         # profiling/tracing keep the serial path so the plan/feed/step split
         # (and the captured timeline) stay honest; otherwise feed assembly
@@ -388,14 +459,34 @@ class Trainer:
             and not prof.enabled
             and not self.conf.trace_dir
         ):
-            prefetcher = _FeedPrefetcher(feeds(), self.conf.prefetch_batches)
+            # queue slots hold scan GROUPS in scan mode: shrink the depth so
+            # staged device memory stays ~prefetch_batches batches either way
+            depth = max(1, self.conf.prefetch_batches // max(scan_k, 1))
+            prefetcher = _FeedPrefetcher(feeds(), depth)
             feed_iter = prefetcher
         else:
             feed_iter = feeds()
 
         try:
           with device_trace(self.conf.trace_dir or None):
-            for batch, dev in feed_iter:
+            for kind, batch, dev in feed_iter:
+                if kind == "scan":
+                    (self.params, self.opt_state, values, g2sum, mstate,
+                     loss_k, finite) = (
+                        self._scan_fn(self.params, self.opt_state, values,
+                                      g2sum, mstate, dev)
+                    )
+                    k = int(loss_k.shape[0])
+                    if self.conf.check_nan_inf and not bool(finite):
+                        raise FloatingPointError(
+                            f"non-finite loss/grad within steps "
+                            f"{self.global_step}..{self.global_step + k - 1} "
+                            "(FLAGS_check_nan_inf analog)"
+                        )
+                    losses.append(loss_k)  # [k] device vector
+                    n_steps += k
+                    self.global_step += k
+                    continue
                 with prof.stage("step"):
                     (self.params, self.opt_state, values, g2sum, mstate,
                      loss, finite, preds) = (
@@ -444,7 +535,13 @@ class Trainer:
             )
         if self.metric_group is not None:
             metrics.update(self.metric_group.compute(mstate["group"]))
-        metrics["loss"] = float(jnp.stack(losses).mean()) if losses else 0.0
+        metrics["loss"] = (
+            float(
+                jnp.concatenate([jnp.atleast_1d(l) for l in losses]).mean()
+            )
+            if losses
+            else 0.0
+        )
         metrics["steps"] = n_steps
         if prof.enabled:
             metrics["profile"] = prof.report()
