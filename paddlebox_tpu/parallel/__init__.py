@@ -3,8 +3,16 @@ from paddlebox_tpu.parallel.sharded_table import ShardedSparseTable, ShardedBatc
 from paddlebox_tpu.parallel.trainer import MultiChipTrainer
 from paddlebox_tpu.parallel.async_dense import AsyncDenseTable
 from paddlebox_tpu.parallel.pipeline import PipelineTrainer
+from paddlebox_tpu.parallel.sequence import (
+    full_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
 __all__ = [
+    "full_attention",
+    "ring_attention",
+    "ulysses_attention",
     "make_mesh",
     "initialize_distributed",
     "ShardedSparseTable",
