@@ -44,6 +44,8 @@ def export_model(
     batch_size: int,
     key_capacity: int,
     dense_dim: int,
+    quantize: bool = False,
+    rank_offset_cols: int = 0,
 ) -> None:
     """Write a serving artifact for ``model`` + ``table`` to ``out_dir``.
 
@@ -52,11 +54,20 @@ def export_model(
     table: SparseTable/ShardedSparseTable OUTSIDE a pass (end_pass first) —
     its host store is snapshotted.  Multi-host callers export per-process
     shard files (rank in the filename) and merge at load.
+    quantize: store the snapshot's embedx columns as int8 with one global
+    scale (~4x smaller artifact — the reference's quantized xbox model
+    publish, box_wrapper.cu FeaturePullValueGpuQuant; counters + embed_w
+    stay f32 exactly as there).
+    rank_offset_cols: for rank_offset-consuming models (RankCtrDnn), the
+    feed's rank-offset matrix column count (DataFeedConfig.rank_offset_cols)
+    — exported as a fourth program input.
     """
-    if getattr(model, "uses_rank_offset", False):
-        raise NotImplementedError(
-            "rank_offset-consuming models need the PV-merged serving feed; "
-            "export only the standard feed models for now"
+    uses_rank = getattr(model, "uses_rank_offset", False)
+    if uses_rank and rank_offset_cols <= 0:
+        raise ValueError(
+            "model consumes rank_offset: pass rank_offset_cols "
+            "(DataFeedConfig.rank_offset_cols) so the serving program can "
+            "take the PV-merged rank matrix as input"
         )
     conf = table.conf
     os.makedirs(out_dir, exist_ok=True)
@@ -69,16 +80,43 @@ def export_model(
     pid = jax.process_index()
     np.save(os.path.join(out_dir, "sparse", f"keys-{pid:05d}.npy"),
             np.asarray(state["keys"], dtype=np.uint64))
-    np.save(os.path.join(out_dir, "sparse", f"values-{pid:05d}.npy"),
-            np.asarray(state["values"], dtype=np.float32)[:, :w])
+    vals = np.asarray(state["values"], dtype=np.float32)[:, :w]
+    co = conf.cvm_offset
+    # the artifact format must be GLOBAL (every rank writes the same shard
+    # layout or Predictor.load breaks): decide off config, never off this
+    # rank's row count — rows with no embedx columns have nothing to quantize
+    quantize = quantize and (w - co - 1) > 0
+    if quantize:
+        # embedx columns (everything past embed_w) -> int8 with one scale
+        # PER SHARD FILE (each process knows only its own rows); counters +
+        # embed_w stay f32 (reference quant layout).  Empty shards write
+        # empty arrays so the loader sees a uniform format.
+        embedx = vals[:, co + 1 :]
+        amax = float(np.abs(embedx).max()) if embedx.size else 0.0
+        scale = (amax / 127.0) if amax > 0 else 1.0
+        q = np.clip(np.round(embedx / scale), -127, 127).astype(np.int8)
+        np.save(os.path.join(out_dir, "sparse", f"embedx_q-{pid:05d}.npy"), q)
+        np.save(os.path.join(out_dir, "sparse", f"head-{pid:05d}.npy"),
+                np.ascontiguousarray(vals[:, : co + 1]))
+        np.save(os.path.join(out_dir, "sparse", f"scale-{pid:05d}.npy"),
+                np.float32(scale))
+    else:
+        np.save(os.path.join(out_dir, "sparse", f"values-{pid:05d}.npy"), vals)
 
     # the forward program, params frozen in as constants
     B, K = batch_size, key_capacity
     frozen = jax.tree.map(jnp.asarray, params)
 
-    def serve(rows, key_segments, dense):
-        logits = model.apply(frozen, rows, key_segments, dense, B)
-        return jax.nn.sigmoid(logits)
+    if uses_rank:
+        def serve(rows, key_segments, dense, rank_offset):
+            logits = model.apply(
+                frozen, rows, key_segments, dense, B, rank_offset=rank_offset
+            )
+            return jax.nn.sigmoid(logits)
+    else:
+        def serve(rows, key_segments, dense):
+            logits = model.apply(frozen, rows, key_segments, dense, B)
+            return jax.nn.sigmoid(logits)
 
     if pid != 0:
         return  # replicated artifacts are rank 0's to write (multi-host:
@@ -86,10 +124,17 @@ def export_model(
         # meta are identical everywhere — same convention as checkpoint.py)
     # lower for both serving platforms: a TPU-trained artifact must run on
     # a CPU-only serving host too
-    exp = jax.export.export(jax.jit(serve), platforms=("cpu", "tpu"))(
+    in_shapes = [
         jax.ShapeDtypeStruct((K, w), jnp.float32),
         jax.ShapeDtypeStruct((K,), jnp.int32),
         jax.ShapeDtypeStruct((B, dense_dim), jnp.float32),
+    ]
+    if uses_rank:
+        in_shapes.append(
+            jax.ShapeDtypeStruct((B, rank_offset_cols), jnp.int32)
+        )
+    exp = jax.export.export(jax.jit(serve), platforms=("cpu", "tpu"))(
+        *in_shapes
     )
     with open(os.path.join(out_dir, "serving.stablehlo"), "wb") as f:
         f.write(exp.serialize())
@@ -107,6 +152,8 @@ def export_model(
         "cvm_offset": conf.cvm_offset,
         "create_threshold": conf.create_threshold,
         "pull_embedx_scale": conf.pull_embedx_scale,
+        "quantized": bool(quantize),
+        "rank_offset_cols": rank_offset_cols if uses_rank else 0,
     }
     with open(os.path.join(out_dir, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)

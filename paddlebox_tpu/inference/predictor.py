@@ -40,10 +40,28 @@ class Predictor:
 
         with open(os.path.join(artifact_dir, "meta.json")) as f:
             meta = json.load(f)
-        key_files = sorted(glob.glob(os.path.join(artifact_dir, "sparse", "keys-*.npy")))
-        val_files = sorted(glob.glob(os.path.join(artifact_dir, "sparse", "values-*.npy")))
+        sp = os.path.join(artifact_dir, "sparse")
+        key_files = sorted(glob.glob(os.path.join(sp, "keys-*.npy")))
         keys = np.concatenate([np.load(p) for p in key_files])
-        values = np.concatenate([np.load(p) for p in val_files])
+        if meta.get("quantized"):
+            # per-shard [head f32 | embedx int8 * scale] -> f32 rows
+            shards = []
+            for kf in key_files:
+                pid = kf[-9:-4]
+                head = np.load(os.path.join(sp, f"head-{pid}.npy"))
+                q = np.load(os.path.join(sp, f"embedx_q-{pid}.npy"))
+                scale = float(np.load(os.path.join(sp, f"scale-{pid}.npy")))
+                shards.append(
+                    np.concatenate(
+                        [head, q.astype(np.float32) * scale], axis=1
+                    )
+                )
+            values = np.concatenate(shards) if shards else np.empty(
+                (0, meta["row_width"]), np.float32
+            )
+        else:
+            val_files = sorted(glob.glob(os.path.join(sp, "values-*.npy")))
+            values = np.concatenate([np.load(p) for p in val_files])
         order = np.argsort(keys)  # per-process shards -> one sorted table
         keys, values = keys[order], values[order]
         with open(os.path.join(artifact_dir, "serving.stablehlo"), "rb") as f:
@@ -87,13 +105,19 @@ class Predictor:
                 "DataFeedConfig.batch_key_capacity to match the export"
             )
         rows = self._resolve_rows(batch.keys, batch.n_keys)
-        preds = np.asarray(
-            self._call(
-                rows,
-                np.asarray(batch.key_segments, np.int32),
-                np.asarray(batch.dense, np.float32),
-            )
-        )
+        args = [
+            rows,
+            np.asarray(batch.key_segments, np.int32),
+            np.asarray(batch.dense, np.float32),
+        ]
+        if m.get("rank_offset_cols", 0):
+            if batch.rank_offset is None:
+                raise ValueError(
+                    "artifact serves a rank_offset model: feed PV-merged "
+                    "batches (enable_pv_merge + preprocess_instance)"
+                )
+            args.append(np.asarray(batch.rank_offset, np.int32))
+        preds = np.asarray(self._call(*args))
         b = int(batch.ins_mask.sum())
         return preds[:b]
 

@@ -102,9 +102,17 @@ def ring_attention(
             )
         else:
             acc, m, l = fold((k_blk, v_blk, acc, m, l, src))
-        k_blk, v_blk = jax.lax.ppermute(
-            (k_blk, v_blk), axis_name,
-            [(i, (i + 1) % p_axis) for i in range(p_axis)],
+        # the last tick's rotation would be discarded: skip it (the scan
+        # counter is replicated, so every device takes the same branch and
+        # the collective stays coherent)
+        k_blk, v_blk = jax.lax.cond(
+            j < p_axis - 1,
+            lambda kv: jax.lax.ppermute(
+                kv, axis_name,
+                [(i, (i + 1) % p_axis) for i in range(p_axis)],
+            ),
+            lambda kv: kv,
+            (k_blk, v_blk),
         )
         return (k_blk, v_blk, acc, m, l), None
 

@@ -111,6 +111,86 @@ def test_predict_dataset_streams_all(tmp_path):
     ds.close()
 
 
+def test_quantized_export_close_and_smaller(tmp_path):
+    """int8 embedx snapshot: predictions close to the f32 artifact, sparse
+    payload ~4x smaller."""
+    conf, ds, model, table, trainer = _train_small(str(tmp_path / "data"))
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    art_f, art_q = str(tmp_path / "f32"), str(tmp_path / "q8")
+    for art, quant in ((art_f, False), (art_q, True)):
+        export_model(
+            model, trainer.params, table, art,
+            batch_size=B, key_capacity=kcap, dense_dim=DENSE, quantize=quant,
+        )
+    pf, pq = Predictor.load(art_f), Predictor.load(art_q)
+    batch = next(ds.batches(drop_last=False))
+    a, b2 = pf.predict(batch), pq.predict(batch)
+    np.testing.assert_allclose(a, b2, atol=2e-2)  # int8 quant noise only
+    ds.close()
+
+    def sparse_bytes(art):
+        d = os.path.join(art, "sparse")
+        return sum(
+            os.path.getsize(os.path.join(d, f))
+            for f in os.listdir(d)
+            if not f.startswith("keys")
+        )
+
+    # row: 3 f32 head cols + 5 int8 embedx vs 8 f32 cols -> ~0.53x here;
+    # production rows (embedx >> head) approach 0.25x
+    assert sparse_bytes(art_q) < 0.6 * sparse_bytes(art_f)
+
+
+def test_rank_model_export_roundtrip(tmp_path):
+    """RankCtrDnn (rank_offset-consuming) exports with the rank matrix as a
+    fourth program input and predicts on PV-merged batches."""
+    from paddlebox_tpu.models import RankCtrDnn
+
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+        max_feasigns_per_ins=16, parse_logkey=True, enable_pv_merge=True,
+        pv_batch_size=4, rank_cmatch_filter=(222, 223),
+    )
+    files = write_synth_files(
+        str(tmp_path / "pv"), n_files=1, ins_per_file=48, n_sparse_slots=S,
+        vocab_per_slot=50, dense_dim=DENSE, seed=4, with_logkey=True,
+        max_ads_per_pv=3,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    ds.preprocess_instance()
+    tconf = SparseTableConfig(embedding_dim=8)
+    model = RankCtrDnn(
+        S, tconf.row_width, dense_dim=DENSE, hidden=(16, 8),
+        max_rank=conf.max_rank,
+    )
+    table = SparseTable(tconf, seed=0)
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10))
+    table.begin_pass(ds.unique_keys())
+    trainer.train_from_dataset(ds, table)
+    table.end_pass()
+
+    art = str(tmp_path / "artifact")
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    export_model(
+        model, trainer.params, table, art,
+        batch_size=next(ds.batches()).batch_size,
+        key_capacity=kcap, dense_dim=DENSE,
+        rank_offset_cols=conf.rank_offset_cols,
+    )
+    pred = Predictor.load(art)
+    batch = next(ds.batches(drop_last=False))
+    out = pred.predict(batch)
+    assert out.shape[0] == int(batch.ins_mask.sum())
+    assert np.all(np.isfinite(out))
+    # without the rank matrix the artifact must refuse
+    batch.rank_offset = None
+    with pytest.raises(ValueError, match="rank_offset"):
+        pred.predict(batch)
+    ds.close()
+
+
 def test_export_respects_create_threshold(tmp_path):
     """Feature admission carries into serving: under-shown features read
     zero embeddings through the predictor's host resolve."""
