@@ -1,0 +1,65 @@
+"""Expert parallelism: MMoE-style expert banks sharded over an ``expert``
+mesh axis.
+
+CTR multi-task models (MMoE, models/mmoe.py) use DENSE gating — every
+instance consumes every expert with a softmax weight — so the sparse-MoE
+dispatch/combine all_to_all (token routing) does not apply.  The TPU-native
+EP layout for dense gating is simpler and collective-light:
+
+  * each device owns E/P experts (the expert bank's leading axis sharded
+    over the mesh);
+  * the batch is replicated across the axis; every device runs ITS experts
+    on the full batch (one vmapped matmul — MXU-dense);
+  * outputs are weighted by the local slice of the gate matrix and psummed:
+    one [B, D_out] all-reduce per layer, vs all-gathering E expert outputs.
+
+This is the ``parallel/`` family's fifth axis (dp, sparse-MP, pp, sp, ep);
+like the others it is a pure shard_map body that reduces to the serial
+computation at P=1.  Reference anchor: MMoE user programs on the BoxPS
+trainer (SURVEY.md §2.11); the reference has no expert-parallel engine —
+its MoE models replicate experts per GPU — so this is a capability the TPU
+design adds, not ports.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+EXPERT_AXIS = "expert"
+
+
+def expert_parallel_forward(
+    expert_w: jax.Array,  # [E_local, D_in, D_hid] this device's experts
+    expert_b: jax.Array,  # [E_local, D_hid]
+    x: jax.Array,  # [B, D_in] replicated batch
+    gates: jax.Array,  # [B, E_global] dense softmax gates
+    axis_name: str = EXPERT_AXIS,
+) -> jax.Array:
+    """Gate-weighted sum of expert outputs (call INSIDE shard_map over
+    ``axis_name``; experts laid out contiguously in mesh order).
+    Returns [B, D_hid], fully reduced (identical on every device)."""
+    p_axis = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    e_local = expert_w.shape[0]
+    # local experts on the full batch: [E_local, B, D_hid]
+    h = jax.nn.relu(
+        jnp.einsum("bi,eio->ebo", x, expert_w) + expert_b[:, None, :]
+    )
+    # my slice of the gate matrix: columns [idx*E_local, (idx+1)*E_local)
+    g = jax.lax.dynamic_slice_in_dim(gates, idx * e_local, e_local, axis=1)
+    local = jnp.einsum("ebo,be->bo", h, g)
+    return jax.lax.psum(local, axis_name)
+
+
+def serial_expert_forward(
+    expert_w: jax.Array,  # [E, D_in, D_hid]
+    expert_b: jax.Array,  # [E, D_hid]
+    x: jax.Array,
+    gates: jax.Array,
+) -> jax.Array:
+    """Single-device reference semantics (the MMoE expert mix)."""
+    h = jax.nn.relu(
+        jnp.einsum("bi,eio->ebo", x, expert_w) + expert_b[:, None, :]
+    )
+    return jnp.einsum("ebo,be->bo", h, gates)
