@@ -30,6 +30,19 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def emit(obj: dict) -> None:
+    """Print a result JSON line to stdout and flush immediately.
+
+    Called twice on the headline path: once right after the `ours`
+    measurement (vs_baseline null) and once after the naive baseline
+    completes.  The driver parses the LAST JSON line from the output tail,
+    so the final line supersedes the partial one — but if the process dies
+    mid-naive (the axon tunnel can drop at any point), the flushed partial
+    line still yields a parsed artifact instead of rc!=0 with parsed:null
+    (the r2/r3 failure shape)."""
+    print(json.dumps(obj), flush=True)
+
+
 def init_backend(max_tries: int = 5, base_delay: float = 5.0,
                  hang_timeout: float = 120.0):
     """Initialize the JAX backend with bounded retry AND a hang watchdog.
@@ -356,7 +369,11 @@ def main() -> None:
                     help="scan_steps for --trainer-path")
     args = ap.parse_args()
 
-    init_backend()
+    devs = init_backend()
+    # "axon"/"tpu" = real chip through the tunnel; "cpu" would mean the
+    # tunnel was unavailable and the number is NOT a TPU number — the judge
+    # asked for this field so a CPU fallback can't masquerade as TPU perf.
+    backend = devs[0].platform
     from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
     from paddlebox_tpu.models import CtrDnn
 
@@ -376,12 +393,13 @@ def main() -> None:
             )
             sps = bench_trainer_path(ds, tconf, trconf, model)
             ds.close()
-        print(json.dumps({
+        emit({
             "metric": "ctr_dnn_trainer_path_samples_per_sec",
             "value": round(sps, 1),
             "unit": "samples/sec",
             "vs_baseline": None,
-        }))
+            "backend": backend,
+        })
         return
 
     if args.sustained:
@@ -389,18 +407,28 @@ def main() -> None:
             args.sustained, tconf, trconf, N_SLOTS, DENSE, B, N_INS, HIDDEN,
             args.profile,
         )
-        print(json.dumps({
+        emit({
             "metric": "ctr_dnn_sustained_samples_per_sec",
             "value": round(sps, 1),
             "unit": "samples/sec",
             "vs_baseline": None,
-        }))
+            "backend": backend,
+        })
         return
 
     with tempfile.TemporaryDirectory() as td:
         conf, ds, parse_s = build_data(td, N_SLOTS, DENSE, B, N_INS, 100_000)
         model = CtrDnn(N_SLOTS, tconf.row_width, dense_dim=DENSE, hidden=HIDDEN)
         ours = bench_ours(ds, tconf, trconf, model)
+        # partial emit BEFORE the naive baseline: if the tunnel drops during
+        # naive, the driver still parses this line (see emit docstring)
+        emit({
+            "metric": "ctr_dnn_samples_per_sec",
+            "value": round(ours, 1),
+            "unit": "samples/sec",
+            "vs_baseline": None,
+            "backend": backend,
+        })
         try:
             naive = bench_naive(ds, tconf, trconf, HIDDEN)
         except Exception as e:  # naive baseline OOM/failed: still report ours
@@ -409,12 +437,13 @@ def main() -> None:
         ds.close()
 
     vs = round(ours / naive, 3) if np.isfinite(naive) and naive > 0 else None
-    print(json.dumps({
+    emit({
         "metric": "ctr_dnn_samples_per_sec",
         "value": round(ours, 1),
         "unit": "samples/sec",
         "vs_baseline": vs,  # null = naive baseline did not run
-    }))
+        "backend": backend,
+    })
 
 
 if __name__ == "__main__":

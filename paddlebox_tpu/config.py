@@ -280,6 +280,10 @@ class TrainerConfig:
     # lax.scan over host-stacked feeds — amortizes per-step Python/dispatch
     # overhead (small models, remote devices).  1 = one dispatch per step.
     # Per-batch dump (need_dump_field) and the step profiler force 1.
+    # With check_nan_inf, the host still only sees the flag after the whole
+    # k-step group, but the scan body short-circuits: ticks after the first
+    # non-finite one pass state through untouched, so at most ONE corrupted
+    # update lands (same blast radius as scan_steps=1).
     scan_steps: int = 1
     # per-stage host timing (reference: TrainFilesWithProfiler — a slower
     # diagnostic mode: the device step is synchronized every batch)

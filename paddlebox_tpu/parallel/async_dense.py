@@ -134,14 +134,19 @@ class AsyncDenseTable:
         """Enqueue one aggregated dense gradient (pytree or flat leaves).
         Blocks when queue_depth grads are already in flight — the double
         buffer's backpressure, which bounds staleness."""
-        if self._err is not None:
-            raise RuntimeError("async dense update thread died") from self._err
         leaves = (
             list(grads)
             if isinstance(grads, list)
             else _tree_leaves_np(grads)
         )
-        self._q.put(leaves)
+        # re-checks for a dead update thread: a plain blocking put() would
+        # deadlock forever if the thread died while the queue was full
+        # (nothing would ever drain it)
+        from paddlebox_tpu.utils.queues import bounded_put
+
+        if not bounded_put(self._q, leaves, lambda: self._err is not None):
+            raise RuntimeError(
+                "async dense update thread died") from self._err
         self.pushes += 1
 
     def pull(self) -> Any:
@@ -155,14 +160,33 @@ class AsyncDenseTable:
         return jax.tree.unflatten(self._treedef, leaves)
 
     def drain(self) -> None:
-        """Block until every pushed grad has been applied (pass boundary)."""
-        self._q.join()
+        """Block until every pushed grad has been applied (pass boundary).
+
+        Polls instead of ``Queue.join()`` so a dying update thread turns
+        into a raised RuntimeError here, not a silent hang at every async
+        pass boundary (a push racing the thread's death could also leave
+        ``unfinished_tasks`` permanently non-zero — polling makes that
+        stale count harmless)."""
+        while True:
+            if self._err is not None:
+                raise RuntimeError(
+                    "async dense update thread died") from self._err
+            with self._q.all_tasks_done:
+                if self._q.unfinished_tasks == 0:
+                    return
+                self._q.all_tasks_done.wait(timeout=0.2)
 
     def stop(self) -> None:
         self._stop = True
-        self._q.put(None)  # wake the thread
+        try:
+            self._q.put_nowait(None)  # wake the thread; Full = it has work
+        except queue.Full:
+            pass  # thread sees _stop at its next get(); dead thread: join
         self._thread.join(timeout=10.0)
         self._pool.shutdown(wait=False)
+        if self._err is not None:
+            raise RuntimeError(
+                "async dense update thread died") from self._err
 
     # -- background update -------------------------------------------------- #
     def _update_loop(self) -> None:
@@ -184,6 +208,15 @@ class AsyncDenseTable:
                         f.result()
                 self.applied += 1
                 self._q.task_done()
-        except BaseException as e:  # surface on the next push/pull
+        except BaseException as e:  # surface on the next push/pull/drain
             self._err = e
-            self._q.task_done()
+            self._q.task_done()  # the in-flight item
+            # drain anything still queued so no producer stays blocked on a
+            # full queue and unfinished_tasks converges (advisor r3: a dead
+            # thread with queued grads hung drain() forever)
+            while True:
+                try:
+                    self._q.get_nowait()
+                    self._q.task_done()
+                except queue.Empty:
+                    return

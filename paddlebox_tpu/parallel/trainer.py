@@ -414,8 +414,10 @@ class MultiChipTrainer:
     def close(self) -> None:
         """Stop background machinery (the async dense update thread)."""
         if self.async_dense is not None:
-            self.async_dense.stop()
-            self.async_dense = None
+            try:
+                self.async_dense.stop()  # raises if the update thread died
+            finally:
+                self.async_dense = None
 
     def init_auc(self) -> AucState:
         return self._stack_local(init_auc_state(self.conf.auc_buckets))

@@ -14,6 +14,7 @@ inside a step.  Host work per batch is only the numpy key->row planning
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 from typing import Any, Iterable, Optional
 
@@ -132,14 +133,21 @@ class _FeedPrefetcher:
         self._thread.start()
 
     def _run(self, gen) -> None:
+        from paddlebox_tpu.utils.queues import bounded_put
+
+        def put(item) -> bool:
+            # re-checks _stop: close() drains the queue, so a blocking put
+            # would otherwise race it and the producer could keep planning
+            # batches (and touching the table) after the caller ended the pass
+            return bounded_put(self._q, item, lambda: self._stop)
+
         try:
             for item in gen:
-                if self._stop:
+                if self._stop or not put(item):
                     return
-                self._q.put(item)
-            self._q.put(self._SENTINEL)
+            put(self._SENTINEL)
         except BaseException as e:  # surfaced to the consumer
-            self._q.put(e)
+            put(e)
 
     def __iter__(self):
         return self
@@ -167,6 +175,14 @@ class _FeedPrefetcher:
         except queue.Empty:
             pass
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # the producer is stuck in planning/H2D staging; it will exit at
+            # its next _stop check, but make the leak visible instead of
+            # silent (advisor r3)
+            logging.getLogger(__name__).warning(
+                "feed-prefetch producer did not exit within 5s of close(); "
+                "daemon thread will retire at its next stop check"
+            )
 
 
 class Trainer:
@@ -292,17 +308,44 @@ class Trainer:
         program once; preds/dump are unavailable (use scan_steps=1 when
         dumping)."""
         body = self._step_body
+        check_nan = self.conf.check_nan_inf
 
         def scan_fn(params, opt_state, values, g2sum, mstate, feeds):
             def tick(carry, feed):
-                p, o, v, g, m = carry
-                p, o, v, g, m, loss, finite, _ = body(p, o, v, g, m, feed)
-                return (p, o, v, g, m), (loss, finite)
+                (p, o, v, g, m), ok = carry
+                if not check_nan:
+                    p, o, v, g, m, loss, finite, _ = body(p, o, v, g, m, feed)
+                    return ((p, o, v, g, m), ok & finite), (loss, finite)
 
-            (params, opt_state, values, g2sum, mstate), (losses, finites) = (
-                jax.lax.scan(
-                    tick, (params, opt_state, values, g2sum, mstate), feeds
+                # with check_nan_inf on, a NaN at tick j must not let ticks
+                # j+1..k-1 keep applying corrupted dense/sparse updates
+                # before the host sees the flag (advisor r3): once ok goes
+                # False the remaining ticks pass state through untouched
+                def run(st):
+                    p, o, v, g, m = st
+                    p, o, v, g, m, loss, finite, _ = body(p, o, v, g, m, feed)
+                    # f32 so both cond branches agree on the loss aval even
+                    # under a bf16 tower
+                    return (p, o, v, g, m), loss.astype(jnp.float32), finite
+
+                def skip(st):
+                    return (
+                        st,
+                        jnp.full((), jnp.nan, jnp.float32),
+                        jnp.array(False),
+                    )
+
+                state, loss, finite = jax.lax.cond(
+                    ok, run, skip, (p, o, v, g, m)
                 )
+                return (state, ok & finite), (loss, finite)
+
+            ((params, opt_state, values, g2sum, mstate), _), (
+                losses, finites
+            ) = jax.lax.scan(
+                tick,
+                ((params, opt_state, values, g2sum, mstate), jnp.array(True)),
+                feeds,
             )
             return (
                 params, opt_state, values, g2sum, mstate, losses,

@@ -28,6 +28,7 @@ from __future__ import annotations
 import os
 import shutil
 import subprocess
+import time
 from typing import Optional
 
 
@@ -118,7 +119,12 @@ class HadoopFS:
         # answer, not a transient failure: no retry, one JVM fork
         tries = (self.retries + 1) if check else 1
         last: Optional[subprocess.CompletedProcess] = None
-        for _ in range(tries):
+        for attempt in range(tries):
+            if attempt:
+                # transient HDFS failures need time to clear; back-to-back
+                # retries just fork JVMs (reference fs.cc sleeps between
+                # retries too). 1s, 2s, 3s... capped at 5s.
+                time.sleep(min(attempt, 5))
             proc = subprocess.run(
                 self._base() + args, capture_output=True, text=text
             )

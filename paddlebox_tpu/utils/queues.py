@@ -1,0 +1,32 @@
+"""Shared bounded-queue helpers for producer/consumer threads.
+
+One audited implementation of "put on a bounded queue while re-checking an
+abort predicate" — a plain blocking ``Queue.put`` deadlocks whenever the
+consumer dies or retires while the queue is full (the reference's Channel<T>
+closes for the same reason, framework/channel.h).  Used by the feed
+prefetcher (train/trainer.py) and the async dense table
+(parallel/async_dense.py).
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Any, Callable
+
+
+def bounded_put(
+    q: "queue.Queue",
+    item: Any,
+    should_abort: Callable[[], bool],
+    poll_s: float = 0.2,
+) -> bool:
+    """Put ``item`` on ``q``, re-checking ``should_abort()`` every ``poll_s``
+    while the queue is full.  Returns False (item NOT enqueued) when aborted.
+    """
+    while not should_abort():
+        try:
+            q.put(item, timeout=poll_s)
+            return True
+        except queue.Full:
+            continue
+    return False

@@ -80,6 +80,26 @@ class TestAsyncDenseTable:
             table.push({"w": np.ones((4, 3), np.float32),
                         "b": np.ones(3, np.float32)})
 
+    def test_drain_and_stop_raise_when_thread_dead(self):
+        """A dead update thread with grads still queued must turn drain()
+        into a RuntimeError, not a Queue.join() hang at the pass boundary
+        (advisor r3 medium)."""
+        table = AsyncDenseTable(_params(), optimizer="sgd", lr=1.0,
+                                queue_depth=4)
+        good = {"w": np.ones((4, 3), np.float32),
+                "b": np.ones(3, np.float32)}
+        table.push([np.ones(3, np.float32)] * 5)  # kills the thread
+        try:
+            table.push(good)  # may or may not land before the death
+        except RuntimeError:
+            pass
+        table._thread.join(timeout=5.0)
+        assert not table._thread.is_alive()
+        with pytest.raises(RuntimeError):
+            table.drain()
+        with pytest.raises(RuntimeError):
+            table.stop()
+
 
 class TestAsyncTrainingMode:
     def test_multichip_async_learns(self):

@@ -93,6 +93,45 @@ def test_e2e_preload_overlap_lifecycle(synth):
     assert m2["loss"] < m1["loss"]
 
 
+def test_scan_nan_short_circuits_remaining_ticks():
+    """With check_nan_inf under scan_steps=k, ticks after the first
+    non-finite one must pass state through untouched: blast radius is one
+    corrupted update, same as scan_steps=1 (advisor r3).  Uses a counting
+    stub body so 'how many updates applied' is directly observable."""
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.train.trainer import Trainer
+
+    def fake_body(p, o, v, g, m, feed):
+        return (p + 1, o, v, g, m, (p + 1).astype(jnp.float32),
+                feed["ok"] > 0, p)
+
+    tr = Trainer.__new__(Trainer)
+    tr.conf = TrainerConfig(check_nan_inf=True, scan_steps=3)
+    tr._step_body = fake_body
+    scan_fn = tr._build_scan_step()
+
+    def zs():  # distinct buffers: the scan donates each argument
+        return [jnp.zeros(()) for _ in range(5)]
+
+    feeds = {"ok": jnp.array([1.0, 0.0, 1.0])}  # tick 1 goes non-finite
+    p, _, _, _, _, losses, finite = scan_fn(*zs(), feeds)
+    # tick 0 applies, tick 1 applies (the one corrupted update), tick 2 skips
+    assert float(p) == 2.0
+    assert not bool(finite)
+    assert losses.shape == (3,)
+    assert bool(jnp.isnan(losses[2]))  # skipped tick reports nan loss
+
+    # all-finite group still applies every tick
+    tr2 = Trainer.__new__(Trainer)
+    tr2.conf = TrainerConfig(check_nan_inf=True, scan_steps=3)
+    tr2._step_body = fake_body
+    p, _, _, _, _, losses, finite = tr2._build_scan_step()(
+        *zs(), {"ok": jnp.ones(3)}
+    )
+    assert float(p) == 3.0 and bool(finite)
+
+
 def test_check_nan_inf_catches_poisoned_lr(synth):
     """FLAGS_check_nan_inf analog actually fires (VERDICT weak #27)."""
     paths, conf = synth
