@@ -221,6 +221,21 @@ class SparseTableConfig:
     # kernels, box_wrapper.cu:1223-1256).  1.0 = no-op (unquantized table).
     pull_embedx_scale: float = 1.0
 
+    # host feature store (the CPU/SSD tier analog — reference: libbox_ps
+    # SSD/CPU/HBM tiering, cmake/external/box_ps.cmake:17-63 and the
+    # LoadSSD/ShrinkTable surface, box_wrapper.cc:1329-1460).  Keys are
+    # hash-partitioned into power-of-two buckets (splitmix64 mix, so skewed
+    # integer key spaces balance like hashed feasigns do); a
+    # pass-boundary merge updates existing rows in place and rebuilds only
+    # buckets that received NEW keys, so steady-state merge cost tracks the
+    # pass size, not total features ever seen (sparse/store.py).
+    store_buckets: int = 256
+    # spill directory for cold buckets ("" = whole store stays in RAM).
+    # With a spill dir, at most store_max_resident buckets are resident and
+    # the rest live as .npz files — the SSD tier for stores beyond RAM.
+    store_spill_dir: str = ""
+    store_max_resident: int = 64
+
     @property
     def row_width(self) -> int:
         """Width of a pulled value row: [show, clk, embed...(, expand...)]."""

@@ -2,5 +2,12 @@
 
 from paddlebox_tpu.train.auto_checkpoint import AutoCheckpointer
 from paddlebox_tpu.train.trainer import Trainer, TrainState
+from paddlebox_tpu.train.two_phase import PhaseSpec, TwoPhaseTrainer
 
-__all__ = ["AutoCheckpointer", "Trainer", "TrainState"]
+__all__ = [
+    "AutoCheckpointer",
+    "PhaseSpec",
+    "Trainer",
+    "TrainState",
+    "TwoPhaseTrainer",
+]

@@ -195,10 +195,29 @@ class Trainer:
         trainer_conf: Optional[TrainerConfig] = None,
         seed: int = 0,
         metric_group: Optional[MetricGroup] = None,
+        slot_mask: Optional[Iterable[int]] = None,
     ):
+        """slot_mask: participating sparse-slot indices (None = all slots).
+        Excluded slots are fully absent from this trainer's program — their
+        pooled features read zero, their embeddings receive no gradients,
+        and their show/clk counters do not increment — the per-phase slot
+        participation of the reference's join/update two-phase training
+        (each phase runs a different program; box_wrapper.h:627-630,
+        train/two_phase.py)."""
         self.model = model
         self.table_conf = table_conf
         self.conf = trainer_conf or TrainerConfig()
+        self.slot_mask = (
+            None if slot_mask is None else tuple(sorted(set(slot_mask)))
+        )
+        if self.slot_mask is not None:
+            S = model.n_sparse_slots
+            bad = [s for s in self.slot_mask if not 0 <= s < S]
+            if bad:
+                raise ValueError(
+                    f"slot_mask indices {bad} out of range for "
+                    f"{S} sparse slots"
+                )
         from paddlebox_tpu.models.layers import apply_compute_dtype_override
 
         apply_compute_dtype_override(model, self.conf.compute_dtype)
@@ -224,6 +243,7 @@ class Trainer:
         self._scan_fn = None
         self._eval_fn = None
         self.global_step = 0
+        self.last_metric_state = None
 
     # -- the fused step ---------------------------------------------------- #
     def _build_step(self):
@@ -234,6 +254,12 @@ class Trainer:
         uses_rank = getattr(model, "uses_rank_offset", False)
         n_tasks = self.n_tasks
         has_group = self.metric_group is not None
+        part_vec = None
+        if self.slot_mask is not None:
+            S = model.n_sparse_slots
+            v = np.zeros(S, np.float32)
+            v[list(self.slot_mask)] = 1.0
+            part_vec = jnp.asarray(v)
 
         def step(params, opt_state, values, g2sum, mstate, batch):
             rows = pull_rows(
@@ -244,8 +270,19 @@ class Trainer:
             )
             bsz = batch["labels"].shape[0]
             extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
+            if part_vec is not None:
+                # occurrence-level participation: seg = ins*S + slot, so
+                # seg % S is the slot (padding occurrences are already
+                # key_mask=0).  Gating inside loss_fn (below) zeroes both
+                # the pooled features AND, via the chain rule, the row
+                # gradients of excluded slots.
+                key_part = part_vec[batch["key_segments"] % part_vec.shape[0]]
+            else:
+                key_part = None
 
             def loss_fn(p, r):
+                if key_part is not None:
+                    r = r * key_part[:, None]
                 logits = model.apply(
                     p, r, batch["key_segments"], batch["dense"], bsz, **extra
                 )
@@ -267,10 +304,19 @@ class Trainer:
 
             updates, opt_state = optimizer.update(pgrads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            key_mask = batch["key_mask"]
+            key_clicks = batch["key_clicks"]
+            key_extras = batch.get("key_extras")
+            if key_part is not None:
+                # excluded slots increment no show/clk/extra counters either
+                key_mask = key_mask * key_part
+                key_clicks = key_clicks * key_part
+                if key_extras is not None:
+                    key_extras = key_extras * key_part[:, None]
             values, g2sum = push_and_update(
                 values, g2sum, row_grads, batch["idx"], batch["uniq_idx"],
-                batch["inverse"], batch["key_mask"], batch["key_clicks"], tconf,
-                key_extras=batch.get("key_extras"),
+                batch["inverse"], key_mask, key_clicks, tconf,
+                key_extras=key_extras,
             )
             primary = preds[:, 0] if n_tasks > 1 else preds
             mstate = dict(mstate)

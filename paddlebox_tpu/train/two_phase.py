@@ -1,0 +1,170 @@
+"""Join/update two-phase training — the reference's production pass schedule.
+
+The reference trains every pass TWICE over the same in-memory data: first
+the "join" program (the towers and slots that join the ad/user statistics),
+then — after a global phase flip — the "update" program (the remaining
+slots).  Phase state lives on the BoxWrapper singleton
+(``phase_``/``FlipPhase``, reference box_wrapper.h:627-630; driven from
+Python via ``box.flip_phase()``, pybind/box_helper_py.cc:99-101), the data
+feed serves PV-merged batches only in the join phase (data_feed.cc:1663-1666
+"join: 1, update: 0"), and every metric is registered with a
+``metric_phase`` so only matching streams accumulate during a phase
+(AddAucMonitor skips mismatches, boxps_worker.cc:530-540; phase-keyed
+name lists, box_wrapper.cc:1196-1221).
+
+TPU translation: phases are explicit specs, not singleton state.  Each
+phase owns a full ``Trainer`` (its own dense tower, optimizer, and metric
+streams — the analog of "a different program per phase") plus a slot
+participation mask (``Trainer.slot_mask``) restricting which sparse slots
+that phase trains; the sparse table is SHARED, so a pass's join updates are
+visible to its update phase exactly as the shared PS core makes them in the
+reference.  Metric streams stay per-phase by construction — no skip-logic
+needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Optional, Sequence
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.train.trainer import Trainer
+
+
+@dataclasses.dataclass
+class PhaseSpec:
+    """One training phase of a pass.
+
+    name:  stream key ("join"/"update" canonically; any label works).
+    model: the phase's dense program (own params/optimizer).
+    slots: participating sparse-slot indices; None = all slots.  Excluded
+           slots are absent from the phase's program: zero pooled features,
+           zero gradients, zero counter increments.
+    use_pv: the phase consumes PV-merged batches (rank_offset models);
+           mirrors the reference serving PV channels only in join phase.
+    """
+
+    name: str
+    model: Any
+    slots: Optional[Sequence[int]] = None
+    use_pv: bool = False
+
+
+class TwoPhaseTrainer:
+    """Trains each pass once per phase, in spec order, over the same data.
+
+    Canonical use is two phases (join then update, matching the reference's
+    ``phase_ = 1`` start and flip-to-0, box_wrapper.h:671); any number of
+    phases works (the reference's AucRunner generalizes phase_num the same
+    way, box_wrapper.h:698).
+    """
+
+    def __init__(
+        self,
+        phases: Sequence[PhaseSpec],
+        table_conf: SparseTableConfig,
+        trainer_conf: Optional[TrainerConfig] = None,
+        seed: int = 0,
+    ):
+        if not phases:
+            raise ValueError("need at least one PhaseSpec")
+        names = [p.name for p in phases]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate phase names: {names}")
+        self.specs = list(phases)
+        self.trainers = {
+            spec.name: Trainer(
+                spec.model,
+                table_conf,
+                trainer_conf,
+                seed=seed + i,
+                slot_mask=spec.slots,
+            )
+            for i, spec in enumerate(phases)
+        }
+        # numeric phase for API parity: index into the training order;
+        # starts at 0 (the first spec — canonically "join", which the
+        # reference encodes as phase id 1 trained first)
+        self._phase = 0
+
+    # -- phase state (reference: Phase/PhaseNum/FlipPhase/SetPhase) -------- #
+    @property
+    def phase(self) -> int:
+        return self._phase
+
+    @property
+    def phase_name(self) -> str:
+        return self.specs[self._phase].name
+
+    @property
+    def phase_num(self) -> int:
+        return len(self.specs)
+
+    def flip_phase(self) -> None:
+        self._phase = (self._phase + 1) % len(self.specs)
+
+    def set_phase(self, phase: int) -> None:
+        if not 0 <= phase < len(self.specs):
+            raise ValueError(f"phase {phase} out of range")
+        self._phase = phase
+
+    # -- training ---------------------------------------------------------- #
+    def train_phase(self, dataset, table, **kw) -> dict:
+        """Train ONLY the current phase over the pass (manual driving, the
+        ``train_from_dataset`` + ``flip_phase()`` loop a user would write
+        against the reference).
+
+        PV gating mirrors the reference's per-phase channel switch
+        (data_feed.cc:1663-1666: join phases read the PV channels, update
+        phases the flat instance channels): a ``use_pv`` phase requires the
+        dataset preprocessed into PV mode; a flat phase on a PV-merged
+        dataset temporarily drops to instance mode and restores after."""
+        spec = self.specs[self._phase]
+        tr = self.trainers[spec.name]
+        pv_capable = hasattr(dataset, "pv_mode")
+        if spec.use_pv and not (pv_capable and dataset.pv_mode):
+            raise RuntimeError(
+                f"phase {spec.name!r} wants PV batches: call "
+                "dataset.preprocess_instance() first"
+            )
+        kw.setdefault("auc_state", tr.last_metric_state or None)
+        restore_pv = (not spec.use_pv) and pv_capable and dataset.pv_mode
+        if restore_pv:
+            # save/restore the PV grouping state rather than recomputing it:
+            # preprocess_instance() would reset _pv_perm and discard any
+            # local/global shuffle order the user set up for the PV phases
+            pv_state = (
+                dataset._pv_order, dataset._pv_starts, dataset._pv_perm
+            )
+            dataset.postprocess_instance()
+        try:
+            return tr.train_from_dataset(dataset, table, **kw)
+        finally:
+            if restore_pv:
+                (dataset._pv_order, dataset._pv_starts,
+                 dataset._pv_perm) = pv_state
+
+    def train_pass(self, dataset, table, drop_last: bool = False) -> dict:
+        """Train every phase over the same pass, flipping between: the full
+        per-pass schedule.  Returns {phase_name: metrics}.  Metric streams
+        carry across passes per phase (exact streaming AUC)."""
+        self.set_phase(0)
+        out = {}
+        for _ in range(len(self.specs)):
+            out[self.phase_name] = self.train_phase(
+                dataset, table, drop_last=drop_last
+            )
+            self.flip_phase()
+        return out
+
+    # -- metrics (reference: GetMetricNameList(metric_phase)) -------------- #
+    def metrics(self, phase: Optional[str] = None) -> dict:
+        """Latest metric state per phase name (all phases when None)."""
+        if phase is not None:
+            return {phase: self.trainers[phase].last_metric_state}
+        return {
+            name: tr.last_metric_state for name, tr in self.trainers.items()
+        }
+
+    def dense_states(self) -> dict:
+        return {name: tr.dense_state() for name, tr in self.trainers.items()}

@@ -45,7 +45,7 @@ def test_evaluate_no_updates(tmp_path):
     _, ds, trainer, table = _world(tmp_path)
     ds.load_into_memory()
     _train_passes(trainer, table, ds)
-    store_before = table._store_vals.copy()
+    store_before = table.state_dict()["values"].copy()
     params_before = [np.asarray(x).copy() for x in
                      __import__("jax").tree.leaves(trainer.params)]
     table.begin_pass(ds.unique_keys())
@@ -53,7 +53,7 @@ def test_evaluate_no_updates(tmp_path):
     table.end_pass()
     assert m["count"] == ds.get_memory_data_size()
     assert m["auc"] > 0.55
-    np.testing.assert_array_equal(table._store_vals, store_before)
+    np.testing.assert_array_equal(table.state_dict()["values"], store_before)
     for a, b in zip(__import__("jax").tree.leaves(trainer.params), params_before):
         np.testing.assert_array_equal(np.asarray(a), b)
     ds.close()

@@ -56,8 +56,9 @@ def test_base_roundtrip(tmp_path):
     p2, o2, meta = mgr.load(table2, trainer2.params, trainer2.opt_state)
     trainer2.load_dense_state(p2, o2)
     assert meta["tag"] == "20260729"
-    np.testing.assert_array_equal(table2._store_keys, table._store_keys)
-    np.testing.assert_allclose(table2._store_vals, table._store_vals, rtol=1e-6)
+    sd, sd2 = table.state_dict(), table2.state_dict()
+    np.testing.assert_array_equal(sd2["keys"], sd["keys"])
+    np.testing.assert_allclose(sd2["values"], sd["values"], rtol=1e-6)
     for a, b in zip(
         __import__("jax").tree.leaves(trainer.params),
         __import__("jax").tree.leaves(trainer2.params),
@@ -83,8 +84,9 @@ def test_delta_chain_equals_full_store(tmp_path):
 
     _, _, _, table2 = _world(seed=5)
     mgr.load(table2)
-    np.testing.assert_array_equal(table2._store_keys, table._store_keys)
-    np.testing.assert_allclose(table2._store_vals, table._store_vals, rtol=1e-6)
+    sd, sd2 = table.state_dict(), table2.state_dict()
+    np.testing.assert_array_equal(sd2["keys"], sd["keys"])
+    np.testing.assert_allclose(sd2["values"], sd["values"], rtol=1e-6)
     ds1.close()
     ds2.close()
 
@@ -112,8 +114,9 @@ def test_resume_matches_uninterrupted(tmp_path):
     m_c = _train_pass(tr_c, tab_c, ds2)
 
     assert m_c["loss"] == pytest.approx(m_a["loss"], rel=1e-5)
-    np.testing.assert_array_equal(tab_c._store_keys, tab_a._store_keys)
-    np.testing.assert_allclose(tab_c._store_vals, tab_a._store_vals, rtol=1e-5)
+    sd_a, sd_c = tab_a.state_dict(), tab_c.state_dict()
+    np.testing.assert_array_equal(sd_c["keys"], sd_a["keys"])
+    np.testing.assert_allclose(sd_c["values"], sd_a["values"], rtol=1e-5)
     ds1.close()
     ds2.close()
 
@@ -131,7 +134,7 @@ def test_load_upto_and_missing(tmp_path):
     mgr.save_delta("b", table)
     _, _, _, t2 = _world(seed=3)
     mgr.load(t2, upto="a")
-    np.testing.assert_allclose(t2._store_vals, store_at_a["values"], rtol=1e-6)
+    np.testing.assert_allclose(t2.state_dict()["values"], store_at_a["values"], rtol=1e-6)
     with pytest.raises(FileNotFoundError):
         mgr.load(t2, upto="nope")
     ds.close()
@@ -161,8 +164,9 @@ def test_sharded_table_checkpoint(tmp_path):
     trainer2 = MultiChipTrainer(model, tconf, mesh, TrainerConfig(auc_buckets=1 << 10), seed=9)
     p2, o2, _ = mgr.load(table2, *trainer2.dense_state())
     trainer2.load_dense_state(p2, o2)
-    np.testing.assert_array_equal(table2._store_keys, table._store_keys)
-    np.testing.assert_allclose(table2._store_vals, table._store_vals, rtol=1e-6)
+    sd, sd2 = table.state_dict(), table2.state_dict()
+    np.testing.assert_array_equal(sd2["keys"], sd["keys"])
+    np.testing.assert_allclose(sd2["values"], sd["values"], rtol=1e-6)
     # restored world trains on
     table2.begin_pass(ds.unique_keys())
     m = trainer2.train_from_dataset(ds, table2)

@@ -244,7 +244,7 @@ def test_extended_embeddings(tmp_path):
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
     # the expand tail received real (nonzero) updates
-    assert np.abs(table._store_vals[:, -tconf.expand_dim - 1 : -1]).sum() > 0
+    assert np.abs(table.state_dict()["values"][:, -tconf.expand_dim - 1 : -1]).sum() > 0
 
     # split semantics: base block == cvm(all-but-expand), expand == raw pool
     rng = np.random.default_rng(0)

@@ -164,8 +164,9 @@ def test_shrink_decays_and_evicts():
     evicted = t.shrink()
     assert evicted == 1
     assert t.n_features == 1
-    assert t._store_keys[0] == 1
-    np.testing.assert_allclose(t._store_vals[0, 0], 2.0)
+    sd = t.state_dict()
+    assert sd["keys"][0] == 1
+    np.testing.assert_allclose(sd["values"][0, 0], 2.0)
 
 
 def test_delta_tracking():

@@ -1,0 +1,176 @@
+"""Join/update two-phase training (train/two_phase.py).
+
+Reference semantics under test: phase flip between two programs per pass
+(box_wrapper.h:627-630), phase-keyed metric streams (box_wrapper.cc:
+1196-1270, boxps_worker.cc:530-540), and per-phase slot participation.
+"""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train import PhaseSpec, Trainer, TwoPhaseTrainer
+
+N_SLOTS, DENSE, B, VOCAB = 4, 4, 64, 100
+
+
+@pytest.fixture(scope="module")
+def synth(tmp_path_factory):
+    td = tmp_path_factory.mktemp("twophase")
+    conf = make_synth_config(
+        n_sparse_slots=N_SLOTS, dense_dim=DENSE, batch_size=B,
+        batch_key_capacity=B * N_SLOTS * 4,
+    )
+    paths = write_synth_files(
+        str(td), n_files=2, ins_per_file=4 * B, n_sparse_slots=N_SLOTS,
+        vocab_per_slot=VOCAB, dense_dim=DENSE, seed=11,
+    )
+    return paths, conf
+
+
+def _model():
+    tconf = SparseTableConfig(embedding_dim=8)
+    return tconf, lambda: CtrDnn(
+        n_sparse_slots=N_SLOTS, emb_width=tconf.row_width, dense_dim=DENSE,
+        hidden=(16,),
+    )
+
+
+def test_phase_state_api(synth):
+    tconf, mk = _model()
+    tp = TwoPhaseTrainer(
+        [PhaseSpec("join", mk()), PhaseSpec("update", mk())], tconf,
+        TrainerConfig(auc_buckets=1 << 10),
+    )
+    assert tp.phase == 0 and tp.phase_name == "join" and tp.phase_num == 2
+    tp.flip_phase()
+    assert tp.phase == 1 and tp.phase_name == "update"
+    tp.flip_phase()
+    assert tp.phase == 0
+    tp.set_phase(1)
+    assert tp.phase_name == "update"
+    with pytest.raises(ValueError):
+        tp.set_phase(2)
+    with pytest.raises(ValueError):
+        TwoPhaseTrainer([PhaseSpec("x", mk()), PhaseSpec("x", mk())], tconf)
+
+
+def test_two_phase_pass_distinct_streams(synth):
+    """A pass trains join then update over the same data; each phase keeps
+    its own metric stream and both learn across passes."""
+    paths, conf = synth
+    tconf, mk = _model()
+    tp = TwoPhaseTrainer(
+        [
+            PhaseSpec("join", mk(), slots=(0, 1)),
+            PhaseSpec("update", mk(), slots=(2, 3)),
+        ],
+        tconf,
+        TrainerConfig(auc_buckets=1 << 10, dense_lr=3e-3),
+    )
+    table = SparseTable(tconf)
+    ds = PadBoxSlotDataset(conf)
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    first, last = None, None
+    for _ in range(4):
+        table.begin_pass(ds.unique_keys())
+        m = tp.train_pass(ds, table)
+        table.end_pass()
+        assert set(m) == {"join", "update"}
+        # phase order: join trains first, then the flip; pass ends back at 0
+        assert tp.phase == 0
+        first = first or m
+        last = m
+    ds.close()
+    for name in ("join", "update"):
+        assert np.isfinite(last[name]["loss"])
+        # each phase's stream accumulated all 4 passes of the same data
+        assert last[name]["count"] == 4 * first[name]["count"]
+        assert last[name]["loss"] < first[name]["loss"]  # both programs learn
+    # the streams are genuinely distinct accumulators
+    sj = tp.metrics("join")["join"]
+    su = tp.metrics()["update"]
+    assert sj is not su
+    assert not np.array_equal(
+        np.asarray(sj["auc"].pos), np.asarray(su["auc"].pos)
+    )
+
+
+def test_slot_participation_gates_grads_and_counters(synth):
+    """Excluded slots must not train in a phase: their show counters stay
+    zero and their embeddings keep the deterministic init (synth keys are
+    slot-disjoint: slot s owns [s*VOCAB+1, (s+1)*VOCAB])."""
+    paths, conf = synth
+    tconf, mk = _model()
+    trainer = Trainer(
+        mk(), tconf, TrainerConfig(auc_buckets=1 << 10), slot_mask=(0, 1)
+    )
+    table = SparseTable(tconf)
+    ds = PadBoxSlotDataset(conf)
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    table.begin_pass(ds.unique_keys())
+    trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    ds.close()
+    sd = table.state_dict()
+    in_phase = sd["keys"] <= np.uint64(2 * VOCAB)
+    # participating slots saw traffic
+    assert sd["values"][in_phase, 0].sum() > 0
+    # excluded slots: zero show AND zero clk
+    np.testing.assert_array_equal(sd["values"][~in_phase, :2], 0.0)
+    # excluded embeddings unchanged from the key-deterministic init
+    from paddlebox_tpu.sparse.table import _key_uniform
+
+    out_keys = sd["keys"][~in_phase]
+    expect = _key_uniform(
+        out_keys, seed=0, n_cols=tconf.row_width - tconf.cvm_offset,
+        rng_range=tconf.initial_range,
+    )
+    np.testing.assert_allclose(
+        sd["values"][~in_phase, tconf.cvm_offset : tconf.row_width], expect,
+        rtol=1e-6,
+    )
+    # and their g2sum never moved
+    np.testing.assert_array_equal(sd["values"][~in_phase, -1], 0.0)
+
+
+def test_single_phase_matches_plain_trainer(synth):
+    """A one-phase TwoPhaseTrainer with no slot mask is exactly a Trainer
+    (same seed -> identical loss/auc): the phase machinery adds nothing."""
+    paths, conf = synth
+    tconf, mk = _model()
+    trconf = TrainerConfig(auc_buckets=1 << 10)
+
+    def run_plain():
+        t = Trainer(mk(), tconf, trconf, seed=0)
+        table = SparseTable(tconf)
+        ds = PadBoxSlotDataset(conf)
+        ds.set_filelist(paths)
+        ds.load_into_memory()
+        table.begin_pass(ds.unique_keys())
+        m = t.train_from_dataset(ds, table)
+        table.end_pass()
+        ds.close()
+        return m
+
+    def run_phased():
+        tp = TwoPhaseTrainer([PhaseSpec("only", mk())], tconf, trconf, seed=0)
+        table = SparseTable(tconf)
+        ds = PadBoxSlotDataset(conf)
+        ds.set_filelist(paths)
+        ds.load_into_memory()
+        table.begin_pass(ds.unique_keys())
+        m = tp.train_pass(ds, table)["only"]
+        table.end_pass()
+        ds.close()
+        return m
+
+    a, b = run_plain(), run_phased()
+    assert a["loss"] == pytest.approx(b["loss"], rel=1e-6)
+    assert a["auc"] == pytest.approx(b["auc"], rel=1e-6)
