@@ -276,6 +276,17 @@ class PadBoxSlotDataset:
         self._pv_starts = None
         self._pv_perm = None
 
+    def pv_state(self) -> tuple:
+        """Opaque snapshot of the PV grouping (including any shuffle order)
+        for restore_pv_state — lets a caller drop to instance mode and come
+        back WITHOUT re-deriving the grouping (which would reset the PV
+        permutation a local/global shuffle established).  Used by the
+        two-phase trainer's per-phase PV gating (train/two_phase.py)."""
+        return (self._pv_order, self._pv_starts, self._pv_perm)
+
+    def restore_pv_state(self, state: tuple) -> None:
+        (self._pv_order, self._pv_starts, self._pv_perm) = state
+
     @property
     def pv_mode(self) -> bool:
         return getattr(self, "_pv_order", None) is not None

@@ -1,0 +1,115 @@
+"""Host control-plane collectives over the JAX coordination service.
+
+The data plane (pull/push all_to_alls, dense psums) rides ICI inside the
+jitted step.  The PLANNING plane — tail barriers, bucket-capacity
+consensus, want-matrix exchange — must not: those collectives run on the
+feed-producer thread, concurrent with the consumer's device step, and two
+threads enqueueing device collectives in racing order across processes is
+a cross-process deadlock (each device queue matches collectives by
+submission order).  ``multihost_utils.process_allgather`` IS a device
+collective, so the planning plane needs a genuinely host-side transport.
+
+This is the coordination-service KV store (SURVEY.md §2.10: "bootstrap =
+JAX coordination service; CPU-side barrier = the same coordination service
+KV store" — the Gloo-with-HTTP-KV-rendezvous analog, reference
+fleet/gloo_wrapper.h:136-150).  Each logical stream gets a ``KvChannel``
+with an independent key namespace and sequence counter, so streams on
+different threads can never pair mismatched payloads: an allgather at
+sequence s only ever reads peers' keys at the same (channel, s).
+
+Deadlock-freedom: a blocking get waits for one specific key, not for queue
+order — processes may interleave channels arbitrarily.  GC: a process
+deletes its own key for sequence s when it posts s+2; a peer that has
+posted s+1 has, by the channel's lockstep definition, already finished
+reading every key at s, so a two-deep window is always safe.
+"""
+
+from __future__ import annotations
+
+import base64
+
+import numpy as np
+
+
+def _client():
+    """The process's coordination-service client (requires
+    jax.distributed.initialize, which the launcher performs)."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "coordination service unavailable: host-plane collectives need "
+            "jax.distributed.initialize (use paddlebox_tpu.launch)"
+        )
+    return client
+
+
+class KvChannel:
+    """One ordered allgather stream over the coordination-service KV store.
+
+    Every process must construct the channel with the SAME name and call
+    ``allgather`` the same number of times in the same logical order —
+    exactly the contract device collectives already impose, minus the
+    shared-queue entanglement with other streams.
+    """
+
+    def __init__(self, name: str, timeout_s: float = 600.0):
+        self.name = name
+        self.timeout_ms = int(timeout_s * 1000)
+        self._seq = 0
+        import jax
+
+        self._rank = jax.process_index()
+        self._world = jax.process_count()
+
+    def _key(self, seq: int, rank: int) -> str:
+        return f"pbox_hp/{self.name}/{seq}/{rank}"
+
+    def allgather(self, x: np.ndarray) -> np.ndarray:
+        """Gather a same-shape/dtype host array from every process ->
+        [P, ...] (matches multiprocess.host_allgather's contract)."""
+        x = np.ascontiguousarray(x)
+        client = _client()
+        s = self._seq
+        self._seq += 1
+        client.key_value_set(
+            self._key(s, self._rank),
+            base64.b64encode(x.tobytes()).decode("ascii"),
+        )
+        parts = []
+        for r in range(self._world):
+            if r == self._rank:
+                parts.append(x)
+                continue
+            raw = client.blocking_key_value_get(
+                self._key(s, r), self.timeout_ms
+            )
+            parts.append(
+                np.frombuffer(
+                    base64.b64decode(raw), dtype=x.dtype
+                ).reshape(x.shape)
+            )
+        # windowed GC of our own past key (see module docstring)
+        if s >= 2:
+            self._delete(s - 2)
+        return np.stack(parts)
+
+    def _delete(self, seq: int) -> None:
+        try:
+            _client().key_value_delete(self._key(seq, self._rank))
+        except Exception:
+            pass  # older runtimes without delete: key leaks, bounded by close
+
+    def close(self) -> None:
+        """Delete this process's remaining keys (the last two sequences).
+
+        Channels are per-pass and names never reuse, so WITHOUT this a
+        long job leaks P keys per pass — one of them a full want matrix —
+        into the coordination-service leader.  Safe to call once every
+        peer has finished the channel's final allgather; the trainer calls
+        it after the pass barrier (whose completion proves exactly that).
+        """
+        for s in (self._seq - 1, self._seq - 2):
+            if s >= 0:
+                self._delete(s)

@@ -63,6 +63,12 @@ from paddlebox_tpu.sparse.table import gather_rows, scatter_add_rows
 
 shard_map = jax.shard_map
 
+# process-wide pass counter for host-plane channel names: advances once per
+# training pass in every process (all processes drive passes in lockstep,
+# the same assumption collectives already impose), so channels stay unique
+# even across multiple MultiChipTrainer instances
+_PLAN_CHANNEL_SEQ = [0]
+
 
 def _stack_group(
     batches: Sequence[HostBatch],
@@ -497,22 +503,46 @@ class MultiChipTrainer:
         mstate = self._init_mstate(auc_state)
         values, g2sum = table.values, table.g2sum
         losses, counts, n_steps = [], [], 0
-        n_slots = None
         uses_rank = getattr(self.model, "uses_rank_offset", False)
-        template = None  # last real batch: shapes for tail-padding groups
-        groups = iter(groups)
-        try:
+
+        # the producer's collectives must be HOST-side: it runs concurrent
+        # with the consumer's device step, and two threads racing device
+        # collectives onto the queues in different orders across processes
+        # is a cross-process deadlock.  Each pass gets its own KV channel
+        # (deterministic name: every process increments in lockstep).
+        plan_channel = None
+        if multiproc:
+            from paddlebox_tpu.parallel.host_plane import KvChannel
+
+            _PLAN_CHANNEL_SEQ[0] += 1
+            plan_channel = KvChannel(f"plan-{_PLAN_CHANNEL_SEQ[0]}")
+            plan_gather = plan_channel.allgather
+        else:
+            plan_gather = host_allgather  # no-op [1, ...] wrap
+
+        def produce_feeds():
+            """Barrier + host planning + stack + H2D for every group.
+
+            Runs on the prefetch thread so the per-batch want-matrix
+            allgather and feed assembly overlap the device step (the
+            single-chip _FeedPrefetcher discipline, VERDICT r3 next #6a).
+            All its cross-process exchanges ride the host-plane KV channel
+            above — it never touches the device queues, so it cannot
+            deadlock against the consumer's step collectives."""
+            groups_it = iter(groups)
+            template = None  # last real batch: shapes for tail-padding
+            n_slots = None
             while True:
-                group = next(groups, None)
+                group = next(groups_it, None)
                 if multiproc:
                     # ragged-tail barrier: a process out of groups must keep
                     # stepping with empty batches while any peer still has
                     # data, or the peers hang in the next all_to_all
-                    left = host_allgather(
+                    left = plan_gather(
                         np.asarray([0 if group is None else 1], np.int64)
                     )
                     if int(left.sum()) == 0:
-                        break
+                        return
                     if group is None:
                         if template is None:
                             raise RuntimeError(
@@ -523,7 +553,7 @@ class MultiChipTrainer:
                     else:
                         template = group[0]
                 elif group is None:
-                    break
+                    return
                 if n_slots is None:
                     n_slots = group[0].n_sparse_slots
                 if uses_rank and group[0].rank_offset is None:
@@ -545,9 +575,21 @@ class MultiChipTrainer:
                         "DataFeedConfig.task_label_slots with "
                         f"{self.n_tasks - 1} slots (task 0 is the primary label)"
                     )
-                plan = table.plan_group(group)
+                plan = table.plan_group(group, gather=plan_gather)
                 feed = _stack_group(group, plan, n_slots, self.metric_group)
-                feed = global_from_local(self._sharding, feed)
+                yield global_from_local(self._sharding, feed)
+
+        feed_iter = produce_feeds()
+        prefetcher = None
+        if self.conf.prefetch_batches > 0:
+            from paddlebox_tpu.train.trainer import _FeedPrefetcher
+
+            prefetcher = _FeedPrefetcher(
+                feed_iter, self.conf.prefetch_batches
+            )
+            feed_iter = prefetcher
+        try:
+            for feed in feed_iter:
                 out = self._step_fn(
                     self.params, self.opt_state, values, g2sum, mstate, feed
                 )
@@ -593,6 +635,8 @@ class MultiChipTrainer:
             # hand the live ones back so end_pass() can salvage the pass even
             # when check_nan_inf raises mid-loop
             table.values, table.g2sum = values, g2sum
+            if prefetcher is not None:
+                prefetcher.close()
         # cross-device merge: sum each stream's histograms over the device
         # axis (multi-host: jitted replicated sum + local read,
         # collect_data_nccl analog)
@@ -634,9 +678,17 @@ class MultiChipTrainer:
             metrics["loss"] = 0.0
         metrics["steps"] = n_steps
         metrics["missing_keys"] = table.missing_key_count
-        metrics["overflow_keys"] = table.overflow_key_count
+        metrics["overflow_keys"] = table.overflow_key_count  # always 0 now
+        metrics["capacity_bumps"] = table.capacity_bumps
         self.last_auc_state = mstate["auc"]
         self.last_metric_state = mstate
+        if plan_channel is not None:
+            # every peer has joined the metric collectives above, which it
+            # can only do after its producer read ALL of this channel's
+            # keys — deleting the final two sequences is now race-free.
+            # (Skipped on the exception path: peers may still be blocked on
+            # a get; two leaked keys on a dying pass is the lesser evil.)
+            plan_channel.close()
         return metrics
 
     # -- inference / evaluation -------------------------------------------- #

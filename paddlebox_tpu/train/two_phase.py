@@ -130,19 +130,16 @@ class TwoPhaseTrainer:
         kw.setdefault("auc_state", tr.last_metric_state or None)
         restore_pv = (not spec.use_pv) and pv_capable and dataset.pv_mode
         if restore_pv:
-            # save/restore the PV grouping state rather than recomputing it:
-            # preprocess_instance() would reset _pv_perm and discard any
-            # local/global shuffle order the user set up for the PV phases
-            pv_state = (
-                dataset._pv_order, dataset._pv_starts, dataset._pv_perm
-            )
+            # snapshot/restore the PV grouping rather than recomputing it:
+            # re-running preprocess_instance() would reset the PV
+            # permutation and discard any shuffle order the user set up
+            pv_state = dataset.pv_state()
             dataset.postprocess_instance()
         try:
             return tr.train_from_dataset(dataset, table, **kw)
         finally:
             if restore_pv:
-                (dataset._pv_order, dataset._pv_starts,
-                 dataset._pv_perm) = pv_state
+                dataset.restore_pv_state(pv_state)
 
     def train_pass(self, dataset, table, drop_last: bool = False) -> dict:
         """Train every phase over the same pass, flipping between: the full
