@@ -203,6 +203,11 @@ class SparseTableConfig:
 
     # sparse optimizer: adagrad with scalar g2sum (Baidu abacus-style)
     learning_rate: float = 0.05
+    # per-slot learning-rate overrides: ((slot, lr), ...) — slots not listed
+    # use `learning_rate`.  The BoxPS LR map analog (reference: GetLRMap/
+    # SetLRMap, box_wrapper.h:631; per-param lr consumed by the PS update).
+    # Single-chip Trainer path; ShardedSparseTable rejects it for now.
+    slot_learning_rates: Sequence = ()
     initial_g2sum: float = 3.0
     initial_range: float = 0.02  # uniform init range for new features
     # feature admission / eviction (reference: ShrinkTable semantics)
@@ -300,6 +305,11 @@ class TrainerConfig:
     # non-finite one pass state through untouched, so at most ONE corrupted
     # update lands (same blast radius as scan_steps=1).
     scan_steps: int = 1
+    # multi-host planning-plane patience: how long one host-plane KV
+    # gather waits for a straggling peer (covers first-compile and
+    # capacity-bump recompile stalls; the device collectives it replaced
+    # waited indefinitely)
+    host_plane_timeout_s: float = 3600.0
     # per-stage host timing (reference: TrainFilesWithProfiler — a slower
     # diagnostic mode: the device step is synchronized every batch)
     profile: bool = False

@@ -14,6 +14,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddlebox_tpu.models.layers import init_mlp, mlp, resolve_compute_dtype
 from paddlebox_tpu.ops import (
@@ -39,6 +40,7 @@ class CtrDnn:
         compute_dtype: str = "",  # "" -> flags.compute_dtype (PBOX_COMPUTE_DTYPE)
         layout: str = "default",  # "default" | "conv" (show/clk/conv counters)
         show_filter: bool = False,  # conv layout: drop the show column
+        slot_embed_dims=None,  # ((slot, dim), ...): per-slot embedx width
     ):
         self.compute_dtype = resolve_compute_dtype(compute_dtype)
         if layout not in ("default", "conv"):
@@ -64,6 +66,26 @@ class CtrDnn:
             base_w, cvm_offset, use_cvm, layout=layout, show_filter=show_filter
         )
         self.input_dim = n_sparse_slots * (pooled_w + expand_dim) + dense_dim
+        # per-slot variable embedding dims, realized as column masks over
+        # the shared [*, emb_width] row (the FEATURE_VARIABLE layout
+        # analog, reference box_wrapper.cc:404-566 per-slot dim dispatch):
+        # slot s uses its first dim_s embedx columns; the rest read zero
+        # and — because the mask applies inside apply(), hence inside the
+        # loss — receive zero gradients, so training, eval, and the export
+        # path all see one consistent semantic.
+        self._dim_mask = None
+        if slot_embed_dims:
+            emb_cols = base_w - cvm_offset
+            mask = np.ones((n_sparse_slots, emb_width), np.float32)
+            for slot, dim in slot_embed_dims:
+                if not 0 <= slot < n_sparse_slots:
+                    raise ValueError(f"slot_embed_dims slot {slot} out of range")
+                if not 0 < dim <= emb_cols:
+                    raise ValueError(
+                        f"slot {slot} dim {dim} not in (0, {emb_cols}]"
+                    )
+                mask[slot, cvm_offset + dim : base_w] = 0.0
+            self._dim_mask = mask
 
     def init(self, key: jax.Array) -> dict:
         return {"tower": init_mlp(key, self.input_dim, self.hidden, 1)}
@@ -77,6 +99,12 @@ class CtrDnn:
         batch_size: int,
     ) -> jax.Array:
         """Returns logits [B]."""
+        if self._dim_mask is not None:
+            # variable per-slot dims: zero each occurrence's masked embedx
+            # columns (padding occurrences index slot 0 harmlessly — their
+            # rows are dead-row zeros)
+            mask = jnp.asarray(self._dim_mask)
+            rows = rows * mask[key_segments % self.n_sparse_slots]
         if self.expand_dim:
             base, expand = fused_seqpool_cvm_extended(
                 rows, key_segments, batch_size, self.n_sparse_slots,

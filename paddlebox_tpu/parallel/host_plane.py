@@ -54,7 +54,11 @@ class KvChannel:
     shared-queue entanglement with other streams.
     """
 
-    def __init__(self, name: str, timeout_s: float = 600.0):
+    def __init__(self, name: str, timeout_s: float = 3600.0):
+        # default 1h: a peer legitimately stalls this long during a first
+        # XLA compile or a capacity-bump recompile with a full prefetch
+        # queue — the device-collective path this replaces would simply
+        # have waited, so the KV plane must not be the stricter one
         self.name = name
         self.timeout_ms = int(timeout_s * 1000)
         self._seq = 0
@@ -62,6 +66,7 @@ class KvChannel:
 
         self._rank = jax.process_index()
         self._world = jax.process_count()
+        self._pool = None  # lazy: parallel peer reads (see allgather)
 
     def _key(self, seq: int, rank: int) -> str:
         return f"pbox_hp/{self.name}/{seq}/{rank}"
@@ -77,19 +82,31 @@ class KvChannel:
             self._key(s, self._rank),
             base64.b64encode(x.tobytes()).decode("ascii"),
         )
-        parts = []
-        for r in range(self._world):
-            if r == self._rank:
-                parts.append(x)
-                continue
+
+        def read(r: int) -> np.ndarray:
             raw = client.blocking_key_value_get(
                 self._key(s, r), self.timeout_ms
             )
-            parts.append(
-                np.frombuffer(
-                    base64.b64decode(raw), dtype=x.dtype
-                ).reshape(x.shape)
-            )
+            return np.frombuffer(
+                base64.b64decode(raw), dtype=x.dtype
+            ).reshape(x.shape)
+
+        peers = [r for r in range(self._world) if r != self._rank]
+        if len(peers) > 1:
+            # concurrent reads: sequential blocking gets would serialize
+            # (P-1) round-trips to the coordination leader per gather
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=min(len(peers), 16),
+                    thread_name_prefix=f"kvch-{self.name}",
+                )
+            fetched = dict(zip(peers, self._pool.map(read, peers)))
+        else:
+            fetched = {r: read(r) for r in peers}
+        parts = [x if r == self._rank else fetched[r]
+                 for r in range(self._world)]
         # windowed GC of our own past key (see module docstring)
         if s >= 2:
             self._delete(s - 2)
@@ -113,3 +130,6 @@ class KvChannel:
         for s in (self._seq - 1, self._seq - 2):
             if s >= 0:
                 self._delete(s)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None

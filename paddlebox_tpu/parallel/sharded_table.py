@@ -105,6 +105,13 @@ class ShardedSparseTable(SparseTable):
         bucket_slack: float = 2.0,
     ):
         super().__init__(conf, seed)
+        if conf.slot_learning_rates:
+            raise NotImplementedError(
+                "slot_learning_rates is single-chip only for now: the "
+                "sharded push merges by served row and would need per-row "
+                "slot resolution on the serve side (use per-slot embedding "
+                "dims — model-side masks — which work on every path)"
+            )
         self.mesh = mesh
         self.n_shards = int(mesh.devices.size)
         # all_to_all bucket capacity multiplier over the uniform-hash

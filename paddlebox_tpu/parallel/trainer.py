@@ -515,7 +515,10 @@ class MultiChipTrainer:
             from paddlebox_tpu.parallel.host_plane import KvChannel
 
             _PLAN_CHANNEL_SEQ[0] += 1
-            plan_channel = KvChannel(f"plan-{_PLAN_CHANNEL_SEQ[0]}")
+            plan_channel = KvChannel(
+                f"plan-{_PLAN_CHANNEL_SEQ[0]}",
+                timeout_s=self.conf.host_plane_timeout_s,
+            )
             plan_gather = plan_channel.allgather
         else:
             plan_gather = host_allgather  # no-op [1, ...] wrap

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 def sparse_adagrad_update(
     g2sum: jax.Array,
     grad: jax.Array,
-    learning_rate: float,
+    learning_rate,  # scalar, or [U] per-row lr (the LR-map analog)
     initial_g2sum: float,
     grad_clip: float,
 ):

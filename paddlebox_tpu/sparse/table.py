@@ -354,6 +354,7 @@ def push_and_update(
     key_clicks: jax.Array,
     conf: SparseTableConfig,
     key_extras: Optional[jax.Array] = None,
+    uniq_lr: Optional[jax.Array] = None,
 ):
     """Merge per-occurrence gradients by unique key and apply the sparse
     optimizer + show/clk counter update (reference: PushSparseGradCase,
@@ -366,6 +367,9 @@ def push_and_update(
     key_extras: [K, cvm_offset - 2] extra counter increments per occurrence
         (e.g. conversion events for the conv layout's third counter,
         reference FeaturePushValueGpuConv); zeros when absent.
+    uniq_lr: optional [U] per-unique-key learning rates (the BoxPS LR-map
+        analog: the Trainer resolves each key's slot-group lr host-side,
+        reference box_wrapper.h:631 GetLRMap).  None = conf.learning_rate.
     Returns (values, g2sum) updated.
     """
     del plan_idx  # pull-side only; kept in the signature for symmetry
@@ -378,8 +382,9 @@ def push_and_update(
     # sparse adagrad on the embedding columns
     g = merged[:, co:]
     g2_rows = jnp.take(g2sum, plan_uniq_idx)
+    lr = conf.learning_rate if uniq_lr is None else uniq_lr
     w_delta, g2_delta = sparse_adagrad_update(
-        g2_rows, g, conf.learning_rate, conf.initial_g2sum, conf.grad_clip,
+        g2_rows, g, lr, conf.initial_g2sum, conf.grad_clip,
     )
     counter_delta = jnp.stack([show_inc, clk_inc], axis=1)
     if co > 2:

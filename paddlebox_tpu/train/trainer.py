@@ -50,11 +50,17 @@ class TrainState:
 
 
 def _host_batch_dict(
-    batch: HostBatch, plan, n_slots: int, counter_label_tasks=()
+    batch: HostBatch, plan, n_slots: int, counter_label_tasks=(),
+    slot_lr_vec: Optional[np.ndarray] = None,
 ) -> dict:
     """Assemble the static-shape feed (numpy leaves) from a HostBatch +
     BatchPlan — _device_batch without the H2D transfer, so multi-step scan
-    groups can stack on the host and transfer once."""
+    groups can stack on the host and transfer once.
+
+    slot_lr_vec: [S] per-slot learning rates; when given the feed carries
+    "uniq_lr" [K], each unique key's lr resolved from the slot of (one of)
+    its occurrences — the host side of the BoxPS LR map
+    (box_wrapper.h:631)."""
     ins = np.minimum(batch.key_segments // n_slots, batch.batch_size - 1)
     key_clicks = batch.labels[ins] * plan.key_mask
     dev = {
@@ -94,6 +100,18 @@ def _host_batch_dict(
             axis=1,
         ).astype(np.float32)
         dev["key_extras"] = extras
+    if slot_lr_vec is not None:
+        K = batch.key_segments.shape[0]
+        uniq_lr = np.full(K, slot_lr_vec.mean(), np.float32)  # padding tail
+        n_real = batch.n_keys
+        if n_real:
+            # inverse[:n_real] maps occurrences -> unique slots; last
+            # assignment wins (keys never span slots in practice, and the
+            # reference's slot-keyed pull makes the same assumption)
+            uniq_lr[plan.inverse[:n_real]] = slot_lr_vec[
+                batch.key_segments[:n_real] % n_slots
+            ]
+        dev["uniq_lr"] = uniq_lr
     return dev
 
 
@@ -230,6 +248,21 @@ class Trainer:
             )
         self.metric_group = metric_group
         self.n_tasks = getattr(model, "n_tasks", 1)
+        # per-slot LR map (reference: BoxPS GetLRMap/SetLRMap,
+        # box_wrapper.h:631): resolved host-side into a [S] vector; the
+        # feed carries per-unique-key lr ("uniq_lr") when configured
+        self._slot_lr_vec: Optional[np.ndarray] = None
+        if table_conf.slot_learning_rates:
+            S = model.n_sparse_slots
+            v = np.full(S, table_conf.learning_rate, np.float32)
+            for slot, lr in table_conf.slot_learning_rates:
+                if not 0 <= slot < S:
+                    raise ValueError(
+                        f"slot_learning_rates slot {slot} out of range "
+                        f"for {S} sparse slots"
+                    )
+                v[slot] = lr
+            self._slot_lr_vec = v
         if self.conf.dense_optimizer == "adam":
             self.optimizer = optax.adam(self.conf.dense_lr)
         elif self.conf.dense_optimizer == "sgd":
@@ -317,6 +350,7 @@ class Trainer:
                 values, g2sum, row_grads, batch["idx"], batch["uniq_idx"],
                 batch["inverse"], key_mask, key_clicks, tconf,
                 key_extras=key_extras,
+                uniq_lr=batch.get("uniq_lr"),
             )
             primary = preds[:, 0] if n_tasks > 1 else preds
             mstate = dict(mstate)
@@ -513,6 +547,7 @@ class Trainer:
                     host = _host_batch_dict(
                         batch, plan, batch.n_sparse_slots,
                         self.conf.counter_label_tasks,
+                        slot_lr_vec=self._slot_lr_vec,
                     )
                     if self.metric_group is not None:
                         host["metric_masks"] = self.metric_group.masks(batch)
