@@ -5,6 +5,7 @@ from paddlebox_tpu.models.dcn import DCN
 from paddlebox_tpu.models.deepfm import DeepFM
 from paddlebox_tpu.models.layers import bce_with_logits, init_mlp, linear, mlp
 from paddlebox_tpu.models.mmoe import MMoE
+from paddlebox_tpu.models.pipelined_ctr import PipelinedCtrDnn
 from paddlebox_tpu.models.rank_ctr import RankCtrDnn
 from paddlebox_tpu.models.wide_deep import WideDeep
 from paddlebox_tpu.models.xdeepfm import XDeepFM
@@ -14,6 +15,7 @@ __all__ = [
     "DCN",
     "DeepFM",
     "MMoE",
+    "PipelinedCtrDnn",
     "RankCtrDnn",
     "WideDeep",
     "XDeepFM",

@@ -89,6 +89,50 @@ def _stage_apply(p: dict, x_inject: jax.Array, carry: jax.Array,
     return h, logits
 
 
+def gpipe_run(stage_fn, emit_fn, n_microbatches: int, act0: jax.Array):
+    """The GPipe loop-skew schedule skeleton, shared by
+    ``pipeline_forward_loss`` (uniform demo tower) and
+    ``models/pipelined_ctr.py`` (the real CTR tower) so the subtle
+    collective code — T = M+P-1 ticks, clip-injection, ppermute edge list,
+    the pcast-varying carry workaround — lives exactly once.
+
+    Call INSIDE shard_map over the pipe axis.
+      stage_fn(m_in, act, is_first) -> (act_out, aux): this device's stage
+          on tick input (m_in = clipped microbatch index for stage 0's
+          injection; act = carried activation).
+      emit_fn(aux, m_out, valid) -> pytree emitted each tick (m_out = the
+          microbatch the LAST stage completes this tick, clipped; valid =
+          is_last & tick within range).
+    Returns emissions stacked [T, ...].
+    """
+    p_axis = jax.lax.axis_size(PIPE_AXIS)
+    idx = jax.lax.axis_index(PIPE_AXIS)
+    M = n_microbatches
+    T = M + p_axis - 1
+    is_first = idx == 0
+    is_last = idx == p_axis - 1
+
+    def tick(act, t):
+        m_in = jnp.clip(t, 0, M - 1)  # stage 0's injected microbatch
+        act_out, aux = stage_fn(m_in, act, is_first)
+        # last stage: tick t completes microbatch t - (P-1)
+        m_out = t - (p_axis - 1)
+        valid = is_last & (m_out >= 0)
+        em = emit_fn(aux, jnp.clip(m_out, 0, M - 1), valid)
+        # shift activations one stage down the ring (last stage's output
+        # falls off the end — the emit already consumed it)
+        act_next = jax.lax.ppermute(
+            act_out, PIPE_AXIS, [(i, i + 1) for i in range(p_axis - 1)]
+        )
+        return act_next, em
+
+    # the carry becomes device-varying after the first tick: mark it so up
+    # front (shard_map's varying-axes typing requires carry in/out to match)
+    vary = lambda v: jax.lax.pcast(v, (PIPE_AXIS,), to="varying")
+    _, emits = jax.lax.scan(tick, vary(act0), jnp.arange(T))
+    return emits
+
+
 def pipeline_forward_loss(
     stage_params: dict,
     x: jax.Array,  # [M, mb, d_in] microbatches (replicated; stage 0 reads)
@@ -97,45 +141,23 @@ def pipeline_forward_loss(
 ) -> jax.Array:
     """Mean sigmoid-BCE over all real instances — call INSIDE shard_map over
     the pipe axis; stage_params are this device's (leading axis stripped)."""
-    p_axis = jax.lax.axis_size(PIPE_AXIS)
-    idx = jax.lax.axis_index(PIPE_AXIS)
     M, mb, _ = x.shape
-    T = M + p_axis - 1
     width = stage_params["proj_b"].shape[0]
-    is_first = (idx == 0)
-    is_last = (idx == p_axis - 1)
 
-    def tick(carry, t):
-        act, loss_sum, cnt_sum = carry
-        m_in = jnp.clip(t, 0, M - 1)  # stage 0's injected microbatch
-        act_out, logits = _stage_apply(
-            stage_params, x[m_in], act, is_first
-        )
-        # last stage: tick t completes microbatch t - (P-1)
-        m_out = t - (p_axis - 1)
-        valid = is_last & (m_out >= 0)
-        m_oc = jnp.clip(m_out, 0, M - 1)
-        lab, msk = y[m_oc], mask[m_oc] * valid
+    def stage_fn(m_in, act, is_first):
+        return _stage_apply(stage_params, x[m_in], act, is_first)
+
+    def emit_fn(logits, m_out, valid):
+        lab, msk = y[m_out], mask[m_out] * valid
         per = optax.sigmoid_binary_cross_entropy(logits, lab) * msk
-        loss_sum = loss_sum + per.sum()
-        cnt_sum = cnt_sum + msk.sum()
-        # shift activations one stage down the ring (last stage's output
-        # falls off the end — the head already consumed it)
-        act_next = jax.lax.ppermute(
-            act_out, PIPE_AXIS, [(i, i + 1) for i in range(p_axis - 1)]
-        )
-        return (act_next, loss_sum, cnt_sum), None
+        return per.sum(), msk.sum()
 
-    # the carry becomes device-varying after the first tick: mark it so up
-    # front (shard_map's varying-axes typing requires carry in/out to match)
-    vary = lambda v: jax.lax.pcast(v, (PIPE_AXIS,), to="varying")
-    act0 = vary(jnp.zeros((mb, width), x.dtype))
-    (_, loss_sum, cnt_sum), _ = jax.lax.scan(
-        tick, (act0, vary(jnp.zeros(())), vary(jnp.zeros(()))), jnp.arange(T)
+    losses, cnts = gpipe_run(
+        stage_fn, emit_fn, M, jnp.zeros((mb, width), x.dtype)
     )
     # only the last stage accumulated: share with everyone
-    loss_sum = jax.lax.psum(loss_sum, PIPE_AXIS)
-    cnt_sum = jax.lax.psum(cnt_sum, PIPE_AXIS)
+    loss_sum = jax.lax.psum(losses.sum(), PIPE_AXIS)
+    cnt_sum = jax.lax.psum(cnts.sum(), PIPE_AXIS)
     return loss_sum / jnp.maximum(cnt_sum, 1.0)
 
 
