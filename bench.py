@@ -100,18 +100,65 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
         done.set()
 
 
+def start_deadline(seconds: float) -> None:
+    """Global run watchdog: exit(4) if the whole bench exceeds ``seconds``.
+
+    An internal graceful exit is strictly better than an external kill: the
+    incremental emit() line is already flushed, and — critically on the axon
+    tunnel — a SIGKILLed client leaves the server holding a stale lease that
+    hangs every subsequent backend init (observed r3 and again r4).  Never
+    let the driver or a shell timeout be the thing that stops bench.py."""
+    import threading
+
+    t0 = time.time()
+
+    def boom():
+        while True:
+            left = seconds - (time.time() - t0)
+            if left <= 0:
+                log(f"FATAL: bench exceeded --max-seconds={seconds:.0f}; "
+                    "exiting gracefully (see emit() partial line)")
+                os._exit(4)
+            time.sleep(min(left, 10.0))
+
+    threading.Thread(target=boom, daemon=True).start()
+
+
+def make_model(name: str, n_slots: int, row_width: int, dense_dim: int,
+               hidden) -> tuple:
+    """(model, n_task_labels) for the benchmark model zoo (BASELINE.md
+    configs 1-5)."""
+    from paddlebox_tpu.models import MMoE, DCN, CtrDnn, DeepFM, WideDeep, XDeepFM
+
+    if name == "ctr_dnn":
+        return CtrDnn(n_slots, row_width, dense_dim=dense_dim, hidden=hidden), 0
+    if name == "deepfm":
+        return DeepFM(n_slots, row_width, dense_dim=dense_dim), 0
+    if name == "widedeep":
+        return WideDeep(n_slots, row_width, dense_dim=dense_dim), 0
+    if name == "xdeepfm":
+        return XDeepFM(n_slots, row_width, dense_dim=dense_dim), 0
+    if name == "dcn":
+        return DCN(n_slots, row_width, dense_dim=dense_dim), 0
+    if name == "mmoe":
+        return MMoE(n_slots, row_width, dense_dim=dense_dim, n_tasks=2), 1
+    raise ValueError(f"unknown --model {name!r}")
+
+
 def build_data(td: str, n_slots: int, dense_dim: int, batch_size: int,
-               n_ins: int, vocab_per_slot: int):
+               n_ins: int, vocab_per_slot: int, n_task_labels: int = 0):
     from paddlebox_tpu.data.dataset import PadBoxSlotDataset
     from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
 
     conf = make_synth_config(
         n_sparse_slots=n_slots, dense_dim=dense_dim, batch_size=batch_size,
         max_feasigns_per_ins=64, batch_key_capacity=batch_size * n_slots * 4,
+        n_task_labels=n_task_labels,
     )
     files = write_synth_files(
         td, n_files=4, ins_per_file=n_ins // 4, n_sparse_slots=n_slots,
         vocab_per_slot=vocab_per_slot, dense_dim=dense_dim, seed=7,
+        n_task_labels=n_task_labels,
     )
     ds = PadBoxSlotDataset(conf, read_threads=4)
     ds.set_filelist(files)
@@ -190,6 +237,95 @@ def bench_trainer_path(ds, tconf, trconf, model, seed=0):
         f"scan={trconf.scan_steps}): {n} samples in {dt:.2f}s = "
         f"{sps:,.0f} samples/s")
     return sps
+
+
+def device_profile(ds, tconf, trconf, model, scan_k: int = 8, seed=0):
+    """Pin down WHERE per-step time goes on the real chip: device-step-only
+    (feed reused, no host work), H2D-only, scan-group-only (stacked feed
+    reused), then the composed async loop.  Each number isolates one stage
+    of the pipeline; disagreement between their sum and the composed loop
+    exposes serialization (the r4 diagnosis tool for the trainer-path
+    regression)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.train.trainer import Trainer, _host_batch_dict, _to_device
+
+    table = SparseTable(tconf, seed=seed)
+    table.begin_pass(ds.unique_keys())
+    trainer = Trainer(model, tconf, trconf, seed=seed)
+    trainer._step_fn = trainer._build_step()
+    mstate = trainer._init_mstate()
+    values, g2sum = table.values, table.g2sum
+    params, opt_state = trainer.params, trainer.opt_state
+    log(f"table rows: {values.shape}")
+
+    batches = list(ds.batches(drop_last=True))
+    n_slots = batches[0].n_sparse_slots
+    B = batches[0].batch_size
+
+    hosts = []
+    t0 = time.perf_counter()
+    for b in batches:
+        plan = table.plan_batch(b)
+        hosts.append(_host_batch_dict(b, plan, n_slots))
+    host_ms = (time.perf_counter() - t0) / len(batches) * 1e3
+    log(f"host plan+assemble: {host_ms:.2f} ms/batch")
+
+    feed_mb = sum(np.asarray(v).nbytes for v in hosts[0].values()) / 1e6
+    dev = _to_device(hosts[0])
+    jax.block_until_ready(dev)
+    t0 = time.perf_counter()
+    for h in hosts[:10]:
+        jax.block_until_ready(_to_device(h))
+    h2d_ms = (time.perf_counter() - t0) / 10 * 1e3
+    log(f"H2D: {feed_mb:.2f} MB/feed, {h2d_ms:.2f} ms/feed")
+
+    # device step alone: same feed, state carried, block only at the end
+    out = trainer._step_fn(params, opt_state, values, g2sum, mstate, dev)
+    jax.block_until_ready(out[5])
+    params, opt_state, values, g2sum, mstate = out[:5]
+    n_it = 30
+    t0 = time.perf_counter()
+    for _ in range(n_it):
+        params, opt_state, values, g2sum, mstate, loss, _, _ = trainer._step_fn(
+            params, opt_state, values, g2sum, mstate, dev)
+    loss.block_until_ready()
+    step_ms = (time.perf_counter() - t0) / n_it * 1e3
+    log(f"device step only: {step_ms:.2f} ms -> {B / step_ms * 1e3:,.0f} samples/s")
+
+    # scan group alone: stacked feed reused
+    scan_ms = None
+    if scan_k > 1:
+        trainer.conf = dataclasses.replace(trainer.conf, scan_steps=scan_k)
+        scan_fn = trainer._build_scan_step()
+        stacked = _to_device(
+            {k: np.stack([h[k] for h in hosts[:scan_k]]) for k in hosts[0]}
+        )
+        t0 = time.perf_counter()
+        out = scan_fn(params, opt_state, values, g2sum, mstate, stacked)
+        jax.block_until_ready(out[5])
+        log(f"scan compile+first group: {time.perf_counter() - t0:.1f}s")
+        params, opt_state, values, g2sum, mstate = out[:5]
+        n_g = 5
+        t0 = time.perf_counter()
+        for _ in range(n_g):
+            (params, opt_state, values, g2sum, mstate, loss_k, _) = scan_fn(
+                params, opt_state, values, g2sum, mstate, stacked)
+        jax.block_until_ready(loss_k)
+        scan_ms = (time.perf_counter() - t0) / n_g / scan_k * 1e3
+        log(f"scan group ({scan_k} ticks): {scan_ms:.2f} ms/tick -> "
+            f"{B / scan_ms * 1e3:,.0f} samples/s")
+
+    table.values, table.g2sum = values, g2sum
+    table.end_pass()
+    return {"host_ms": round(host_ms, 2), "h2d_ms": round(h2d_ms, 2),
+            "step_ms": round(step_ms, 2),
+            "scan_tick_ms": None if scan_ms is None else round(scan_ms, 2),
+            "feed_mb": round(feed_mb, 2)}
 
 
 def bench_naive(ds, tconf, trconf, model_hidden, seed=0):
@@ -367,7 +503,23 @@ def main() -> None:
                     help="bench Trainer.train_from_dataset (prefetch+scan)")
     ap.add_argument("--scan", type=int, default=8,
                     help="scan_steps for --trainer-path")
+    ap.add_argument("--model", default="ctr_dnn",
+                    choices=["ctr_dnn", "deepfm", "widedeep", "xdeepfm",
+                             "dcn", "mmoe"],
+                    help="benchmark model (BASELINE.md model zoo)")
+    ap.add_argument("--device-profile", action="store_true",
+                    help="isolate host/H2D/step/scan stage timings")
+    ap.add_argument("--max-seconds", type=float, default=1700.0,
+                    help="global watchdog: graceful exit(4) past this")
     args = ap.parse_args()
+    start_deadline(args.max_seconds)
+
+    if os.environ.get("PBOX_BENCH_CPU"):
+        # smoke-test escape hatch: never touch the axon tunnel (the emitted
+        # backend field says "cpu", so this can't masquerade as a TPU number)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     devs = init_backend()
     # "axon"/"tpu" = real chip through the tunnel; "cpu" would mean the
@@ -375,7 +527,6 @@ def main() -> None:
     # asked for this field so a CPU fallback can't masquerade as TPU perf.
     backend = devs[0].platform
     from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
-    from paddlebox_tpu.models import CtrDnn
 
     N_SLOTS, DENSE, B = 16, 13, 2048
     N_INS = 40 * B  # 40 steps
@@ -385,16 +536,30 @@ def main() -> None:
                            compute_dtype=args.compute_dtype,
                            scan_steps=args.scan if args.trainer_path else 1)
 
+    def data_and_model(td):
+        model, n_tl = make_model(
+            args.model, N_SLOTS, tconf.row_width, DENSE, HIDDEN)
+        conf, ds, parse_s = build_data(
+            td, N_SLOTS, DENSE, B, N_INS, 100_000, n_task_labels=n_tl)
+        return conf, ds, parse_s, model
+
+    if args.device_profile:
+        with tempfile.TemporaryDirectory() as td:
+            conf, ds, _, model = data_and_model(td)
+            prof = device_profile(ds, tconf, trconf, model, scan_k=args.scan)
+            ds.close()
+        emit({"metric": f"{args.model}_device_profile", "value": prof["step_ms"],
+              "unit": "ms/step", "vs_baseline": None, "backend": backend,
+              **prof})
+        return
+
     if args.trainer_path:
         with tempfile.TemporaryDirectory() as td:
-            conf, ds, _ = build_data(td, N_SLOTS, DENSE, B, N_INS, 100_000)
-            model = CtrDnn(
-                N_SLOTS, tconf.row_width, dense_dim=DENSE, hidden=HIDDEN
-            )
+            conf, ds, _, model = data_and_model(td)
             sps = bench_trainer_path(ds, tconf, trconf, model)
             ds.close()
         emit({
-            "metric": "ctr_dnn_trainer_path_samples_per_sec",
+            "metric": f"{args.model}_trainer_path_samples_per_sec",
             "value": round(sps, 1),
             "unit": "samples/sec",
             "vs_baseline": None,
@@ -417,28 +582,28 @@ def main() -> None:
         return
 
     with tempfile.TemporaryDirectory() as td:
-        conf, ds, parse_s = build_data(td, N_SLOTS, DENSE, B, N_INS, 100_000)
-        model = CtrDnn(N_SLOTS, tconf.row_width, dense_dim=DENSE, hidden=HIDDEN)
+        conf, ds, parse_s, model = data_and_model(td)
         ours = bench_ours(ds, tconf, trconf, model)
         # partial emit BEFORE the naive baseline: if the tunnel drops during
         # naive, the driver still parses this line (see emit docstring)
         emit({
-            "metric": "ctr_dnn_samples_per_sec",
+            "metric": f"{args.model}_samples_per_sec",
             "value": round(ours, 1),
             "unit": "samples/sec",
             "vs_baseline": None,
             "backend": backend,
         })
-        try:
-            naive = bench_naive(ds, tconf, trconf, HIDDEN)
-        except Exception as e:  # naive baseline OOM/failed: still report ours
-            log(f"naive baseline failed: {e!r}")
-            naive = float("nan")
+        naive = float("nan")
+        if args.model == "ctr_dnn":  # the naive-port baseline is CTR-DNN-shaped
+            try:
+                naive = bench_naive(ds, tconf, trconf, HIDDEN)
+            except Exception as e:  # naive OOM/failed: still report ours
+                log(f"naive baseline failed: {e!r}")
         ds.close()
 
     vs = round(ours / naive, 3) if np.isfinite(naive) and naive > 0 else None
     emit({
-        "metric": "ctr_dnn_samples_per_sec",
+        "metric": f"{args.model}_samples_per_sec",
         "value": round(ours, 1),
         "unit": "samples/sec",
         "vs_baseline": vs,  # null = naive baseline did not run

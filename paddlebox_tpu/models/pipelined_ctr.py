@@ -39,7 +39,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from paddlebox_tpu.models.layers import init_mlp
+from paddlebox_tpu.models.layers import (
+    cast_tree,
+    init_mlp,
+    resolve_compute_dtype,
+)
 from paddlebox_tpu.ops import fused_seqpool_cvm, pooled_width
 from paddlebox_tpu.parallel.pipeline import PIPE_AXIS, gpipe_run
 
@@ -79,11 +83,15 @@ class PipelinedCtrDnn:
         use_cvm: bool = True,
         cvm_offset: int = 2,
         microbatches: Optional[int] = None,
+        compute_dtype: str = "",  # "" -> flags.compute_dtype
     ):
         if PIPE_AXIS not in mesh.axis_names:
             raise ValueError(
                 f"mesh needs a {PIPE_AXIS!r} axis, has {mesh.axis_names}"
             )
+        # same cast policy as CtrDnn (f32 params/pooling, compute-dtype
+        # tower, f32 logits) so TrainerConfig.compute_dtype works unchanged
+        self.compute_dtype = resolve_compute_dtype(compute_dtype)
         self.mesh = mesh
         self.n_stages = int(mesh.shape[PIPE_AXIS])
         self.n_sparse_slots = n_sparse_slots
@@ -151,6 +159,8 @@ class PipelinedCtrDnn:
         # this device's stage: strip the sharded leading axis
         sw = stages["w"][0]  # [dmax, A, A]
         sb = stages["b"][0]  # [dmax, A]
+        if self.compute_dtype is not None:
+            sw, sb = cast_tree((sw, sb), self.compute_dtype)
         live = jnp.asarray(self._live)
         head = jnp.asarray(self._head)
         M, mb, A = x_pad.shape
@@ -179,6 +189,7 @@ class PipelinedCtrDnn:
         )  # [T, mb]
         # ticks P-1..T-1 carry microbatches 0..M-1 (on the last stage only)
         logits = emits[p_axis - 1 :].reshape(M * mb)
+        logits = logits.astype(jnp.float32)  # upcast before the reduction
         return jax.lax.psum(logits, PIPE_AXIS)  # zeros elsewhere
 
     def apply(
@@ -207,6 +218,8 @@ class PipelinedCtrDnn:
                 f"batch size {B} not divisible by microbatches {M}"
             )
         x_pad = jnp.zeros((B, self.A), x.dtype).at[:, : self.input_dim].set(x)
+        if self.compute_dtype is not None:
+            x_pad = x_pad.astype(self.compute_dtype)
         x_mb = x_pad.reshape(M, B // M, self.A)
 
         mapped = jax.shard_map(

@@ -7,13 +7,19 @@ is a dense HBM array and the host has already resolved keys to row indices
 (sparse/table.py plan), so the device-side ops are:
 
   * ``pallas_pull_rows(values, idx)``   — row gather: values[idx] with the
-    table kept in HBM and rows DMA'd to VMEM per grid tile, indices scalar-
-    prefetched so the DMA addresses are known before the tile body runs.
+    table kept in HBM.  Each grid step DMAs a TILE of rows into VMEM with
+    per-row async copies; the NEXT tile's DMAs are started while the
+    current tile is emitted (cross-tile double buffering, scratch slot
+    ping-pong), so row-fetch latency overlaps the output writeback.
   * ``pallas_scatter_add(values, idx, delta)`` — in-place row
-    read-modify-write accumulate (the push).  TPU grids execute
-    sequentially on a core, so duplicate indices (the dead padding row)
-    accumulate correctly without atomics — the ordering guarantee CUDA
-    needs atomics for.
+    read-modify-write accumulate (the push), a TILE of rows per grid step.
+    Within a tile, duplicate indices are combined with an equality-matrix
+    matmul (every duplicate stores the SAME loaded+summed row, so store
+    order cannot lose updates — the ordering guarantee CUDA needs atomics
+    for, vectorized instead of serialized).  Tiles themselves stay fully
+    ordered: a tile's loads start only after the previous tile's stores
+    completed, so cross-tile duplicates are plain sequential
+    read-modify-writes.
 
 Enabled via ``flags.use_pallas_sparse`` (default off): XLA's native
 gather/scatter is already tuned for these shapes, so these kernels are the
@@ -31,7 +37,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_TILE = 8  # rows gathered per grid step (f32 sublane tile)
+_TILE = 32  # max rows per grid step (pow2; shrinks to divide small inputs)
+
+
+def _tile_for(n: int) -> int:
+    """Largest power-of-two divisor of n, capped at _TILE.  Real plans pad
+    key buffers to power-of-two capacities >= 1024, so this is _TILE there;
+    small test shapes degrade gracefully instead of asserting."""
+    t = n & -n  # lowest set bit == largest pow2 divisor
+    return min(t, _TILE) if n else _TILE
 
 
 def _on_tpu() -> bool:
@@ -41,77 +55,116 @@ def _on_tpu() -> bool:
         return False
 
 
-def _gather_kernel(idx_ref, values_ref, out_ref, scratch, sems):
-    """One grid step gathers _TILE rows: start all row DMAs, wait, emit."""
+def _gather_kernel(idx_ref, values_ref, out_ref, scratch, sems, *, tile):
+    """Grid step g emits tile g from its scratch slot while tile g+1's row
+    DMAs run into the other slot (double buffering across grid steps —
+    scratch persists between sequential grid steps on a TPU core)."""
     g = pl.program_id(0)
-    dmas = []
-    for i in range(_TILE):
-        row = idx_ref[g * _TILE + i]
-        dma = pltpu.make_async_copy(
-            values_ref.at[pl.ds(row, 1), :],
-            scratch.at[pl.ds(i, 1), :],
-            sems.at[i],
-        )
-        dma.start()
-        dmas.append(dma)
-    for dma in dmas:
-        dma.wait()
-    out_ref[:] = scratch[:]
+    n = pl.num_programs(0)
+
+    def start(slot, t):
+        for i in range(tile):
+            pltpu.make_async_copy(
+                values_ref.at[pl.ds(idx_ref[t * tile + i], 1), :],
+                scratch.at[slot, pl.ds(i, 1), :],
+                sems.at[slot, i],
+            ).start()
+
+    @pl.when(g == 0)
+    def _():
+        start(0, 0)  # warmup: tile 0 into slot 0
+
+    @pl.when(g + 1 < n)
+    def _():
+        start((g + 1) % 2, g + 1)  # prefetch next tile into the other slot
+
+    cur = g % 2
+    for i in range(tile):
+        pltpu.make_async_copy(
+            values_ref.at[pl.ds(idx_ref[g * tile + i], 1), :],
+            scratch.at[cur, pl.ds(i, 1), :],
+            sems.at[cur, i],
+        ).wait()
+    out_ref[:] = scratch[cur]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def pallas_pull_rows(values: jax.Array, idx: jax.Array,
                      interpret: bool = False) -> jax.Array:
-    """values: [P, W] (HBM); idx: int32 [K], K % _TILE == 0 (the host plan
-    pads key buffers to power-of-two capacities, so this holds).
-    Returns [K, W] — identical to ``jnp.take(values, idx, axis=0)``."""
+    """values: [P, W] (HBM); idx: int32 [K].  Returns [K, W] — identical to
+    ``jnp.take(values, idx, axis=0)``."""
     k = idx.shape[0]
     w = values.shape[1]
-    assert k % _TILE == 0, f"key capacity {k} not a multiple of {_TILE}"
+    tile = _tile_for(k)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,  # idx is known before tile bodies run
-        grid=(k // _TILE,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # table stays in HBM
+        grid=(k // tile,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # table stays in HBM
         out_specs=pl.BlockSpec(
-            (_TILE, w), lambda g, idx: (g, 0), memory_space=pltpu.VMEM
+            (tile, w), lambda g, idx: (g, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((_TILE, w), values.dtype),
-            pltpu.SemaphoreType.DMA((_TILE,)),
+            pltpu.VMEM((2, tile, w), values.dtype),  # ping-pong slots
+            pltpu.SemaphoreType.DMA((2, tile)),
         ],
     )
     return pl.pallas_call(
-        _gather_kernel,
+        functools.partial(_gather_kernel, tile=tile),
         out_shape=jax.ShapeDtypeStruct((k, w), values.dtype),
         grid_spec=grid_spec,
         interpret=interpret or not _on_tpu(),
     )(idx, values)
 
 
-def _scatter_kernel(idx_ref, delta_ref, values_ref, out_ref, row, sems):
-    """One grid step accumulates one delta row into its table row in HBM:
-    DMA row in -> add -> DMA row back.  Grid steps run sequentially, so
-    repeated indices (dead row) are safe read-modify-writes.
+def _scatter_kernel(idx_ref, delta_ref, values_ref, out_ref, rows, sems,
+                    *, tile):
+    """One grid step accumulates ``tile`` delta rows into their table rows:
+    DMA all rows in -> combine duplicates -> add -> DMA all rows back.
+
+    Duplicates within the tile: every occurrence of a row loads the SAME
+    pre-tile value (all loads complete before any store), and the equality
+    matmul gives every occurrence the SUM of all its duplicates' deltas —
+    so all duplicate stores write one identical final row and store order
+    is irrelevant.  Duplicates across tiles: the body waits all stores
+    before returning and grid steps run sequentially on a core, so later
+    tiles read fully-updated rows.
 
     All loads AND stores go through ``out_ref`` — the aliased output buffer
     (initialized to the input table).  Reading the aliased *input* ref
-    instead would see stale rows for duplicate indices in interpret mode,
-    where input and output are distinct buffers.
+    instead would see stale rows in interpret mode, where input and output
+    are distinct buffers.
     """
     del values_ref  # aliased into out_ref; never touched directly
     g = pl.program_id(0)
-    r = idx_ref[g]
-    load = pltpu.make_async_copy(
-        out_ref.at[pl.ds(r, 1), :], row, sems.at[0]
-    )
-    load.start()
-    load.wait()
-    row[:] = row[:] + delta_ref[:]
-    store = pltpu.make_async_copy(
-        row, out_ref.at[pl.ds(r, 1), :], sems.at[1]
-    )
-    store.start()
-    store.wait()
+    for i in range(tile):
+        pltpu.make_async_copy(
+            out_ref.at[pl.ds(idx_ref[g * tile + i], 1), :],
+            rows.at[pl.ds(i, 1), :],
+            sems.at[0, i],
+        ).start()
+    # [tile] index vector (SMEM scalar reads) -> duplicate-combining matmul
+    tvec = jnp.stack([idx_ref[g * tile + i] for i in range(tile)])
+    eq = (tvec[:, None] == tvec[None, :]).astype(delta_ref.dtype)
+    combined = jax.lax.dot(eq, delta_ref[:])  # [tile, W]: sum over dups
+    for i in range(tile):
+        pltpu.make_async_copy(
+            out_ref.at[pl.ds(idx_ref[g * tile + i], 1), :],
+            rows.at[pl.ds(i, 1), :],
+            sems.at[0, i],
+        ).wait()
+    rows[:] = rows[:] + combined
+    for i in range(tile):
+        pltpu.make_async_copy(
+            rows.at[pl.ds(i, 1), :],
+            out_ref.at[pl.ds(idx_ref[g * tile + i], 1), :],
+            sems.at[1, i],
+        ).start()
+    for i in range(tile):
+        pltpu.make_async_copy(
+            rows.at[pl.ds(i, 1), :],
+            out_ref.at[pl.ds(idx_ref[g * tile + i], 1), :],
+            sems.at[1, i],
+        ).wait()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -124,21 +177,24 @@ def pallas_scatter_add(values: jax.Array, idx: jax.Array, delta: jax.Array,
     """
     u = idx.shape[0]
     w = values.shape[1]
+    tile = _tile_for(u)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(u,),
+        grid=(u // tile,),
         in_specs=[
-            pl.BlockSpec((1, w), lambda g, idx: (g, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # table aliased in HBM
+            pl.BlockSpec(
+                (tile, w), lambda g, idx: (g, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),  # table aliased in HBM
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
-            pltpu.VMEM((1, w), values.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((tile, w), values.dtype),
+            pltpu.SemaphoreType.DMA((2, tile)),
         ],
     )
     return pl.pallas_call(
-        _scatter_kernel,
+        functools.partial(_scatter_kernel, tile=tile),
         out_shape=jax.ShapeDtypeStruct(values.shape, values.dtype),
         grid_spec=grid_spec,
         input_output_aliases={2: 0},  # (idx, delta, values) -> values out

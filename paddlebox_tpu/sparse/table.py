@@ -290,15 +290,15 @@ class SparseTable:
 # ------------------------------------------------------------------------- #
 def gather_rows(values: jax.Array, idx: jax.Array) -> jax.Array:
     """Row gather, routed to the Pallas DMA kernel when
-    ``flags.use_pallas_sparse`` is set (and the key capacity tiles evenly);
-    XLA's native gather otherwise.  Identical semantics either way."""
+    ``flags.use_pallas_sparse`` is set; XLA's native gather otherwise.
+    Identical semantics either way (the kernel's tile size adapts to any
+    key-buffer length)."""
     from paddlebox_tpu.config import flags
 
     if flags.use_pallas_sparse:
-        from paddlebox_tpu.ops.pallas_sparse import _TILE, pallas_pull_rows
+        from paddlebox_tpu.ops.pallas_sparse import pallas_pull_rows
 
-        if idx.shape[0] % _TILE == 0:
-            return pallas_pull_rows(values, idx)
+        return pallas_pull_rows(values, idx)
     return jnp.take(values, idx, axis=0)
 
 

@@ -123,3 +123,47 @@ def test_e2e_train_step_with_pallas_enabled(pallas_flag, tmp_path):
     np.testing.assert_allclose(
         s_pallas["values"], s_xla["values"], rtol=1e-5, atol=1e-6
     )
+
+
+def test_pallas_scatter_add_duplicates_across_tiles():
+    """Duplicates spanning tile boundaries must accumulate sequentially —
+    the cross-tile ordering guarantee (loads of tile g+1 start only after
+    tile g's stores completed)."""
+    rng = np.random.default_rng(2)
+    values = rng.normal(size=(16, 8)).astype(np.float32)
+    # 64 indices (tile 32 -> 2 tiles), every index duplicated in both tiles
+    idx = np.concatenate([np.arange(16), np.arange(16)] * 2).astype(np.int32)
+    delta = rng.normal(size=(64, 8)).astype(np.float32)
+    got = pallas_scatter_add(
+        jnp.asarray(values), jnp.asarray(idx), jnp.asarray(delta),
+        interpret=True,
+    )
+    want = jnp.asarray(values).at[jnp.asarray(idx)].add(jnp.asarray(delta))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_pallas_kernels_odd_and_large_shapes():
+    """Tile size adapts to any length (odd -> tile 1; pow2 -> full tile)."""
+    rng = np.random.default_rng(3)
+    values = rng.normal(size=(128, 12)).astype(np.float32)
+    for k in (1, 3, 40, 1024):
+        idx = rng.integers(0, 128, size=k).astype(np.int32)
+        got = pallas_pull_rows(
+            jnp.asarray(values), jnp.asarray(idx), interpret=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), values[idx]
+        )
+    for u in (3, 40, 256):
+        idx = rng.integers(0, 128, size=u).astype(np.int32)
+        delta = rng.normal(size=(u, 12)).astype(np.float32)
+        got = pallas_scatter_add(
+            jnp.asarray(values), jnp.asarray(idx), jnp.asarray(delta),
+            interpret=True,
+        )
+        want = jnp.asarray(values).at[jnp.asarray(idx)].add(jnp.asarray(delta))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )

@@ -131,3 +131,37 @@ def test_batch_not_divisible_rejected():
     dense = np.zeros((B, DENSE), np.float32)
     with pytest.raises(ValueError):
         piped.apply(piped.init(jax.random.PRNGKey(0)), rows, segs, dense, B)
+
+
+def test_bf16_compute_dtype_honored():
+    """TrainerConfig.compute_dtype must actually flip the pipelined tower
+    to bf16 (not be silently dropped with a warning), and stay close to the
+    bf16 CtrDnn, which shares the cast policy."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.models.layers import apply_compute_dtype_override
+
+    tconf = SparseTableConfig(embedding_dim=8)
+    plain, piped = _models(tconf)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the no-attribute path warns
+        apply_compute_dtype_override(plain, "bfloat16")
+        apply_compute_dtype_override(piped, "bfloat16")
+    assert piped.compute_dtype == jnp.bfloat16
+
+    key = jax.random.PRNGKey(7)
+    p_plain, p_piped = plain.init(key), piped.init(key)
+    rng = np.random.default_rng(0)
+    K = B * N_SLOTS
+    rows = rng.normal(size=(K, tconf.row_width)).astype(np.float32)
+    rows[:, :2] = np.abs(rows[:, :2])  # sane show/clk counters
+    segs = np.repeat(np.arange(B) * N_SLOTS, N_SLOTS) + np.tile(
+        np.arange(N_SLOTS), B
+    )
+    dense = rng.normal(size=(B, DENSE)).astype(np.float32)
+    lp = np.asarray(plain.apply(p_plain, rows, segs, dense, B))
+    lq = np.asarray(piped.apply(p_piped, rows, segs, dense, B))
+    assert lq.dtype == np.float32
+    np.testing.assert_allclose(lq, lp, rtol=2e-2, atol=2e-2)  # bf16 noise
