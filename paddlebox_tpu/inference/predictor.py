@@ -3,8 +3,15 @@
 The AnalysisPredictor analog (reference:
 /root/reference/paddle/fluid/inference/api/analysis_predictor.cc — load
 frozen program + params, feed named tensors, fetch outputs), reduced to the
-TPU-native essentials: deserialize the StableHLO program (params inside),
+TPU-native essentials: deserialize the StableHLO program(s) (params inside),
 resolve sparse keys against the table snapshot on the host, run.
+
+Shape flexibility: XLA programs are static-shaped, so the reference's
+freely-resizable feed tensors become a ladder of exported shape buckets
+(export_model ``batch_buckets``).  ``predict`` pads any batch whose REAL
+instance/key counts fit some bucket up to that bucket's shapes — padding
+rows are zero and padding segment ids are out of range (dropped by the
+pooling segment_sum), so bucket choice never changes the scores.
 
 The embedding resolve duplicates training's pull semantics exactly
 (sparse/table.py pull_rows): missing/padding keys read zero rows,
@@ -27,17 +34,33 @@ from paddlebox_tpu.data.feed import HostBatch
 
 class Predictor:
     def __init__(self, meta: dict, keys: np.ndarray, values: np.ndarray,
-                 exported) -> None:
+                 artifact_dir: str, bucket_files: list) -> None:
+        """bucket_files: [(batch_size, key_capacity, filename), ...].
+        Programs deserialize lazily on first use (each embeds the full
+        frozen dense params — eager loading would scale serving-host
+        startup with ladder size, not traffic)."""
         self.meta = meta
         self._keys = keys  # sorted uint64
         self._values = values  # [n, W] f32
-        self._exported = exported
-        self._call = exported.call
+        self._dir = artifact_dir
+        self._buckets = bucket_files
+        self._programs: dict = {}  # filename -> deserialized exported
+
+    @property
+    def bucket_shapes(self) -> list:
+        """[(batch_size, key_capacity), ...] of the exported ladder."""
+        return [(b, k) for b, k, _ in self._buckets]
+
+    def _program(self, fname: str):
+        import jax
+
+        if fname not in self._programs:
+            with open(os.path.join(self._dir, fname), "rb") as f:
+                self._programs[fname] = jax.export.deserialize(f.read())
+        return self._programs[fname]
 
     @classmethod
     def load(cls, artifact_dir: str) -> "Predictor":
-        import jax
-
         with open(os.path.join(artifact_dir, "meta.json")) as f:
             meta = json.load(f)
         sp = os.path.join(artifact_dir, "sparse")
@@ -64,15 +87,23 @@ class Predictor:
             values = np.concatenate([np.load(p) for p in val_files])
         order = np.argsort(keys)  # per-process shards -> one sorted table
         keys, values = keys[order], values[order]
-        with open(os.path.join(artifact_dir, "serving.stablehlo"), "rb") as f:
-            exported = jax.export.deserialize(f.read())
-        return cls(meta, keys, values, exported)
+        # pre-bucket artifacts carry no "buckets" entry: synthesize one
+        bucket_meta = meta.get("buckets") or [{
+            "batch_size": meta["batch_size"],
+            "key_capacity": meta["key_capacity"],
+            "file": "serving.stablehlo",
+        }]
+        bucket_files = [
+            (int(bm["batch_size"]), int(bm["key_capacity"]), bm["file"])
+            for bm in bucket_meta
+        ]
+        return cls(meta, keys, values, artifact_dir, bucket_files)
 
     # -- feature resolve (host) -------------------------------------------- #
-    def _resolve_rows(self, batch_keys: np.ndarray, n_keys: int) -> np.ndarray:
+    def _resolve_rows(self, batch_keys: np.ndarray, n_keys: int,
+                      key_capacity: int) -> np.ndarray:
         m = self.meta
-        K, W = m["key_capacity"], m["row_width"]
-        rows = np.zeros((K, W), dtype=np.float32)
+        rows = np.zeros((key_capacity, m["row_width"]), dtype=np.float32)
         if n_keys and self._keys.shape[0]:
             bk = batch_keys[:n_keys]
             pos = np.searchsorted(self._keys, bk)
@@ -88,37 +119,57 @@ class Predictor:
             rows[:n_keys] = got
         return rows
 
+    def _pick_bucket(self, b: int, nk: int):
+        """Cheapest fitting bucket by padded work (B * K), not first-fit —
+        a non-monotone ladder like [(64, 65536), (128, 1024)] must send a
+        tiny request to the small program, not the huge-capacity one."""
+        fits = [(B * K, B, K, f) for B, K, f in self._buckets
+                if b <= B and nk <= K]
+        if fits:
+            _, B, K, fname = min(fits)
+            return B, K, self._program(fname)
+        raise ValueError(
+            f"no exported shape bucket fits a batch with {b} instances / "
+            f"{nk} keys: artifact buckets (batch_size, key_capacity) = "
+            f"{self.bucket_shapes} — re-export with batch_buckets covering "
+            "this shape"
+        )
+
     # -- scoring ------------------------------------------------------------ #
     def predict(self, batch: HostBatch) -> np.ndarray:
         """Probabilities for the batch's REAL instances: [b] (primary task)
-        or [b, n_tasks]."""
+        or [b, n_tasks].  The batch may come from ANY feed shape whose real
+        instance/key counts fit an exported bucket."""
         m = self.meta
-        if batch.batch_size != m["batch_size"]:
+        b = int(batch.ins_mask.sum())
+        if b and not batch.ins_mask[:b].all():
             raise ValueError(
-                f"artifact was exported for batch_size={m['batch_size']}, "
-                f"got {batch.batch_size}"
+                "batch real instances are not front-packed; cannot re-bucket"
             )
-        if batch.keys.shape[0] != m["key_capacity"]:
-            raise ValueError(
-                f"artifact was exported for key_capacity={m['key_capacity']}, "
-                f"got a batch with key buffer {batch.keys.shape[0]} — set "
-                "DataFeedConfig.batch_key_capacity to match the export"
-            )
-        rows = self._resolve_rows(batch.keys, batch.n_keys)
-        args = [
-            rows,
-            np.asarray(batch.key_segments, np.int32),
-            np.asarray(batch.dense, np.float32),
-        ]
+        nk = int(batch.n_keys)
+        B, K, exported = self._pick_bucket(b, nk)
+        S = m["n_sparse_slots"]
+
+        rows = self._resolve_rows(batch.keys, nk, K)
+        # segments: the real keys' ids are ins * S + slot with ins < b <= B,
+        # valid under bucket B too; padding ids land out of range (B * S)
+        # and are dropped by the pooling segment_sum
+        segs = np.full(K, B * S, np.int32)
+        segs[:nk] = np.asarray(batch.key_segments[:nk], np.int32)
+        dense = np.zeros((B, m["dense_dim"]), np.float32)
+        dense[:b] = np.asarray(batch.dense[:b], np.float32)
+        args = [rows, segs, dense]
         if m.get("rank_offset_cols", 0):
             if batch.rank_offset is None:
                 raise ValueError(
                     "artifact serves a rank_offset model: feed PV-merged "
                     "batches (enable_pv_merge + preprocess_instance)"
                 )
-            args.append(np.asarray(batch.rank_offset, np.int32))
-        preds = np.asarray(self._call(*args))
-        b = int(batch.ins_mask.sum())
+            ro = np.zeros((B, m["rank_offset_cols"]), np.int32)
+            ro_src = np.asarray(batch.rank_offset, np.int32)
+            ro[:b] = ro_src[:b]
+            args.append(ro)
+        preds = np.asarray(exported.call(*args))
         return preds[:b]
 
     def predict_dataset(self, dataset) -> Iterator[np.ndarray]:
