@@ -332,6 +332,49 @@ def device_profile(ds, tconf, trconf, model, scan_k: int = 8, seed=0):
             "feed_mb": round(feed_mb, 2)}
 
 
+def bench_pallas(n_rows: int = 1 << 21, width: int = 10,
+                 n_idx: int = 1 << 17, iters: int = 30) -> dict:
+    """Pallas vs XLA gather/scatter at bench table shapes (VERDICT r3 next
+    #4: 'benchmark vs v0 on the real chip; tune or delete').  Returns ms
+    per op for all four variants; the use_pallas_sparse default should
+    follow the winner measured HERE, on hardware, not intuition."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.ops.pallas_sparse import (
+        pallas_pull_rows, pallas_scatter_add,
+    )
+
+    rng = np.random.default_rng(0)
+    values = jnp.asarray(
+        rng.normal(size=(n_rows, width)).astype(np.float32))
+    idx = jnp.asarray(
+        rng.integers(0, n_rows, size=n_idx).astype(np.int32))
+    delta = jnp.asarray(rng.normal(size=(n_idx, width)).astype(np.float32))
+
+    def time_op(fn, *args):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    res = {
+        "xla_gather_ms": time_op(
+            jax.jit(lambda v, i: jnp.take(v, i, axis=0)), values, idx),
+        "pallas_gather_ms": time_op(pallas_pull_rows, values, idx),
+        "xla_scatter_ms": time_op(
+            jax.jit(lambda v, i, d: v.at[i].add(d)), values, idx, delta),
+        "pallas_scatter_ms": time_op(pallas_scatter_add, values, idx, delta),
+    }
+    for k, v in res.items():
+        log(f"{k}: {v:.2f} ms  ({n_idx} rows x {width} cols, "
+            f"table {n_rows})")
+    return {k: round(v, 3) for k, v in res.items()}
+
+
 def bench_naive(ds, tconf, trconf, model_hidden, seed=0):
     """Naive JAX port: embedding rows gathered per occurrence with NO dedup,
     per-slot masked mean... pooling via S separate masked segment matmuls,
@@ -513,6 +556,8 @@ def main() -> None:
                     help="benchmark model (BASELINE.md model zoo)")
     ap.add_argument("--device-profile", action="store_true",
                     help="isolate host/H2D/step/scan stage timings")
+    ap.add_argument("--pallas", action="store_true",
+                    help="Pallas vs XLA gather/scatter at table shapes")
     ap.add_argument("--max-seconds", type=float, default=1700.0,
                     help="global watchdog: graceful exit(4) past this")
     args = ap.parse_args()
@@ -546,6 +591,13 @@ def main() -> None:
         conf, ds, parse_s = build_data(
             td, N_SLOTS, DENSE, B, N_INS, 100_000, n_task_labels=n_tl)
         return conf, ds, parse_s, model
+
+    if args.pallas:
+        res = bench_pallas()
+        emit({"metric": "pallas_vs_xla_gather_scatter",
+              "value": res["pallas_gather_ms"], "unit": "ms",
+              "vs_baseline": None, "backend": backend, **res})
+        return
 
     if args.device_profile:
         with tempfile.TemporaryDirectory() as td:

@@ -235,6 +235,18 @@ class SparseTableConfig:
     # buckets that received NEW keys, so steady-state merge cost tracks the
     # pass size, not total features ever seen (sparse/store.py).
     store_buckets: int = 256
+    # device-table scratch rows reserved past the pass working set, one per
+    # key-buffer slot, so every padding/missing plan slot scatters into its
+    # OWN row instead of all duplicating the dead row.  Push indices are
+    # then unique by construction and the jitted push claims
+    # unique_indices=True, unlocking XLA's parallel scatter lowering (the
+    # serial duplicate-safe lowering is the sparse push's worst case on
+    # TPU).  Used for PASS 1 only — later passes size the region from the
+    # observed plan (key buffer single-chip, serve buffer sharded).  An
+    # under-provisioned region degrades gracefully: overflow pad slots
+    # clamp to the dead row with exactly-zero deltas (see plan_keys /
+    # plan_group).  The pow2 table rounding usually absorbs it for free.
+    plan_scratch_rows: int = 1 << 17
     # spill directory for cold buckets ("" = whole store stays in RAM).
     # With a spill dir, at most store_max_resident buckets are resident and
     # the rest live as .npz files — the SSD tier for stores beyond RAM.

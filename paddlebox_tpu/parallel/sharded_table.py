@@ -126,6 +126,10 @@ class ShardedSparseTable(SparseTable):
         # forced a power-of-two capacity bump (each distinct capacity
         # recompiles the step once)
         self.capacity_bumps = 0
+        # largest serve buffer (n * C) planned so far: sizes the next
+        # pass's per-shard scratch region (pass 1 falls back to
+        # conf.plan_scratch_rows)
+        self._last_serve_n = 0
         # mesh positions (== global shard ids) whose devices this process
         # owns; single-process: every position.  The want-matrix allgather in
         # plan_group assumes each process's positions are one contiguous run
@@ -168,7 +172,15 @@ class ShardedSparseTable(SparseTable):
             m = owner == o
             row_within[m] = np.arange(int(m.sum()), dtype=np.int32)
         w = self.conf.row_width
-        cap = _next_pow2(max((sk.shape[0] for sk in shard_keys), default=0) + 1)
+        # shard layout mirrors the single-chip table: [0, live) rows |
+        # [live, cap-1) plan scratch (distinct scatter targets for the
+        # serve_uniq padding tail -> unique push indices) | cap-1 dead.
+        # After the first plan, the observed serve-buffer size is the exact
+        # scratch need; pass 1 falls back to the config default.
+        scratch = self._last_serve_n or self.conf.plan_scratch_rows
+        cap = _next_pow2(
+            max((sk.shape[0] for sk in shard_keys), default=0) + 1 + scratch
+        )
         # materialize only the local shards: rows come from this process's
         # host store (each process persists exactly its owned shards), and
         # fresh keys init key-deterministically (_key_uniform), so any
@@ -181,6 +193,9 @@ class ShardedSparseTable(SparseTable):
         self.values = global_from_local(sharding, jnp.asarray(lvals[:, :, :w]))
         self.g2sum = global_from_local(sharding, jnp.asarray(lvals[:, :, w]))
         self._shard_keys = shard_keys
+        self._shard_live = np.asarray(
+            [shard_keys[o].shape[0] for o in self._local_pos], np.int32
+        )  # per-LOCAL-shard scratch base
         self._pass_owner = owner.astype(np.int32)
         self._pass_row = row_within
         self._pass_keys = pk
@@ -361,7 +376,22 @@ class ShardedSparseTable(SparseTable):
             want_all[:, self._local_pos, :].transpose(1, 0, 2)
         )  # [L, n, C]
         serve_map = np.empty((L, n, C), dtype=np.int32)
-        serve_uniq = np.full((L, n * C), dead, dtype=np.int32)
+        # padding tail: every slot gets its OWN scratch row (live + j), so
+        # serve_uniq is unique by construction — uq itself is np.unique
+        # output (at most one dead entry for census-missing keys) and the
+        # scratch region is disjoint from live rows and dead.  The jitted
+        # push claims unique_indices on this.  Slots past the provisioned
+        # scratch clamp to the dead row: pad segments receive zero
+        # contributions in the push's cross-requester segment_sum, so
+        # duplicate dead targets write unchanged bytes under any scatter
+        # order (and the dead row is scrubbed after every push anyway) —
+        # an under-provisioned scratch region degrades, never crashes.
+        self._last_serve_n = max(self._last_serve_n, n * C)
+        serve_uniq = np.minimum(
+            self._shard_live[:, None]
+            + np.arange(n * C, dtype=np.int32)[None, :],
+            dead,
+        )
         for o in range(L):
             uq, inv = np.unique(serve_rows[o].reshape(-1), return_inverse=True)
             serve_uniq[o, : uq.shape[0]] = uq

@@ -178,9 +178,11 @@ def sharded_push_and_update(
         conf.grad_clip,
     )
     delta = jnp.concatenate([acc[:, :co], w_delta], axis=1)
-    values = scatter_add_rows(values, serve_uniq, delta)
-    g2sum = g2sum.at[serve_uniq].add(g2_delta)  # [cap] vector: XLA scatter
-    # scrub the dead row: padding requests and census-missing keys land there
+    # serve_uniq is unique by construction (np.unique rows + per-slot
+    # scratch tail, sharded_table.plan_group): parallel scatter lowering
+    values = scatter_add_rows(values, serve_uniq, delta, unique=True)
+    g2sum = g2sum.at[serve_uniq].add(g2_delta, unique_indices=True)
+    # scrub the dead row: census-missing keys land there
     values = values.at[cap - 1].set(0.0)
     g2sum = g2sum.at[cap - 1].set(0.0)
     return values, g2sum

@@ -110,6 +110,9 @@ class SparseTable:
         self._in_pass = False
         # delta tracking for SaveDelta-style incremental checkpoints
         self._delta_keys: list[np.ndarray] = []
+        # largest key buffer planned so far: sizes the next pass's scratch
+        # region (pass 1 falls back to conf.plan_scratch_rows)
+        self._last_plan_k = 0
         # stats
         self.missing_key_count = 0
 
@@ -152,7 +155,15 @@ class SparseTable:
             raise RuntimeError("end_pass the previous pass first")
         pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
         w = self.conf.row_width
-        cap = _next_pow2(pk.shape[0] + 1)
+        # layout: [0, n) live rows | [n, cap-1) plan scratch | cap-1 dead.
+        # Scratch rows give every padding/missing plan slot a distinct
+        # scatter target (see SparseTableConfig.plan_scratch_rows).  Once a
+        # plan has run, the observed key-buffer size is the exact need;
+        # pass 1 uses the config default (over-provisioning only rounds
+        # into the same pow2 in the common case, and plan_keys degrades
+        # gracefully if a later batch needs more).
+        scratch = self._last_plan_k or self.conf.plan_scratch_rows
+        cap = _next_pow2(pk.shape[0] + 1 + scratch)
         vals = np.zeros((cap, w + 1), dtype=np.float32)
         n = pk.shape[0]
         vals[:n] = self._resolve_or_init(pk)
@@ -188,13 +199,29 @@ class SparseTable:
         return self.plan_keys(batch.keys, batch.n_keys)
 
     def plan_keys(self, keys: np.ndarray, n_real: int) -> BatchPlan:
-        """Resolve a padded key buffer to device row indices + dedup maps."""
+        """Resolve a padded key buffer to device row indices + dedup maps.
+
+        ``idx`` (the pull side) maps missing/padding occurrences to the
+        dead row (reads zeros).  ``uniq_idx`` (the push side) maps every
+        non-live slot to its OWN scratch row (scratch_base + slot), so push
+        indices are unique by construction — push_and_update scatters with
+        unique_indices=True and XLA never pays the duplicate-safe serial
+        lowering.  Scratch rows are never pulled and never merged back."""
         if not self._in_pass:
             raise RuntimeError("begin_pass before planning batches")
         K = keys.shape[0]
         dead = self.dead_row
+        scratch_base = self._pass_keys.shape[0]
+        self._last_plan_k = max(self._last_plan_k, K)
         idx = np.full(K, dead, dtype=np.int32)
-        uniq_idx = np.full(K, dead, dtype=np.int32)
+        # slots beyond the provisioned scratch clamp to the dead row: their
+        # deltas are exactly zero (padding) so duplicate dead targets write
+        # unchanged bytes under any scatter order, keeping the push's
+        # unique_indices claim benign even when under-provisioned (real
+        # unique slots sit at the front and always win scratch rows first)
+        uniq_idx = np.minimum(
+            scratch_base + np.arange(K, dtype=np.int32), dead
+        )
         inverse = np.full(K, K - 1, dtype=np.int32)
         mask = np.zeros(K, dtype=np.float32)
         n_missing = 0
@@ -205,10 +232,13 @@ class SparseTable:
             npk = self._pass_keys.shape[0]
             pos_c = np.minimum(pos, max(npk - 1, 0))
             found = (self._pass_keys[pos_c] == uk) if npk else np.zeros(uk.shape[0], bool)
-            rows = np.where(found, pos_c, dead).astype(np.int32)
+            nu = uk.shape[0]
+            # push target: live row when found, the slot's scratch row else
+            rows_push = np.where(found, pos_c, uniq_idx[:nu]).astype(np.int32)
+            rows_pull = np.where(found, pos_c, dead).astype(np.int32)
             n_missing = int((~found).sum())
-            uniq_idx[: uk.shape[0]] = rows
-            idx[:n_real] = rows[inv]
+            uniq_idx[:nu] = rows_push
+            idx[:n_real] = rows_pull[inv]
             inverse[:n_real] = inv
             mask[:n_real] = 1.0
         self.missing_key_count += n_missing
@@ -302,16 +332,20 @@ def gather_rows(values: jax.Array, idx: jax.Array) -> jax.Array:
     return jnp.take(values, idx, axis=0)
 
 
-def scatter_add_rows(values: jax.Array, idx: jax.Array, delta: jax.Array) -> jax.Array:
+def scatter_add_rows(values: jax.Array, idx: jax.Array, delta: jax.Array,
+                     unique: bool = False) -> jax.Array:
     """Row scatter-add, routed like gather_rows.  Duplicate indices
-    accumulate identically on both paths."""
+    accumulate identically on both paths.  ``unique=True`` promises the
+    caller's indices are distinct (the plan's scratch-row construction) and
+    unlocks XLA's parallel scatter lowering; the Pallas kernel is
+    duplicate-safe either way."""
     from paddlebox_tpu.config import flags
 
     if flags.use_pallas_sparse:
         from paddlebox_tpu.ops.pallas_sparse import pallas_scatter_add
 
         return pallas_scatter_add(values, idx, delta)
-    return values.at[idx].add(delta)
+    return values.at[idx].add(delta, unique_indices=unique)
 
 
 def pull_rows(
@@ -396,10 +430,13 @@ def push_and_update(
             extra_inc = jnp.zeros((U, co - 2), counter_delta.dtype)
         counter_delta = jnp.concatenate([counter_delta, extra_inc], axis=1)
     delta = jnp.concatenate([counter_delta, w_delta], axis=1)
-    values = scatter_add_rows(values, plan_uniq_idx, delta)
-    g2sum = g2sum.at[plan_uniq_idx].add(g2_delta)  # [P] vector: XLA scatter
-    # the dead row must stay zero: padding slots scatter only zeros, but keys
-    # missing from the pass census carry real grads — scrub them.
+    # plan_uniq_idx is unique by construction (every padding/missing slot
+    # owns a scratch row — plan_keys): claim it so XLA lowers the scatter
+    # parallel instead of duplicate-safe serial
+    values = scatter_add_rows(values, plan_uniq_idx, delta, unique=True)
+    g2sum = g2sum.at[plan_uniq_idx].add(g2_delta, unique_indices=True)
+    # the dead row must stay zero: missing-key grads land in scratch rows
+    # now, but keep the scrub as a cheap invariant (pulls read dead as zero)
     dead = values.shape[0] - 1
     values = values.at[dead].set(0.0)
     g2sum = g2sum.at[dead].set(0.0)
