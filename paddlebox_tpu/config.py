@@ -122,6 +122,16 @@ class DataFeedConfig:
     # box_wrapper.cc:1222-1270).  Excluded from the dense feature matrix.
     task_label_slots: Sequence[str] = ()
 
+    # ordered behavior-sequence slot (long-sequence models): this sparse
+    # slot's per-instance keys are ALSO exposed as an ordered sequence —
+    # HostBatch.seq_pos [B, max_seq_len] holds each instance's key-buffer
+    # positions for it (padding = key capacity).  The slot still
+    # participates in normal pooled features.  The reference has no
+    # long-sequence path (SURVEY §5.7); this feeds the beyond-parity
+    # sequence-parallel tower (models/longseq_ctr.py).
+    sequence_slot: str = ""
+    max_seq_len: int = 64
+
     # fixed device-batch capacities (XLA static shapes): max total feasigns per
     # batch per sparse slot group.  Host feed pads/clips to these.
     max_feasigns_per_ins: int = 256
@@ -241,12 +251,13 @@ class SparseTableConfig:
     # then unique by construction and the jitted push claims
     # unique_indices=True, unlocking XLA's parallel scatter lowering (the
     # serial duplicate-safe lowering is the sparse push's worst case on
-    # TPU).  Used for PASS 1 only — later passes size the region from the
-    # observed plan (key buffer single-chip, serve buffer sharded).  An
-    # under-provisioned region degrades gracefully: overflow pad slots
-    # clamp to the dead row with exactly-zero deltas (see plan_keys /
-    # plan_group).  The pow2 table rounding usually absorbs it for free.
-    plan_scratch_rows: int = 1 << 17
+    # TPU).  Used for PASS 1 only — later passes size the region exactly
+    # from the observed plan (key buffer single-chip, serve buffer
+    # sharded), so a mis-set default costs at most one extra pass-boundary
+    # recompile, never correctness: slots past the region clamp to the
+    # dead row and the push zeroes every dead-targeted delta before the
+    # scatter (see plan_keys / push_and_update).
+    plan_scratch_rows: int = 1 << 15
     # spill directory for cold buckets ("" = whole store stays in RAM).
     # With a spill dir, at most store_max_resident buckets are resident and
     # the rest live as .npz files — the SSD tier for stores beyond RAM.

@@ -39,6 +39,9 @@ class HostBatch:
     batch_size: int
     n_sparse_slots: int
     rank_offset: Optional[np.ndarray] = None  # int32 [B, C] (PV merge mode)
+    # ordered per-instance positions (into the key buffer) of the
+    # configured sequence_slot's keys; padding = key capacity K
+    seq_pos: Optional[np.ndarray] = None  # int32 [B, max_seq_len]
     # multi-task labels [B, T]: col 0 = primary label, cols 1.. = the
     # configured task_label_slots (present only when those are configured)
     task_labels: Optional[np.ndarray] = None
@@ -69,6 +72,8 @@ def empty_like(batch: HostBatch) -> HostBatch:
         n_sparse_slots=S,
         rank_offset=None if batch.rank_offset is None
         else np.zeros_like(batch.rank_offset),
+        seq_pos=None if batch.seq_pos is None
+        else np.full_like(batch.seq_pos, batch.keys.shape[0]),
         task_labels=None if batch.task_labels is None
         else np.zeros_like(batch.task_labels),
         cmatches=None if batch.cmatches is None else np.zeros_like(batch.cmatches),
@@ -149,6 +154,15 @@ class BatchBuilder:
             conf.batch_size * conf.max_feasigns_per_ins
         )
         self.dropped_keys = 0  # overflow counter (observability)
+        self.seq_slot_idx: Optional[int] = None
+        if conf.sequence_slot:
+            names = [s.name for s in conf.sparse_slots()]
+            if conf.sequence_slot not in names:
+                raise ValueError(
+                    f"sequence_slot {conf.sequence_slot!r} is not a sparse "
+                    f"slot (have {names})"
+                )
+            self.seq_slot_idx = names.index(conf.sequence_slot)
 
     def build_pv(
         self, block: RecordBlock, ids: np.ndarray, pv_bounds: np.ndarray
@@ -192,6 +206,21 @@ class BatchBuilder:
         row_seg = (np.arange(b * S) // S) * S + (np.arange(b * S) % S)  # = arange(b*S)
         segs[:total] = np.repeat(row_seg.astype(np.int32), lens)
 
+        seq_pos = None
+        if self.seq_slot_idx is not None:
+            # ordered positions of the sequence slot's keys in the buffer:
+            # instance i's slot run is [new_off[r], new_off[r]+lens[r]) with
+            # r = i*S + slot (file order == behavior order); pad with K
+            T = self.conf.max_seq_len
+            seq_pos = np.full((B, T), K, dtype=np.int32)
+            rr = np.arange(b, dtype=np.int64) * S + self.seq_slot_idx
+            col = np.arange(T, dtype=np.int64)[None, :]
+            seq_pos[:b] = np.where(
+                col < np.minimum(lens[rr], T)[:, None],
+                new_off[:-1][rr][:, None] + col,
+                K,
+            ).astype(np.int32)
+
         dense = np.zeros((B, block.dense.shape[1]), dtype=np.float32)
         dense[:b] = block.dense[ids]
         labels = np.zeros(B, dtype=np.float32)
@@ -218,6 +247,7 @@ class BatchBuilder:
             keys=keys,
             key_segments=segs,
             n_keys=total,
+            seq_pos=seq_pos,
             dense=dense,
             labels=labels,
             ins_mask=mask,

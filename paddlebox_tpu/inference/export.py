@@ -71,6 +71,8 @@ def export_model(
     (VERDICT r3 missing #5).
     """
     uses_rank = getattr(model, "uses_rank_offset", False)
+    uses_seq = getattr(model, "uses_seq_pos", False)
+    seq_len = int(getattr(model, "max_seq_len", 0)) if uses_seq else 0
     if uses_rank and rank_offset_cols <= 0:
         raise ValueError(
             "model consumes rank_offset: pass rank_offset_cols "
@@ -123,17 +125,19 @@ def export_model(
             buckets.append((int(bb), int(bk)))
     bucket_meta = []
     for B, K in buckets:
-        if uses_rank:
-            def serve(rows, key_segments, dense, rank_offset, B=B):
-                logits = model.apply(
-                    frozen, rows, key_segments, dense, B,
-                    rank_offset=rank_offset,
-                )
-                return jax.nn.sigmoid(logits)
-        else:
-            def serve(rows, key_segments, dense, B=B):
-                logits = model.apply(frozen, rows, key_segments, dense, B)
-                return jax.nn.sigmoid(logits)
+        # extras ride in a fixed order after the three core inputs:
+        # rank_offset (when used), then seq_pos (when used) — the
+        # Predictor assembles args in the same order
+        def serve(rows, key_segments, dense, *extras, B=B):
+            kw = {}
+            i = 0
+            if uses_rank:
+                kw["rank_offset"] = extras[i]
+                i += 1
+            if uses_seq:
+                kw["seq_pos"] = extras[i]
+            logits = model.apply(frozen, rows, key_segments, dense, B, **kw)
+            return jax.nn.sigmoid(logits)
 
         # lower for both serving platforms: a TPU-trained artifact must run
         # on a CPU-only serving host too
@@ -145,6 +149,10 @@ def export_model(
         if uses_rank:
             in_shapes.append(
                 jax.ShapeDtypeStruct((B, rank_offset_cols), jnp.int32)
+            )
+        if uses_seq:
+            in_shapes.append(
+                jax.ShapeDtypeStruct((B, seq_len), jnp.int32)
             )
         exp = jax.export.export(jax.jit(serve), platforms=("cpu", "tpu"))(
             *in_shapes
@@ -179,6 +187,7 @@ def export_model(
         "pull_embedx_scale": conf.pull_embedx_scale,
         "quantized": bool(quantize),
         "rank_offset_cols": rank_offset_cols if uses_rank else 0,
+        "seq_len": seq_len,
     }
     with open(os.path.join(out_dir, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)

@@ -169,6 +169,21 @@ class Predictor:
             ro_src = np.asarray(batch.rank_offset, np.int32)
             ro[:b] = ro_src[:b]
             args.append(ro)
+        if m.get("seq_len", 0):
+            if batch.seq_pos is None:
+                raise ValueError(
+                    "artifact serves a sequence model: set "
+                    "DataFeedConfig.sequence_slot so batches carry seq_pos"
+                )
+            T = m["seq_len"]
+            # re-bucket: real positions (< this batch's real key count) are
+            # valid under the bucket's key buffer too; everything else
+            # becomes the bucket's pad marker K
+            sp = np.full((B, T), K, np.int32)
+            src = np.asarray(batch.seq_pos, np.int32)
+            tc = min(T, src.shape[1])
+            sp[:b, :tc] = np.where(src[:b, :tc] < nk, src[:b, :tc], K)
+            args.append(sp)
         preds = np.asarray(exported.call(*args))
         return preds[:b]
 

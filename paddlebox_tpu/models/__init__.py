@@ -4,6 +4,7 @@ from paddlebox_tpu.models.ctr_dnn import CtrDnn
 from paddlebox_tpu.models.dcn import DCN
 from paddlebox_tpu.models.deepfm import DeepFM
 from paddlebox_tpu.models.layers import bce_with_logits, init_mlp, linear, mlp
+from paddlebox_tpu.models.longseq_ctr import LongSeqCtrDnn
 from paddlebox_tpu.models.mmoe import MMoE
 from paddlebox_tpu.models.pipelined_ctr import PipelinedCtrDnn
 from paddlebox_tpu.models.rank_ctr import RankCtrDnn
@@ -14,6 +15,7 @@ __all__ = [
     "CtrDnn",
     "DCN",
     "DeepFM",
+    "LongSeqCtrDnn",
     "MMoE",
     "PipelinedCtrDnn",
     "RankCtrDnn",

@@ -26,6 +26,8 @@ all_to_all work out of the box) and reduce to plain attention at P=1.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -33,11 +35,15 @@ SEQ_AXIS = "seq"
 
 
 def full_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False,
+    key_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Plain softmax attention (the single-device reference semantics).
 
     q/k/v: [B, T, H, D]; returns [B, T, H, D].
+    key_valid: optional bool [B, Tk] — padded key positions read zero
+    attention weight (variable-length sequences); a query whose keys are
+    ALL masked reads a zero vector, not NaN.
     """
     d = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(float(d))
@@ -45,7 +51,13 @@ def full_attention(
         tq, tk = s.shape[-2], s.shape[-1]
         mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
         s = jnp.where(mask, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
+    if key_valid is not None:
+        s = jnp.where(key_valid[:, None, None, :], s, -jnp.inf)
+    # masked-stable softmax: exp(-inf)=0 rows normalize against a floored
+    # denominator instead of producing NaN
+    m = jnp.max(s, axis=-1, keepdims=True)
+    w = jnp.exp(s - jnp.where(jnp.isneginf(m), 0.0, m))
+    p = w / jnp.maximum(w.sum(axis=-1, keepdims=True), 1e-30)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
@@ -55,10 +67,15 @@ def ring_attention(
     v: jax.Array,
     causal: bool = False,
     axis_name: str = SEQ_AXIS,
+    key_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ring attention over sequence chunks (call INSIDE shard_map over
     ``axis_name``; every array is this device's chunk [B, T_local, H, D],
     chunks laid out contiguously in mesh order).
+
+    key_valid: optional bool [B, T_local] — this chunk's key validity; it
+    rides the ring with its K/V block so padded positions are masked
+    wherever the block is folded.
     """
     p_axis = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -68,7 +85,7 @@ def ring_attention(
 
     def fold(args):
         """One online-softmax fold (flash recursion) in f32 accumulators."""
-        k_blk, v_blk, acc, m, l, src = args
+        k_blk, v_blk, valid_blk, acc, m, l, src = args
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k_blk,
             preferred_element_type=jnp.float32,
@@ -77,6 +94,7 @@ def ring_attention(
             k_pos = src * t + jnp.arange(t)
             mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
             s = jnp.where(mask[None, None], s, -jnp.inf)
+        s = jnp.where(valid_blk[:, None, None, :], s, -jnp.inf)
         s_max = s.max(axis=-1)  # [B, H, Tq]
         m_new = jnp.maximum(m, s_max)
         m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
@@ -89,7 +107,7 @@ def ring_attention(
         return acc, m_new, l
 
     def tick(carry, j):
-        k_blk, v_blk, acc, m, l = carry
+        k_blk, v_blk, valid_blk, acc, m, l = carry
         src = (idx - j) % p_axis  # which chunk this block is
         if causal:
             # a block entirely in the causal future folds to a no-op: skip
@@ -97,34 +115,39 @@ def ring_attention(
             acc, m, l = jax.lax.cond(
                 src <= idx,
                 fold,
-                lambda args: (args[2], args[3], args[4]),
-                (k_blk, v_blk, acc, m, l, src),
+                lambda args: (args[3], args[4], args[5]),
+                (k_blk, v_blk, valid_blk, acc, m, l, src),
             )
         else:
-            acc, m, l = fold((k_blk, v_blk, acc, m, l, src))
+            acc, m, l = fold((k_blk, v_blk, valid_blk, acc, m, l, src))
         # the last tick's rotation would be discarded: skip it (the scan
         # counter is replicated, so every device takes the same branch and
         # the collective stays coherent)
-        k_blk, v_blk = jax.lax.cond(
+        k_blk, v_blk, valid_blk = jax.lax.cond(
             j < p_axis - 1,
             lambda kv: jax.lax.ppermute(
                 kv, axis_name,
                 [(i, (i + 1) % p_axis) for i in range(p_axis)],
             ),
             lambda kv: kv,
-            (k_blk, v_blk),
+            (k_blk, v_blk, valid_blk),
         )
-        return (k_blk, v_blk, acc, m, l), None
+        return (k_blk, v_blk, valid_blk, acc, m, l), None
 
     # accumulate in f32 whatever the input dtype (flash-attention practice:
     # bf16 inputs, f32 running max/normalizer/weighted-sum)
     vary = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")
+    # the synthesized all-ones mask is replicated; the ring shift needs it
+    # device-varying like the K/V blocks it rides with
+    kv_valid = (
+        vary(jnp.ones((b, t), bool)) if key_valid is None else key_valid
+    )
     acc0 = jnp.zeros((b, h, t, d), jnp.float32)
     m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
-    (_, _, acc, _, l), _ = jax.lax.scan(
+    (_, _, _, acc, _, l), _ = jax.lax.scan(
         tick,
-        (k, v, vary(acc0), vary(m0), vary(l0)),
+        (k, v, kv_valid, vary(acc0), vary(m0), vary(l0)),
         jnp.arange(p_axis),
     )
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, T, D] f32
@@ -137,16 +160,24 @@ def ulysses_attention(
     v: jax.Array,
     causal: bool = False,
     axis_name: str = SEQ_AXIS,
+    key_valid: Optional[jax.Array] = None,
 ) -> jax.Array:
     """All-to-all sequence parallelism (call INSIDE shard_map over
     ``axis_name``): trade T-sharding for H-sharding, run full attention,
     trade back.  q/k/v: [B, T_local, H, D] with H divisible by the axis
     size; returns [B, T_local, H, D].
+    key_valid: optional bool [B, T_local] — local chunk's key validity,
+    allgathered to the full sequence for the head-sharded attention.
     """
     p_axis = jax.lax.axis_size(axis_name)
     b, t, h, d = q.shape
     if h % p_axis != 0:
         raise ValueError(f"heads {h} not divisible by seq axis size {p_axis}")
+    valid_full = (
+        None
+        if key_valid is None
+        else jax.lax.all_gather(key_valid, axis_name, axis=1, tiled=True)
+    )
 
     def seq_to_heads(x):
         # [B, T_local, H, D] -> [B, P*T_local, H/P, D]: give every device
@@ -161,6 +192,7 @@ def ulysses_attention(
         )
 
     out = full_attention(
-        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal=causal
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v), causal=causal,
+        key_valid=valid_full,
     )
     return heads_to_seq(out)

@@ -381,11 +381,11 @@ class ShardedSparseTable(SparseTable):
         # output (at most one dead entry for census-missing keys) and the
         # scratch region is disjoint from live rows and dead.  The jitted
         # push claims unique_indices on this.  Slots past the provisioned
-        # scratch clamp to the dead row: pad segments receive zero
-        # contributions in the push's cross-requester segment_sum, so
-        # duplicate dead targets write unchanged bytes under any scatter
-        # order (and the dead row is scrubbed after every push anyway) —
-        # an under-provisioned scratch region degrades, never crashes.
+        # scratch clamp to the dead row; sharded_push_and_update zeroes
+        # every dead-targeted delta before the scatter, so clamped
+        # duplicates only write unchanged bytes (and the dead row is
+        # scrubbed after every push anyway) — an under-provisioned scratch
+        # region degrades, never crashes or corrupts.
         self._last_serve_n = max(self._last_serve_n, n * C)
         serve_uniq = np.minimum(
             self._shard_live[:, None]

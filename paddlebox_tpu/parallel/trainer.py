@@ -84,6 +84,8 @@ def _stack_group(
     extra = {}
     if batches[0].rank_offset is not None:
         extra["rank_offset"] = np.stack([b.rank_offset for b in batches])
+    if batches[0].seq_pos is not None:
+        extra["seq_pos"] = np.stack([b.seq_pos for b in batches])
     if batches[0].task_labels is not None:
         extra["task_labels"] = np.stack([b.task_labels for b in batches])
     if metric_group is not None:
@@ -178,11 +180,16 @@ def sharded_push_and_update(
         conf.grad_clip,
     )
     delta = jnp.concatenate([acc[:, :co], w_delta], axis=1)
-    # serve_uniq is unique by construction (np.unique rows + per-slot
-    # scratch tail, sharded_table.plan_group): parallel scatter lowering
-    values = scatter_add_rows(values, serve_uniq, delta, unique=True)
-    g2sum = g2sum.at[serve_uniq].add(g2_delta, unique_indices=True)
-    # scrub the dead row: census-missing keys land there
+    # serve_uniq targets are unique EXCEPT possibly repeated dead-row
+    # entries (np.unique's own dead entry for census-missing keys, plus
+    # scratch-clamped pad slots — sharded_table.plan_group).  Dead-row
+    # gradients are discarded by the scrub below regardless, so zero every
+    # dead-targeted delta first: duplicates then only write unchanged
+    # bytes and the unique_indices claim stays benign under any lowering.
+    ok = (serve_uniq != cap - 1).astype(delta.dtype)
+    values = scatter_add_rows(values, serve_uniq, delta * ok[:, None],
+                              unique=True)
+    g2sum = g2sum.at[serve_uniq].add(g2_delta * ok, unique_indices=True)
     values = values.at[cap - 1].set(0.0)
     g2sum = g2sum.at[cap - 1].set(0.0)
     return values, g2sum
@@ -258,6 +265,7 @@ class MultiChipTrainer:
         async_dense = conf.sync_dense_mode == "async"
         check_nan = conf.check_nan_inf
         uses_rank = getattr(model, "uses_rank_offset", False)
+        uses_seq = getattr(model, "uses_seq_pos", False)
         n_tasks = self.n_tasks
         has_group = self.metric_group is not None
 
@@ -275,6 +283,8 @@ class MultiChipTrainer:
             )
             bsz = batch["labels"].shape[0]
             extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
+            if uses_seq:
+                extra["seq_pos"] = batch["seq_pos"]
 
             def loss_fn(p, r):
                 logits = model.apply(
@@ -506,6 +516,7 @@ class MultiChipTrainer:
         values, g2sum = table.values, table.g2sum
         losses, counts, n_steps = [], [], 0
         uses_rank = getattr(self.model, "uses_rank_offset", False)
+        uses_seq = getattr(self.model, "uses_seq_pos", False)
 
         # the producer's collectives must be HOST-side: it runs concurrent
         # with the consumer's device step, and two threads racing device
@@ -561,6 +572,12 @@ class MultiChipTrainer:
                     return
                 if n_slots is None:
                     n_slots = group[0].n_sparse_slots
+                if uses_seq and group[0].seq_pos is None:
+                    raise RuntimeError(
+                        "model consumes an ordered behavior sequence: set "
+                        "DataFeedConfig.sequence_slot (and max_seq_len) so "
+                        "batches carry seq_pos"
+                    )
                 if uses_rank and group[0].rank_offset is None:
                     raise RuntimeError(
                         "model requires PV-merged batches with rank_offset: "
@@ -701,6 +718,7 @@ class MultiChipTrainer:
         model = self.model
         tconf = self.table_conf
         uses_rank = getattr(model, "uses_rank_offset", False)
+        uses_seq = getattr(model, "uses_seq_pos", False)
         n_tasks = self.n_tasks
 
         def body(params, values, auc, batch):
@@ -713,6 +731,8 @@ class MultiChipTrainer:
             )
             bsz = batch["labels"].shape[0]
             extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
+            if uses_seq:
+                extra["seq_pos"] = batch["seq_pos"]
             logits = model.apply(
                 params, rows, batch["key_segments"], batch["dense"], bsz, **extra
             )
@@ -739,6 +759,7 @@ class MultiChipTrainer:
 
         multiproc = is_multiprocess()
         uses_rank = getattr(self.model, "uses_rank_offset", False)
+        uses_seq = getattr(self.model, "uses_seq_pos", False)
         auc = self.init_auc()
         n_slots = None
         template = None

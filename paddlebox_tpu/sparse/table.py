@@ -214,11 +214,11 @@ class SparseTable:
         scratch_base = self._pass_keys.shape[0]
         self._last_plan_k = max(self._last_plan_k, K)
         idx = np.full(K, dead, dtype=np.int32)
-        # slots beyond the provisioned scratch clamp to the dead row: their
-        # deltas are exactly zero (padding) so duplicate dead targets write
-        # unchanged bytes under any scatter order, keeping the push's
-        # unique_indices claim benign even when under-provisioned (real
-        # unique slots sit at the front and always win scratch rows first)
+        # slots beyond the provisioned scratch clamp to the dead row:
+        # push_and_update zeroes every dead-targeted delta, so the clamped
+        # duplicates only ever write unchanged bytes (real unique slots sit
+        # at the front and win scratch rows first; clamped missing-key
+        # grads were headed for the post-push dead-row scrub regardless)
         uniq_idx = np.minimum(
             scratch_base + np.arange(K, dtype=np.int32), dead
         )
@@ -430,14 +430,22 @@ def push_and_update(
             extra_inc = jnp.zeros((U, co - 2), counter_delta.dtype)
         counter_delta = jnp.concatenate([counter_delta, extra_inc], axis=1)
     delta = jnp.concatenate([counter_delta, w_delta], axis=1)
-    # plan_uniq_idx is unique by construction (every padding/missing slot
-    # owns a scratch row — plan_keys): claim it so XLA lowers the scatter
-    # parallel instead of duplicate-safe serial
-    values = scatter_add_rows(values, plan_uniq_idx, delta, unique=True)
-    g2sum = g2sum.at[plan_uniq_idx].add(g2_delta, unique_indices=True)
-    # the dead row must stay zero: missing-key grads land in scratch rows
-    # now, but keep the scrub as a cheap invariant (pulls read dead as zero)
+    # plan_uniq_idx targets are unique EXCEPT possibly repeated dead-row
+    # entries (slots the plan clamped when the scratch region was
+    # under-provisioned — plan_keys).  Zero every dead-targeted delta so
+    # duplicates only ever write unchanged bytes: dead-row gradients were
+    # always discarded (the scrub below), so this changes no observable
+    # state while keeping the unique_indices claim's duplicates benign
+    # under any scatter lowering.
     dead = values.shape[0] - 1
+    ok = (plan_uniq_idx != dead).astype(delta.dtype)
+    values = scatter_add_rows(
+        values, plan_uniq_idx, delta * ok[:, None], unique=True
+    )
+    g2sum = g2sum.at[plan_uniq_idx].add(
+        g2_delta * ok, unique_indices=True
+    )
+    # the dead row must stay zero (pulls read it as the zero row)
     values = values.at[dead].set(0.0)
     g2sum = g2sum.at[dead].set(0.0)
     return values, g2sum

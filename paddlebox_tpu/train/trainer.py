@@ -76,6 +76,8 @@ def _host_batch_dict(
     }
     if batch.rank_offset is not None:
         dev["rank_offset"] = batch.rank_offset
+    if batch.seq_pos is not None:
+        dev["seq_pos"] = batch.seq_pos
     if batch.task_labels is not None:
         dev["task_labels"] = batch.task_labels
     if counter_label_tasks:
@@ -285,6 +287,7 @@ class Trainer:
         optimizer = self.optimizer
         check_nan = self.conf.check_nan_inf
         uses_rank = getattr(model, "uses_rank_offset", False)
+        uses_seq = getattr(model, "uses_seq_pos", False)
         n_tasks = self.n_tasks
         has_group = self.metric_group is not None
         part_vec = None
@@ -303,6 +306,8 @@ class Trainer:
             )
             bsz = batch["labels"].shape[0]
             extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
+            if uses_seq:
+                extra["seq_pos"] = batch["seq_pos"]
             if part_vec is not None:
                 # occurrence-level participation: seg = ins*S + slot, so
                 # seg % S is the slot (padding occurrences are already
@@ -493,6 +498,7 @@ class Trainer:
         values, g2sum = table.values, table.g2sum
         losses, n_steps = [], 0
         uses_rank = getattr(self.model, "uses_rank_offset", False)
+        uses_seq = getattr(self.model, "uses_seq_pos", False)
         dumper = None
         if self.conf.need_dump_field and self.conf.dump_fields_path:
             from paddlebox_tpu.train.dump import FieldDumper
@@ -526,6 +532,12 @@ class Trainer:
                     raise RuntimeError(
                         "model requires PV-merged batches with rank_offset: "
                         "set enable_pv_merge and call dataset.preprocess_instance()"
+                    )
+                if uses_seq and batch.seq_pos is None:
+                    raise RuntimeError(
+                        "model consumes an ordered behavior sequence: set "
+                        "DataFeedConfig.sequence_slot (and max_seq_len) so "
+                        "batches carry seq_pos"
                     )
                 if self.n_tasks > 1 and (
                     batch.task_labels is None
@@ -679,6 +691,7 @@ class Trainer:
         model = self.model
         tconf = self.table_conf
         uses_rank = getattr(model, "uses_rank_offset", False)
+        uses_seq = getattr(model, "uses_seq_pos", False)
         n_tasks = self.n_tasks
 
         def step(params, values, auc, batch):
@@ -690,6 +703,8 @@ class Trainer:
             )
             bsz = batch["labels"].shape[0]
             extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
+            if uses_seq:
+                extra["seq_pos"] = batch["seq_pos"]
             logits = model.apply(
                 params, rows, batch["key_segments"], batch["dense"], bsz, **extra
             )
@@ -706,12 +721,19 @@ class Trainer:
         if self._eval_fn is None:
             self._eval_fn = self._build_eval_step()
         uses_rank = getattr(self.model, "uses_rank_offset", False)
+        uses_seq = getattr(self.model, "uses_seq_pos", False)
         auc = init_auc_state(self.conf.auc_buckets)
         for batch in dataset.batches(drop_last=drop_last):
             if uses_rank and batch.rank_offset is None:
                 raise RuntimeError(
                     "model requires PV-merged batches with rank_offset: "
                     "set enable_pv_merge and call dataset.preprocess_instance()"
+                )
+            if uses_seq and batch.seq_pos is None:
+                raise RuntimeError(
+                    "model consumes an ordered behavior sequence: set "
+                    "DataFeedConfig.sequence_slot (and max_seq_len) so "
+                    "batches carry seq_pos"
                 )
             plan = table.plan_batch(batch)
             dev = _device_batch(batch, plan, batch.n_sparse_slots)
