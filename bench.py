@@ -465,7 +465,8 @@ def bench_naive(ds, tconf, trconf, model_hidden, seed=0):
 
 
 def bench_sustained(n_passes: int, tconf, trconf, n_slots: int, dense_dim: int,
-                    batch_size: int, ins_per_pass: int, hidden, profile: bool):
+                    batch_size: int, ins_per_pass: int, hidden, profile: bool,
+                    vocab_per_slot: int = 100_000):
     """Sustained multi-pass throughput: pass p trains while pass p+1's files
     parse in the background (the production day-loop shape,
     examples/train_ctr_dnn.py).  This is the number that stresses the host
@@ -493,7 +494,8 @@ def bench_sustained(n_passes: int, tconf, trconf, n_slots: int, dense_dim: int,
             return write_synth_files(
                 os.path.join(td, f"p{p}"), n_files=4,
                 ins_per_file=ins_per_pass // 4, n_sparse_slots=n_slots,
-                vocab_per_slot=100_000, dense_dim=dense_dim, seed=7 + p,
+                vocab_per_slot=vocab_per_slot, dense_dim=dense_dim,
+                seed=7 + p,
             )
 
         all_files = [files_for(p) for p in range(n_passes)]
@@ -501,6 +503,7 @@ def bench_sustained(n_passes: int, tconf, trconf, n_slots: int, dense_dim: int,
         ds.set_filelist(all_files[0])
         ds.preload_into_memory()
         total = 0
+        prev_count = 0
         t_start = None  # starts after pass 0's parse (un-overlappable)
         auc_state = None
         for p in range(n_passes):
@@ -514,7 +517,12 @@ def bench_sustained(n_passes: int, tconf, trconf, n_slots: int, dense_dim: int,
             metrics = trainer.train_from_dataset(ds, table, auc_state=auc_state)
             auc_state = trainer.last_metric_state
             table.end_pass()
-            total += int(metrics["count"])
+            # metrics["count"] is CUMULATIVE across passes (the carried AUC
+            # state keeps counting), so the latest value IS the running
+            # total; accumulate the per-pass delta so a future auc_state
+            # reset can't silently shrink the denominator
+            total += int(metrics["count"]) - prev_count
+            prev_count = int(metrics["count"])
             log(f"pass {p}: loss={metrics['loss']:.4f} auc={metrics['auc']:.4f} "
                 f"count={metrics['count']:.0f}")
         dt = time.perf_counter() - t_start
@@ -558,6 +566,12 @@ def main() -> None:
                     help="isolate host/H2D/step/scan stage timings")
     ap.add_argument("--pallas", action="store_true",
                     help="Pallas vs XLA gather/scatter at table shapes")
+    ap.add_argument("--slots", type=int, default=16,
+                    help="sparse slots (north-star sustained shape: 26)")
+    ap.add_argument("--emb", type=int, default=8,
+                    help="embedding_dim (north-star sustained shape: 16)")
+    ap.add_argument("--vocab", type=int, default=100_000,
+                    help="per-slot vocab (north-star: 1000000)")
     ap.add_argument("--max-seconds", type=float, default=1700.0,
                     help="global watchdog: graceful exit(4) past this")
     args = ap.parse_args()
@@ -577,10 +591,10 @@ def main() -> None:
     backend = devs[0].platform
     from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
 
-    N_SLOTS, DENSE, B = 16, 13, 2048
+    N_SLOTS, DENSE, B = args.slots, 13, 2048
     N_INS = 40 * B  # 40 steps
     HIDDEN = (512, 256, 128)
-    tconf = SparseTableConfig(embedding_dim=8)
+    tconf = SparseTableConfig(embedding_dim=args.emb)
     trconf = TrainerConfig(auc_buckets=1 << 20,
                            compute_dtype=args.compute_dtype,
                            scan_steps=args.scan if args.trainer_path else 1)
@@ -589,7 +603,7 @@ def main() -> None:
         model, n_tl = make_model(
             args.model, N_SLOTS, tconf.row_width, DENSE, HIDDEN)
         conf, ds, parse_s = build_data(
-            td, N_SLOTS, DENSE, B, N_INS, 100_000, n_task_labels=n_tl)
+            td, N_SLOTS, DENSE, B, N_INS, args.vocab, n_task_labels=n_tl)
         return conf, ds, parse_s, model
 
     if args.pallas:
@@ -626,7 +640,7 @@ def main() -> None:
     if args.sustained:
         sps = bench_sustained(
             args.sustained, tconf, trconf, N_SLOTS, DENSE, B, N_INS, HIDDEN,
-            args.profile,
+            args.profile, vocab_per_slot=args.vocab,
         )
         emit({
             "metric": "ctr_dnn_sustained_samples_per_sec",

@@ -151,6 +151,12 @@ class LongSeqCtrDnn:
         """Returns logits [B]."""
         B, T = batch_size, self.max_seq_len
         K = rows.shape[0]
+        if seq_pos.shape[-1] != T:
+            raise ValueError(
+                f"seq_pos width {seq_pos.shape[-1]} != model max_seq_len "
+                f"{T}: set DataFeedConfig.max_seq_len and "
+                "LongSeqCtrDnn(max_seq_len=...) to the same value"
+            )
         pooled = fused_seqpool_cvm(
             rows, key_segments, B, self.n_sparse_slots,
             use_cvm=self.use_cvm, cvm_offset=self.cvm_offset,

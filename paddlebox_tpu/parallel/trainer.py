@@ -785,6 +785,12 @@ class MultiChipTrainer:
                 break
             if n_slots is None:
                 n_slots = group[0].n_sparse_slots
+            if uses_seq and group[0].seq_pos is None:
+                raise RuntimeError(
+                    "model consumes an ordered behavior sequence: set "
+                    "DataFeedConfig.sequence_slot (and max_seq_len) so "
+                    "batches carry seq_pos"
+                )
             if uses_rank and group[0].rank_offset is None:
                 raise RuntimeError(
                     "model requires PV-merged batches with rank_offset: "
