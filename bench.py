@@ -545,6 +545,123 @@ def bench_sustained(n_passes: int, tconf, trconf, n_slots: int, dense_dim: int,
     return sps
 
 
+def stage_headline(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
+                   hidden, model_name: str, with_naive: bool) -> None:
+    """The headline (or one model-zoo) measurement: bench_ours with the
+    partial emit BEFORE the naive baseline, so a naive OOM/SIGKILL (which
+    no try/except can catch) still leaves the ours line on stdout.  The
+    ONE body behind both `python bench.py [--model X]` and run_all —
+    single-metric CLI and --all capture cannot drift."""
+    with tempfile.TemporaryDirectory() as td:
+        conf, ds, _, model = _data_and_model(
+            td, args, tconf, n_slots, dense, bsz, n_ins, hidden, model_name)
+        ours = bench_ours(ds, tconf, trconf, model)
+        emit({"metric": f"{model_name}_samples_per_sec",
+              "value": round(ours, 1), "unit": "samples/sec",
+              "vs_baseline": None, "backend": backend})
+        naive = float("nan")
+        if with_naive:
+            try:
+                naive = bench_naive(ds, tconf, trconf, hidden)
+            except Exception as e:
+                log(f"naive baseline failed: {e!r}")
+        ds.close()
+    if with_naive:
+        vs = round(ours / naive, 3) if np.isfinite(naive) and naive > 0 \
+            else None
+        emit({"metric": f"{model_name}_samples_per_sec",
+              "value": round(ours, 1), "unit": "samples/sec",
+              "vs_baseline": vs, "backend": backend})
+
+
+def stage_device_profile(backend, args, tconf, trconf, n_slots, dense, bsz,
+                         n_ins, hidden, scan_k: int) -> None:
+    with tempfile.TemporaryDirectory() as td:
+        conf, ds, _, model = _data_and_model(
+            td, args, tconf, n_slots, dense, bsz, n_ins, hidden, args.model)
+        prof = device_profile(ds, tconf, trconf, model, scan_k=scan_k)
+        ds.close()
+    emit({"metric": f"{args.model}_device_profile", "value": prof["step_ms"],
+          "unit": "ms/step", "vs_baseline": None, "backend": backend, **prof})
+
+
+def stage_trainer_path(backend, args, tconf, trconf, n_slots, dense, bsz,
+                       n_ins, hidden) -> None:
+    with tempfile.TemporaryDirectory() as td:
+        conf, ds, _, model = _data_and_model(
+            td, args, tconf, n_slots, dense, bsz, n_ins, hidden, args.model)
+        sps = bench_trainer_path(ds, tconf, trconf, model)
+        ds.close()
+    emit({"metric": f"{args.model}_trainer_path_samples_per_sec",
+          "value": round(sps, 1), "unit": "samples/sec", "vs_baseline": None,
+          "backend": backend})
+
+
+def stage_pallas(backend) -> None:
+    res = bench_pallas()
+    emit({"metric": "pallas_vs_xla_gather_scatter",
+          "value": res["pallas_gather_ms"], "unit": "ms",
+          "vs_baseline": None, "backend": backend, **res})
+
+
+def _data_and_model(td, args, tconf, n_slots, dense, bsz, n_ins, hidden,
+                    model_name: str):
+    model, n_tl = make_model(model_name, n_slots, tconf.row_width, dense,
+                             hidden)
+    conf, ds, parse_s = build_data(td, n_slots, dense, bsz, n_ins,
+                                   args.vocab, n_task_labels=n_tl)
+    return conf, ds, parse_s, model
+
+
+def run_all(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
+            hidden) -> None:
+    """Every measurement in ONE process (one tunnel client, one backend
+    init): the post-recovery capture plan.  Stages are isolated — a stage
+    failure logs and moves on so one bad path can't cost the whole run
+    (except a SIGKILL; the headline's partial emit covers its worst case)."""
+    import dataclasses
+
+    from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+
+    def stage(name, fn, *a, **kw):
+        t0 = time.perf_counter()
+        try:
+            fn(*a, **kw)
+            log(f"== stage {name} done in {time.perf_counter() - t0:.0f}s")
+        except Exception as e:
+            log(f"== stage {name} FAILED: {e!r}")
+            emit({"metric": name, "value": None, "unit": "error",
+                  "vs_baseline": None, "backend": backend,
+                  "error": repr(e)[:200]})
+
+    common = (backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
+              hidden)
+    stage("headline", stage_headline, *common, model_name="ctr_dnn",
+          with_naive=True)
+    stage("device_profile", stage_device_profile, *common, scan_k=8)
+    stage("pallas", stage_pallas, backend)
+    tp_conf = dataclasses.replace(trconf, scan_steps=8)
+    stage("trainer_path", stage_trainer_path, backend, args, tconf, tp_conf,
+          n_slots, dense, bsz, n_ins, hidden)
+    for name in ("deepfm", "widedeep", "xdeepfm", "dcn", "mmoe"):
+        stage(f"zoo_{name}", stage_headline, *common, model_name=name,
+              with_naive=False)
+
+    def sustained():
+        ns_tconf = SparseTableConfig(embedding_dim=16)
+        ns_trconf = TrainerConfig(auc_buckets=1 << 20)
+        sps = bench_sustained(
+            4, ns_tconf, ns_trconf, 26, dense, bsz, 40 * bsz, hidden,
+            profile=False, vocab_per_slot=1_000_000,
+        )
+        emit({"metric": "ctr_dnn_sustained_northstar_samples_per_sec",
+              "value": round(sps, 1), "unit": "samples/sec",
+              "vs_baseline": None, "backend": backend,
+              "shape": "26 slots, emb 16, vocab 1e6, 4 passes"})
+
+    stage("sustained_northstar", sustained)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--sustained", type=int, default=0, metavar="N_PASSES",
@@ -566,6 +683,10 @@ def main() -> None:
                     help="isolate host/H2D/step/scan stage timings")
     ap.add_argument("--pallas", action="store_true",
                     help="Pallas vs XLA gather/scatter at table shapes")
+    ap.add_argument("--all", action="store_true",
+                    help="one process, every measurement: headline+naive, "
+                         "device profile, pallas, trainer path, model zoo, "
+                         "sustained north-star — one JSON line each")
     ap.add_argument("--slots", type=int, default=16,
                     help="sparse slots (north-star sustained shape: 26)")
     ap.add_argument("--emb", type=int, default=8,
@@ -599,42 +720,22 @@ def main() -> None:
                            compute_dtype=args.compute_dtype,
                            scan_steps=args.scan if args.trainer_path else 1)
 
-    def data_and_model(td):
-        model, n_tl = make_model(
-            args.model, N_SLOTS, tconf.row_width, DENSE, HIDDEN)
-        conf, ds, parse_s = build_data(
-            td, N_SLOTS, DENSE, B, N_INS, args.vocab, n_task_labels=n_tl)
-        return conf, ds, parse_s, model
+    common = (backend, args, tconf, trconf, N_SLOTS, DENSE, B, N_INS, HIDDEN)
 
     if args.pallas:
-        res = bench_pallas()
-        emit({"metric": "pallas_vs_xla_gather_scatter",
-              "value": res["pallas_gather_ms"], "unit": "ms",
-              "vs_baseline": None, "backend": backend, **res})
+        stage_pallas(backend)
+        return
+
+    if args.all:
+        run_all(*common)
         return
 
     if args.device_profile:
-        with tempfile.TemporaryDirectory() as td:
-            conf, ds, _, model = data_and_model(td)
-            prof = device_profile(ds, tconf, trconf, model, scan_k=args.scan)
-            ds.close()
-        emit({"metric": f"{args.model}_device_profile", "value": prof["step_ms"],
-              "unit": "ms/step", "vs_baseline": None, "backend": backend,
-              **prof})
+        stage_device_profile(*common, scan_k=args.scan)
         return
 
     if args.trainer_path:
-        with tempfile.TemporaryDirectory() as td:
-            conf, ds, _, model = data_and_model(td)
-            sps = bench_trainer_path(ds, tconf, trconf, model)
-            ds.close()
-        emit({
-            "metric": f"{args.model}_trainer_path_samples_per_sec",
-            "value": round(sps, 1),
-            "unit": "samples/sec",
-            "vs_baseline": None,
-            "backend": backend,
-        })
+        stage_trainer_path(*common)
         return
 
     if args.sustained:
@@ -651,34 +752,9 @@ def main() -> None:
         })
         return
 
-    with tempfile.TemporaryDirectory() as td:
-        conf, ds, parse_s, model = data_and_model(td)
-        ours = bench_ours(ds, tconf, trconf, model)
-        # partial emit BEFORE the naive baseline: if the tunnel drops during
-        # naive, the driver still parses this line (see emit docstring)
-        emit({
-            "metric": f"{args.model}_samples_per_sec",
-            "value": round(ours, 1),
-            "unit": "samples/sec",
-            "vs_baseline": None,
-            "backend": backend,
-        })
-        naive = float("nan")
-        if args.model == "ctr_dnn":  # the naive-port baseline is CTR-DNN-shaped
-            try:
-                naive = bench_naive(ds, tconf, trconf, HIDDEN)
-            except Exception as e:  # naive OOM/failed: still report ours
-                log(f"naive baseline failed: {e!r}")
-        ds.close()
-
-    vs = round(ours / naive, 3) if np.isfinite(naive) and naive > 0 else None
-    emit({
-        "metric": f"{args.model}_samples_per_sec",
-        "value": round(ours, 1),
-        "unit": "samples/sec",
-        "vs_baseline": vs,  # null = naive baseline did not run
-        "backend": backend,
-    })
+    # the naive-port baseline is CTR-DNN-shaped; other models report ours only
+    stage_headline(*common, model_name=args.model,
+                   with_naive=args.model == "ctr_dnn")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,100 @@
+// Minimal non-Python serving client for the CTR scoring endpoint.
+//
+// The reference ships C/Go/R inference clients next to its
+// AnalysisPredictor stack (/root/reference/paddle/fluid/inference/,
+// goapi/, capi/); here serving is an HTTP endpoint over the StableHLO
+// artifact (examples/serve_ctr.py + inference/predictor.py), so a client
+// in any language is a few dozen lines of socket code.  This one POSTs
+// canonical slot-text lines to /score and prints the returned JSON.
+//
+// Build:  g++ -O2 -o serve_client examples/serve_client.cpp
+// Usage:  ./serve_client <host> <port> < lines.txt
+//         (lines = the same slot text the trainer parses:
+//          "<n> v1..vn" per slot in config order)
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <sstream>
+#include <string>
+
+static int dial(const char* host, const char* port) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  if (getaddrinfo(host, port, &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (addrinfo* p = res; p; p = p->ai_next) {
+    fd = socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+static bool send_all(int fd, const std::string& s) {
+  size_t off = 0;
+  while (off < s.size()) {
+    ssize_t n = write(fd, s.data() + off, s.size() - off);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <host> <port> < slot_lines.txt\n",
+                 argv[0]);
+    return 2;
+  }
+  std::ostringstream body_s;
+  body_s << std::cin.rdbuf();
+  const std::string body = body_s.str();
+  if (body.empty()) {
+    std::fprintf(stderr, "no input lines on stdin\n");
+    return 2;
+  }
+
+  int fd = dial(argv[1], argv[2]);
+  if (fd < 0) {
+    std::perror("connect");
+    return 1;
+  }
+  std::ostringstream req;
+  req << "POST /score HTTP/1.1\r\n"
+      << "Host: " << argv[1] << "\r\n"
+      << "Content-Type: text/plain\r\n"
+      << "Content-Length: " << body.size() << "\r\n"
+      << "Connection: close\r\n\r\n"
+      << body;
+  if (!send_all(fd, req.str())) {
+    std::perror("write");
+    close(fd);
+    return 1;
+  }
+
+  std::string resp;
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof buf)) > 0) resp.append(buf, n);
+  close(fd);
+
+  const size_t hdr_end = resp.find("\r\n\r\n");
+  if (hdr_end == std::string::npos ||
+      resp.compare(0, 7, "HTTP/1.") != 0 ||
+      resp.find(" 200 ") > 12) {
+    std::fprintf(stderr, "bad response:\n%s\n", resp.c_str());
+    return 1;
+  }
+  std::cout << resp.substr(hdr_end + 4) << "\n";
+  return 0;
+}

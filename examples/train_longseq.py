@@ -42,9 +42,13 @@ def main():
     from paddlebox_tpu.train.trainer import Trainer
 
     S, DENSE, B = 8, 8, 256
+    MAX_KEYS_PER_SLOT = 24
     conf = make_synth_config(
         n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
         max_feasigns_per_ins=args.max_seq_len + 16,
+        # capacity must cover the worst batch (B * S * keys-per-slot) or the
+        # feed silently clips tail keys — the behavior sequences included
+        batch_key_capacity=B * S * MAX_KEYS_PER_SLOT,
         sequence_slot="slot0",  # slot0's keys double as the behavior sequence
         max_seq_len=args.max_seq_len,
     )
@@ -79,7 +83,8 @@ def main():
     with tempfile.TemporaryDirectory() as td:
         files = write_synth_files(
             td, n_files=2, ins_per_file=2048, n_sparse_slots=S,
-            vocab_per_slot=5000, dense_dim=DENSE, seed=7, max_keys_per_slot=24,
+            vocab_per_slot=5000, dense_dim=DENSE, seed=7,
+            max_keys_per_slot=MAX_KEYS_PER_SLOT,
         )
         ds = PadBoxSlotDataset(conf, read_threads=2)
         ds.set_filelist(files)
