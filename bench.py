@@ -555,17 +555,20 @@ def stage_headline(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
     with tempfile.TemporaryDirectory() as td:
         conf, ds, _, model = _data_and_model(
             td, args, tconf, n_slots, dense, bsz, n_ins, hidden, model_name)
-        ours = bench_ours(ds, tconf, trconf, model)
-        emit({"metric": f"{model_name}_samples_per_sec",
-              "value": round(ours, 1), "unit": "samples/sec",
-              "vs_baseline": None, "backend": backend})
-        naive = float("nan")
-        if with_naive:
-            try:
-                naive = bench_naive(ds, tconf, trconf, hidden)
-            except Exception as e:
-                log(f"naive baseline failed: {e!r}")
-        ds.close()
+        try:
+            ours = bench_ours(ds, tconf, trconf, model)
+            emit({"metric": f"{model_name}_samples_per_sec",
+                  "value": round(ours, 1), "unit": "samples/sec",
+                  "vs_baseline": None, "backend": backend})
+            naive = float("nan")
+            if with_naive:
+                try:
+                    naive = bench_naive(ds, tconf, trconf, hidden)
+                except Exception as e:
+                    log(f"naive baseline failed: {e!r}")
+        finally:
+            ds.close()  # run_all continues after a stage failure: don't
+            # leak the dataset's reader thread pools into later stages
     if with_naive:
         vs = round(ours / naive, 3) if np.isfinite(naive) and naive > 0 \
             else None
@@ -579,8 +582,10 @@ def stage_device_profile(backend, args, tconf, trconf, n_slots, dense, bsz,
     with tempfile.TemporaryDirectory() as td:
         conf, ds, _, model = _data_and_model(
             td, args, tconf, n_slots, dense, bsz, n_ins, hidden, args.model)
-        prof = device_profile(ds, tconf, trconf, model, scan_k=scan_k)
-        ds.close()
+        try:
+            prof = device_profile(ds, tconf, trconf, model, scan_k=scan_k)
+        finally:
+            ds.close()
     emit({"metric": f"{args.model}_device_profile", "value": prof["step_ms"],
           "unit": "ms/step", "vs_baseline": None, "backend": backend, **prof})
 
@@ -590,8 +595,10 @@ def stage_trainer_path(backend, args, tconf, trconf, n_slots, dense, bsz,
     with tempfile.TemporaryDirectory() as td:
         conf, ds, _, model = _data_and_model(
             td, args, tconf, n_slots, dense, bsz, n_ins, hidden, args.model)
-        sps = bench_trainer_path(ds, tconf, trconf, model)
-        ds.close()
+        try:
+            sps = bench_trainer_path(ds, tconf, trconf, model)
+        finally:
+            ds.close()
     emit({"metric": f"{args.model}_trainer_path_samples_per_sec",
           "value": round(sps, 1), "unit": "samples/sec", "vs_baseline": None,
           "backend": backend})
@@ -693,9 +700,17 @@ def main() -> None:
                     help="embedding_dim (north-star sustained shape: 16)")
     ap.add_argument("--vocab", type=int, default=100_000,
                     help="per-slot vocab (north-star: 1000000)")
-    ap.add_argument("--max-seconds", type=float, default=1700.0,
-                    help="global watchdog: graceful exit(4) past this")
+    ap.add_argument("--hidden", default="512,256,128",
+                    help="dense tower widths, comma-separated (bf16-vs-f32 "
+                         "comparisons need a bigger tower, e.g. "
+                         "2048,1024,512)")
+    ap.add_argument("--max-seconds", type=float, default=None,
+                    help="global watchdog: graceful exit(4) past this "
+                         "(default 1700; 5400 for --all's ~10 stages; "
+                         "0 disables)")
     args = ap.parse_args()
+    if args.max_seconds is None:
+        args.max_seconds = 5400.0 if getattr(args, "all") else 1700.0
     start_deadline(args.max_seconds)
 
     if os.environ.get("PBOX_BENCH_CPU"):
@@ -714,7 +729,7 @@ def main() -> None:
 
     N_SLOTS, DENSE, B = args.slots, 13, 2048
     N_INS = 40 * B  # 40 steps
-    HIDDEN = (512, 256, 128)
+    HIDDEN = tuple(int(x) for x in args.hidden.split(",") if x)
     tconf = SparseTableConfig(embedding_dim=args.emb)
     trconf = TrainerConfig(auc_buckets=1 << 20,
                            compute_dtype=args.compute_dtype,

@@ -13,6 +13,7 @@
 //          "<n> v1..vn" per slot in config order)
 
 #include <arpa/inet.h>
+#include <csignal>
 #include <netdb.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -51,6 +52,9 @@ static bool send_all(int fd, const std::string& s) {
 }
 
 int main(int argc, char** argv) {
+  // an early server close must surface as the write-error path below, not
+  // kill the process silently mid-write
+  std::signal(SIGPIPE, SIG_IGN);
   if (argc != 3) {
     std::fprintf(stderr, "usage: %s <host> <port> < slot_lines.txt\n",
                  argv[0]);

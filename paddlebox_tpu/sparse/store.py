@@ -267,6 +267,21 @@ class BucketStore:
         for b, idx in self._split(keys):
             self._set(b, keys[idx], vals[idx])
 
+    def stats(self) -> dict:
+        """Bucket-by-bucket size/finiteness report WITHOUT materializing a
+        global copy (the pre-publish check must not be the thing that OOMs
+        the day-loop host at 1e8+ features)."""
+        n_bytes = 0
+        finite = True
+        for b in range(self.n_buckets):
+            if self._counts[b] == 0:
+                continue
+            bk, bv = self._get(b)
+            n_bytes += int(bk.nbytes + bv.nbytes)
+            if finite:
+                finite = bool(np.isfinite(bv).all())
+        return {"n": self.n, "bytes": n_bytes, "finite": finite}
+
     def materialize(self) -> Tuple[np.ndarray, np.ndarray]:
         """Whole store as (keys, vals), globally key-sorted.  Hash bucketing
         interleaves key ranges across buckets, so this pays one full argsort

@@ -1,0 +1,79 @@
+"""Production fleet utilities (utils/fleet_util.py): health checks, publish
+gating, model reports — the fleet_util.py decision layer
+(reference: fluid/incubate/fleet/utils/fleet_util.py)."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train.trainer import Trainer
+from paddlebox_tpu.utils.fleet_util import (
+    HealthPolicy,
+    ModelMonitor,
+    check_model,
+)
+
+
+def _metrics(auc=0.7, loss=0.5, pred=0.2, actual=0.2, count=100.0):
+    return {"auc": auc, "loss": loss, "predicted_ctr": pred,
+            "actual_ctr": actual, "count": count}
+
+
+def test_health_check_passes_and_fails():
+    mon = ModelMonitor()
+    assert mon.observe(_metrics()).ok
+    # AUC collapse vs previous pass
+    r = mon.check(_metrics(auc=0.55))
+    assert not r.ok and any("dropped" in x for x in r.reasons)
+    # worse than chance
+    r = mon.check(_metrics(auc=0.45))
+    assert not r.ok
+    # diverged loss and non-finite loss
+    assert not mon.check(_metrics(loss=100.0)).ok
+    assert not mon.check(_metrics(loss=float("nan"))).ok
+    # calibration gap (dead tower shape)
+    r = mon.check(_metrics(pred=0.9, actual=0.2))
+    assert not r.ok and any("calibration" in x for x in r.reasons)
+
+
+def test_publish_gate_tracks_best():
+    mon = ModelMonitor(HealthPolicy(max_auc_drop=1.0))
+    mon.observe(_metrics(auc=0.80))
+    assert mon.should_publish(_metrics(auc=0.79))  # within tolerance
+    assert not mon.should_publish(_metrics(auc=0.70))  # far behind best
+    assert mon.should_publish(_metrics(auc=0.81))
+
+
+def test_check_model_and_global_auc(tmp_path):
+    conf = make_synth_config(n_sparse_slots=3, dense_dim=2, batch_size=32,
+                             max_feasigns_per_ins=8)
+    files = write_synth_files(str(tmp_path), n_files=1, ins_per_file=128,
+                              n_sparse_slots=3, vocab_per_slot=40,
+                              dense_dim=2, seed=2)
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    tconf = SparseTableConfig(embedding_dim=4)
+    model = CtrDnn(3, tconf.row_width, dense_dim=2, hidden=(8,))
+    table = SparseTable(tconf, seed=0)
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10), seed=0)
+    table.begin_pass(ds.unique_keys())
+    m = trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    ds.close()
+
+    rep = check_model(table, trainer)
+    assert rep["n_features"] > 0 and rep["sparse_finite"]
+    assert rep["dense_params"] > 0 and rep["dense_finite"]
+    assert rep["sparse_bytes"] > 0 and rep["dense_bytes"] > 0
+
+    g = ModelMonitor.global_auc(trainer)
+    assert g == pytest.approx(m["auc"], abs=1e-9)
+
+    fresh = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10))
+    with pytest.raises(RuntimeError):
+        ModelMonitor.global_auc(fresh)
