@@ -7,16 +7,27 @@ T softmax gates and T task towers.  Task 0's label is the primary label
 slot; tasks 1.. read the configured ``task_label_slots``
 (DataFeedConfig.task_label_slots — the reference names a label var per
 MetricMsg, box_wrapper.cc:1222-1270).
+
+Expert parallelism: with ``expert_mesh`` the expert bank shards over an
+``expert`` mesh axis (parallel/expert.py layout: each device runs its E/P
+experts on the replicated batch; per-task mixing takes the LOCAL gate
+columns and one psum reduces the weighted sum — collective-light for dense
+gating, where every instance consumes every expert).  Identical math to
+the serial bank; sharded-vs-single parity is pinned by test_moe_ep.  The
+reference replicates experts per GPU (no EP engine) — this is a TPU-design
+capability, not a port.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
 
 from paddlebox_tpu.models.layers import (
+    cast_tree,
     init_linear,
     init_mlp,
     linear,
@@ -24,6 +35,7 @@ from paddlebox_tpu.models.layers import (
     resolve_compute_dtype,
 )
 from paddlebox_tpu.ops import fused_seqpool_cvm, pooled_width
+from paddlebox_tpu.parallel.expert import EXPERT_AXIS, expert_parallel_mlp_mix
 
 
 class MMoE:
@@ -40,8 +52,22 @@ class MMoE:
         use_cvm: bool = True,
         cvm_offset: int = 2,
         compute_dtype: str = "",
+        expert_mesh: Optional[Mesh] = None,
     ):
         self.compute_dtype = resolve_compute_dtype(compute_dtype)
+        if expert_mesh is not None:
+            if EXPERT_AXIS not in expert_mesh.axis_names:
+                raise ValueError(
+                    f"expert_mesh needs an {EXPERT_AXIS!r} axis, has "
+                    f"{expert_mesh.axis_names}"
+                )
+            p = int(expert_mesh.shape[EXPERT_AXIS])
+            if n_experts % p:
+                raise ValueError(
+                    f"n_experts {n_experts} not divisible by the "
+                    f"{EXPERT_AXIS!r} axis size {p}"
+                )
+        self.expert_mesh = expert_mesh
         self.n_sparse_slots = n_sparse_slots
         self.emb_width = emb_width
         self.dense_dim = dense_dim
@@ -80,12 +106,49 @@ class MMoE:
         if self.dense_dim:
             feats = jnp.concatenate([feats, dense], axis=1)
         dt = self.compute_dtype
-        expert_out = jnp.stack(
-            [mlp(e, feats, dt) for e in params["experts"]], axis=1
-        )  # [B, E, expert_dim]
-        logits = []
-        for gate, tower in zip(params["gates"], params["towers"]):
-            g = jax.nn.softmax(linear(gate, feats, dt), axis=-1)  # [B, E]
-            mixed = jnp.einsum("be,bed->bd", g, expert_out)
-            logits.append(mlp(tower, mixed, dt)[:, 0])
+        gates = jnp.stack(
+            [
+                jax.nn.softmax(linear(g, feats, dt), axis=-1)
+                for g in params["gates"]
+            ]
+        )  # [T, B, E]
+        if self.expert_mesh is None:
+            expert_out = jnp.stack(
+                [mlp(e, feats, dt) for e in params["experts"]], axis=1
+            )  # [B, E, expert_dim]
+            mixed = jnp.einsum("tbe,bed->tbd", gates, expert_out)
+        else:
+            mixed = self._ep_mixed(params["experts"], feats, gates)
+        logits = [
+            mlp(tower, mixed[t], dt)[:, 0]
+            for t, tower in enumerate(params["towers"])
+        ]
         return jnp.stack(logits, axis=1)
+
+    # -- expert parallelism ------------------------------------------------ #
+    def _ep_mixed(self, experts: list, feats: jax.Array,
+                  gates: jax.Array) -> jax.Array:
+        """[T, B, expert_dim] gate-mixed expert outputs with the expert bank
+        sharded over the ``expert`` mesh axis — the shard_map body is
+        parallel/expert.py's expert_parallel_mlp_mix (replicated batch,
+        local experts, local gate columns, one psum; mlp() cast policy, so
+        serial == sharded under any compute dtype)."""
+        dt = self.compute_dtype
+        # stacked bank: leaves [E, d_in, d_out] / [E, d_out], sharded on E
+        stacked = [
+            {
+                "w": jnp.stack([e[li]["w"] for e in experts]),
+                "b": jnp.stack([e[li]["b"] for e in experts]),
+            }
+            for li in range(len(experts[0]))
+        ]
+        if dt is not None:
+            feats = feats.astype(dt)
+            stacked = cast_tree(stacked, dt)
+
+        return jax.shard_map(
+            expert_parallel_mlp_mix,
+            mesh=self.expert_mesh,
+            in_specs=(P(EXPERT_AXIS), P(), P()),
+            out_specs=P(),
+        )(stacked, feats, gates)

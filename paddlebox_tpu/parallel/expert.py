@@ -29,6 +29,26 @@ import jax.numpy as jnp
 EXPERT_AXIS = "expert"
 
 
+def mix_local_experts(
+    h: jax.Array,  # [E_local, B, D] this device's expert outputs
+    gates: jax.Array,  # [B, E_global] or [T, B, E_global] dense gates
+    axis_name: str = EXPERT_AXIS,
+) -> jax.Array:
+    """The EP mixing layout, shared by every consumer (call INSIDE
+    shard_map): take THIS device's gate columns (experts laid out
+    contiguously in mesh order), weight the local expert outputs, psum.
+    Returns [B, D] (2-D gates) or [T, B, D] (stacked per-task gates) —
+    fully reduced, identical on every device."""
+    idx = jax.lax.axis_index(axis_name)
+    e_local = h.shape[0]
+    g = jax.lax.dynamic_slice_in_dim(gates, idx * e_local, e_local, axis=-1)
+    if gates.ndim == 2:
+        local = jnp.einsum("ebo,be->bo", h, g)
+    else:
+        local = jnp.einsum("ebo,tbe->tbo", h, g)
+    return jax.lax.psum(local, axis_name)
+
+
 def expert_parallel_forward(
     expert_w: jax.Array,  # [E_local, D_in, D_hid] this device's experts
     expert_b: jax.Array,  # [E_local, D_hid]
@@ -36,20 +56,34 @@ def expert_parallel_forward(
     gates: jax.Array,  # [B, E_global] dense softmax gates
     axis_name: str = EXPERT_AXIS,
 ) -> jax.Array:
-    """Gate-weighted sum of expert outputs (call INSIDE shard_map over
-    ``axis_name``; experts laid out contiguously in mesh order).
-    Returns [B, D_hid], fully reduced (identical on every device)."""
-    p_axis = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
-    e_local = expert_w.shape[0]
+    """Gate-weighted sum of single-layer ReLU expert outputs (call INSIDE
+    shard_map over ``axis_name``).  Returns [B, D_hid], fully reduced."""
     # local experts on the full batch: [E_local, B, D_hid]
     h = jax.nn.relu(
         jnp.einsum("bi,eio->ebo", x, expert_w) + expert_b[:, None, :]
     )
-    # my slice of the gate matrix: columns [idx*E_local, (idx+1)*E_local)
-    g = jax.lax.dynamic_slice_in_dim(gates, idx * e_local, e_local, axis=1)
-    local = jnp.einsum("ebo,be->bo", h, g)
-    return jax.lax.psum(local, axis_name)
+    return mix_local_experts(h, gates, axis_name)
+
+
+def expert_parallel_mlp_mix(
+    stacked_layers: list,  # [{"w": [E_local, d_i, d_o], "b": [E_local, d_o]}]
+    x: jax.Array,  # [B, D_in] replicated batch
+    gates: jax.Array,  # [T, B, E_global] stacked per-task dense gates
+    axis_name: str = EXPERT_AXIS,
+) -> jax.Array:
+    """Multi-layer expert bank with mlp() semantics (ReLU between layers,
+    last layer linear, expert outputs upcast to f32 BEFORE the gate mixing
+    — the same cast policy as models/layers.mlp, so a compute-dtype bank
+    mixes identically to the serial path).  Call INSIDE shard_map.
+    Returns [T, B, D_out] f32, fully reduced."""
+    e_local = stacked_layers[0]["w"].shape[0]
+    h = jnp.broadcast_to(x, (e_local, *x.shape))  # [E_local, B, D_in]
+    for li, layer in enumerate(stacked_layers):
+        h = jnp.einsum("ebi,eio->ebo", h, layer["w"]) + layer["b"][:, None, :]
+        if li < len(stacked_layers) - 1:
+            h = jax.nn.relu(h)
+    h = h.astype(jnp.float32)
+    return mix_local_experts(h, gates.astype(jnp.float32), axis_name)
 
 
 def serial_expert_forward(
