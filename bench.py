@@ -74,6 +74,14 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
                     f">{hang_timeout:.0f}s (axon tunnel holds a stale client "
                     "lease?) — exiting so the driver records a diagnosable "
                     "failure, not a timeout")
+                # a parseable diagnostic beats a bare rc=3: value null can
+                # never masquerade as a perf number, but the artifact's
+                # LAST JSON line explains itself
+                emit({"metric": "ctr_dnn_samples_per_sec", "value": None,
+                      "unit": "samples/sec", "vs_baseline": None,
+                      "backend": "unavailable",
+                      "error": "axon backend init hung (stale client "
+                               "lease); no measurement taken"})
                 os._exit(3)
 
     threading.Thread(target=watchdog, daemon=True).start()
@@ -93,6 +101,11 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
                     f"{e!r} — retrying in {delay:.0f}s")
                 state["deadline"] = time.time() + delay + hang_timeout
                 time.sleep(delay)
+        emit({"metric": "ctr_dnn_samples_per_sec", "value": None,
+              "unit": "samples/sec", "vs_baseline": None,
+              "backend": "unavailable",
+              "error": f"backend init failed after {max_tries} tries: "
+                       f"{last!r}"[:300]})
         raise RuntimeError(
             f"backend unavailable after {max_tries} tries: {last!r}"
         )
