@@ -43,8 +43,19 @@ def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
+def emit_unavailable(error: str, metric: str) -> None:
+    """The backend-failure diagnostic line: value null can never pass as a
+    measurement, but the artifact's last JSON line explains itself (and
+    names the metric the run was FOR, so a driver keying on metric names
+    still matches)."""
+    emit({"metric": metric, "value": None, "unit": "samples/sec",
+          "vs_baseline": None, "backend": "unavailable",
+          "error": error[:300]})
+
+
 def init_backend(max_tries: int = 5, base_delay: float = 5.0,
-                 hang_timeout: float = 120.0):
+                 hang_timeout: float = 120.0,
+                 metric: str = "ctr_dnn_samples_per_sec"):
     """Initialize the JAX backend with bounded retry AND a hang watchdog.
 
     The axon TPU tunnel is a single-client resource with two failure modes:
@@ -74,14 +85,11 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
                     f">{hang_timeout:.0f}s (axon tunnel holds a stale client "
                     "lease?) — exiting so the driver records a diagnosable "
                     "failure, not a timeout")
-                # a parseable diagnostic beats a bare rc=3: value null can
-                # never masquerade as a perf number, but the artifact's
-                # LAST JSON line explains itself
-                emit({"metric": "ctr_dnn_samples_per_sec", "value": None,
-                      "unit": "samples/sec", "vs_baseline": None,
-                      "backend": "unavailable",
-                      "error": "axon backend init hung (stale client "
-                               "lease); no measurement taken"})
+                # a parseable diagnostic beats a bare rc=3
+                emit_unavailable(
+                    "axon backend init hung (stale client lease); no "
+                    "measurement taken", metric,
+                )
                 os._exit(3)
 
     threading.Thread(target=watchdog, daemon=True).start()
@@ -94,18 +102,20 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
                 log(f"backend ok (attempt {attempt}): "
                     f"{[f'{d.platform}:{d.id}' for d in devs]}")
                 return devs
-            except RuntimeError as e:
+            except Exception as e:  # OSError/ValueError from the plugin's
+                # tunnel layer must produce the diagnostic line too, not
+                # just RuntimeError from jax's own init
                 last = e
+                if attempt == max_tries:
+                    break  # no further attempt: don't sleep the backoff
                 delay = base_delay * attempt
                 log(f"backend init failed (attempt {attempt}/{max_tries}): "
                     f"{e!r} — retrying in {delay:.0f}s")
                 state["deadline"] = time.time() + delay + hang_timeout
                 time.sleep(delay)
-        emit({"metric": "ctr_dnn_samples_per_sec", "value": None,
-              "unit": "samples/sec", "vs_baseline": None,
-              "backend": "unavailable",
-              "error": f"backend init failed after {max_tries} tries: "
-                       f"{last!r}"[:300]})
+        emit_unavailable(
+            f"backend init failed after {max_tries} tries: {last!r}", metric,
+        )
         raise RuntimeError(
             f"backend unavailable after {max_tries} tries: {last!r}"
         )
@@ -733,7 +743,17 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
 
-    devs = init_backend()
+    if args.pallas:
+        fail_metric = "pallas_vs_xla_gather_scatter"
+    elif args.device_profile:
+        fail_metric = f"{args.model}_device_profile"
+    elif args.trainer_path:
+        fail_metric = f"{args.model}_trainer_path_samples_per_sec"
+    elif args.sustained:
+        fail_metric = "ctr_dnn_sustained_samples_per_sec"
+    else:  # headline and --all lead with the headline metric
+        fail_metric = f"{args.model}_samples_per_sec"
+    devs = init_backend(metric=fail_metric)
     # "axon"/"tpu" = real chip through the tunnel; "cpu" would mean the
     # tunnel was unavailable and the number is NOT a TPU number — the judge
     # asked for this field so a CPU fallback can't masquerade as TPU perf.
