@@ -43,19 +43,20 @@ def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def emit_unavailable(error: str, metric: str) -> None:
+def emit_unavailable(error: str, metric: str, unit: str) -> None:
     """The backend-failure diagnostic line: value null can never pass as a
     measurement, but the artifact's last JSON line explains itself (and
-    names the metric the run was FOR, so a driver keying on metric names
+    names the metric+unit the run was FOR, so a driver keying on either
     still matches)."""
-    emit({"metric": metric, "value": None, "unit": "samples/sec",
+    emit({"metric": metric, "value": None, "unit": unit,
           "vs_baseline": None, "backend": "unavailable",
           "error": error[:300]})
 
 
 def init_backend(max_tries: int = 5, base_delay: float = 5.0,
                  hang_timeout: float = 120.0,
-                 metric: str = "ctr_dnn_samples_per_sec"):
+                 metric: str = "ctr_dnn_samples_per_sec",
+                 unit: str = "samples/sec"):
     """Initialize the JAX backend with bounded retry AND a hang watchdog.
 
     The axon TPU tunnel is a single-client resource with two failure modes:
@@ -88,7 +89,7 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
                 # a parseable diagnostic beats a bare rc=3
                 emit_unavailable(
                     "axon backend init hung (stale client lease); no "
-                    "measurement taken", metric,
+                    "measurement taken", metric, unit,
                 )
                 os._exit(3)
 
@@ -114,7 +115,8 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
                 state["deadline"] = time.time() + delay + hang_timeout
                 time.sleep(delay)
         emit_unavailable(
-            f"backend init failed after {max_tries} tries: {last!r}", metric,
+            f"backend init failed after {max_tries} tries: {last!r}",
+            metric, unit,
         )
         raise RuntimeError(
             f"backend unavailable after {max_tries} tries: {last!r}"
@@ -744,16 +746,19 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     if args.pallas:
-        fail_metric = "pallas_vs_xla_gather_scatter"
+        fail_metric, fail_unit = "pallas_vs_xla_gather_scatter", "ms"
     elif args.device_profile:
-        fail_metric = f"{args.model}_device_profile"
+        fail_metric, fail_unit = f"{args.model}_device_profile", "ms/step"
     elif args.trainer_path:
         fail_metric = f"{args.model}_trainer_path_samples_per_sec"
+        fail_unit = "samples/sec"
     elif args.sustained:
         fail_metric = "ctr_dnn_sustained_samples_per_sec"
+        fail_unit = "samples/sec"
     else:  # headline and --all lead with the headline metric
         fail_metric = f"{args.model}_samples_per_sec"
-    devs = init_backend(metric=fail_metric)
+        fail_unit = "samples/sec"
+    devs = init_backend(metric=fail_metric, unit=fail_unit)
     # "axon"/"tpu" = real chip through the tunnel; "cpu" would mean the
     # tunnel was unavailable and the number is NOT a TPU number — the judge
     # asked for this field so a CPU fallback can't masquerade as TPU perf.

@@ -26,6 +26,14 @@ import tempfile
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# this image's sitecustomize forces jax_platforms="axon,cpu" (the real-TPU
+# tunnel, a single-client resource) over the env var; the example must run
+# anywhere, so pin CPU before any backend init — same guard as
+# examples/bench_store.py
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 def main():
     ap = argparse.ArgumentParser()

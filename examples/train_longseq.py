@@ -21,6 +21,14 @@ import tempfile
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# this image's sitecustomize forces jax_platforms="axon,cpu" (the real-TPU
+# tunnel, a single-client resource reserved for bench.py) over the env var;
+# pin CPU before any backend init so the example runs anywhere.  Delete
+# these two lines to run on a real TPU deployment.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 def main():
     ap = argparse.ArgumentParser()

@@ -52,10 +52,10 @@ class MMoE:
         use_cvm: bool = True,
         cvm_offset: int = 2,
         compute_dtype: str = "",
-        expert_mesh: Optional[Mesh] = None,
+        expert_mesh=None,  # Mesh | "inherit" (inside an outer shard_map)
     ):
         self.compute_dtype = resolve_compute_dtype(compute_dtype)
-        if expert_mesh is not None:
+        if expert_mesh is not None and expert_mesh != "inherit":
             if EXPERT_AXIS not in expert_mesh.axis_names:
                 raise ValueError(
                     f"expert_mesh needs an {EXPERT_AXIS!r} axis, has "
@@ -146,9 +146,18 @@ class MMoE:
             feats = feats.astype(dt)
             stacked = cast_tree(stacked, dt)
 
-        return jax.shard_map(
-            expert_parallel_mlp_mix,
-            mesh=self.expert_mesh,
-            in_specs=(P(EXPERT_AXIS), P(), P()),
-            out_specs=P(),
-        )(stacked, feats, gates)
+        in_specs = (P(EXPERT_AXIS), P(), P(None, None, EXPERT_AXIS))
+        if self.expert_mesh == "inherit":
+            # composed mode: an OUTER shard_map (e.g. MultiChipTrainer on a
+            # data x expert mesh) already established the context mesh; bind
+            # only the expert axis here and let the rest stay as-is
+            sm = jax.shard_map(
+                expert_parallel_mlp_mix, in_specs=in_specs, out_specs=P(),
+                axis_names={EXPERT_AXIS}, check_vma=False,
+            )
+        else:
+            sm = jax.shard_map(
+                expert_parallel_mlp_mix, mesh=self.expert_mesh,
+                in_specs=in_specs, out_specs=P(),
+            )
+        return sm(stacked, feats, gates)

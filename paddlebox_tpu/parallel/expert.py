@@ -10,8 +10,12 @@ EP layout for dense gating is simpler and collective-light:
     over the mesh);
   * the batch is replicated across the axis; every device runs ITS experts
     on the full batch (one vmapped matmul — MXU-dense);
-  * outputs are weighted by the local slice of the gate matrix and psummed:
-    one [B, D_out] all-reduce per layer, vs all-gathering E expert outputs.
+  * the gate matrix is sharded along its expert axis by SPEC (each device
+    receives exactly its experts' columns — no in-body axis_index, which
+    keeps the body legal inside an OUTER shard_map for composed
+    data x expert meshes);
+  * outputs are weighted by the local gate columns and psummed: one
+    [B, D_out] all-reduce per mix, vs all-gathering E expert outputs.
 
 This is the ``parallel/`` family's fifth axis (dp, sparse-MP, pp, sp, ep);
 like the others it is a pure shard_map body that reduces to the serial
@@ -31,21 +35,18 @@ EXPERT_AXIS = "expert"
 
 def mix_local_experts(
     h: jax.Array,  # [E_local, B, D] this device's expert outputs
-    gates: jax.Array,  # [B, E_global] or [T, B, E_global] dense gates
+    gates_local: jax.Array,  # [B, E_local] or [T, B, E_local] gate columns
     axis_name: str = EXPERT_AXIS,
 ) -> jax.Array:
     """The EP mixing layout, shared by every consumer (call INSIDE
-    shard_map): take THIS device's gate columns (experts laid out
-    contiguously in mesh order), weight the local expert outputs, psum.
+    shard_map): weight the local expert outputs by THIS device's gate
+    columns (sharded in by spec ``P(..., EXPERT_AXIS)``), psum.
     Returns [B, D] (2-D gates) or [T, B, D] (stacked per-task gates) —
     fully reduced, identical on every device."""
-    idx = jax.lax.axis_index(axis_name)
-    e_local = h.shape[0]
-    g = jax.lax.dynamic_slice_in_dim(gates, idx * e_local, e_local, axis=-1)
-    if gates.ndim == 2:
-        local = jnp.einsum("ebo,be->bo", h, g)
+    if gates_local.ndim == 2:
+        local = jnp.einsum("ebo,be->bo", h, gates_local)
     else:
-        local = jnp.einsum("ebo,tbe->tbo", h, g)
+        local = jnp.einsum("ebo,tbe->tbo", h, gates_local)
     return jax.lax.psum(local, axis_name)
 
 
@@ -53,28 +54,30 @@ def expert_parallel_forward(
     expert_w: jax.Array,  # [E_local, D_in, D_hid] this device's experts
     expert_b: jax.Array,  # [E_local, D_hid]
     x: jax.Array,  # [B, D_in] replicated batch
-    gates: jax.Array,  # [B, E_global] dense softmax gates
+    gates_local: jax.Array,  # [B, E_local] this device's gate columns
     axis_name: str = EXPERT_AXIS,
 ) -> jax.Array:
     """Gate-weighted sum of single-layer ReLU expert outputs (call INSIDE
-    shard_map over ``axis_name``).  Returns [B, D_hid], fully reduced."""
+    shard_map over ``axis_name``; shard gates with ``P(None, EXPERT_AXIS)``).
+    Returns [B, D_hid], fully reduced."""
     # local experts on the full batch: [E_local, B, D_hid]
     h = jax.nn.relu(
         jnp.einsum("bi,eio->ebo", x, expert_w) + expert_b[:, None, :]
     )
-    return mix_local_experts(h, gates, axis_name)
+    return mix_local_experts(h, gates_local, axis_name)
 
 
 def expert_parallel_mlp_mix(
     stacked_layers: list,  # [{"w": [E_local, d_i, d_o], "b": [E_local, d_o]}]
     x: jax.Array,  # [B, D_in] replicated batch
-    gates: jax.Array,  # [T, B, E_global] stacked per-task dense gates
+    gates_local: jax.Array,  # [T, B, E_local] stacked per-task gate columns
     axis_name: str = EXPERT_AXIS,
 ) -> jax.Array:
     """Multi-layer expert bank with mlp() semantics (ReLU between layers,
     last layer linear, expert outputs upcast to f32 BEFORE the gate mixing
     — the same cast policy as models/layers.mlp, so a compute-dtype bank
-    mixes identically to the serial path).  Call INSIDE shard_map.
+    mixes identically to the serial path).  Call INSIDE shard_map; shard
+    gates with ``P(None, None, EXPERT_AXIS)``.
     Returns [T, B, D_out] f32, fully reduced."""
     e_local = stacked_layers[0]["w"].shape[0]
     h = jnp.broadcast_to(x, (e_local, *x.shape))  # [E_local, B, D_in]
@@ -83,7 +86,7 @@ def expert_parallel_mlp_mix(
         if li < len(stacked_layers) - 1:
             h = jax.nn.relu(h)
     h = h.astype(jnp.float32)
-    return mix_local_experts(h, gates.astype(jnp.float32), axis_name)
+    return mix_local_experts(h, gates_local.astype(jnp.float32), axis_name)
 
 
 def serial_expert_forward(

@@ -87,3 +87,30 @@ def make_mesh(
                 )
             devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (axis_name,))
+
+
+def make_composed_mesh(
+    n_data: int,
+    n_inner: int,
+    inner_axis: str,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """2-D (data x inner) mesh for composed parallelism: the sparse table +
+    batch shard over ``data`` exactly as on a 1-D mesh, while a model axis
+    (``expert``/``seq``) splits the dense compute inside each data shard.
+    Device layout is data-major, so each data shard's inner group is an
+    ICI-adjacent block.  MultiChipTrainer binds only ``data`` manually
+    (axis_names) and the model's inner shard_map (``expert_mesh="inherit"``
+    etc.) binds the inner axis inside the same jitted step."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_data * n_inner
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(n_data, n_inner)
+    return Mesh(arr, (DATA_AXIS, inner_axis))
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Size of the data axis (== total devices on a 1-D mesh)."""
+    return int(mesh.shape[DATA_AXIS])

@@ -26,14 +26,30 @@ def is_multiprocess() -> bool:
 
 
 def local_device_indices(mesh: Mesh) -> np.ndarray:
-    """Positions along the (one-axis) mesh owned by this process, in mesh
-    order.  With the default device order these are contiguous."""
+    """DATA-axis positions owned by this process, in mesh order (with the
+    default device order these are contiguous).  On a composed 2-D mesh a
+    data position is local when this process owns its ENTIRE inner device
+    group; a row spanning processes raises NotImplementedError (each data
+    shard's plans, feeds and readbacks assume one owning process)."""
     pid = jax.process_index()
-    flat = mesh.devices.reshape(-1)
-    return np.asarray(
-        [i for i, d in enumerate(flat) if d.process_index == pid],
-        dtype=np.int64,
-    )
+    if mesh.devices.ndim == 1:
+        flat = mesh.devices
+        return np.asarray(
+            [i for i, d in enumerate(flat) if d.process_index == pid],
+            dtype=np.int64,
+        )
+    rows = mesh.devices.reshape(mesh.devices.shape[0], -1)
+    out = []
+    for i in range(rows.shape[0]):
+        owners = {d.process_index for d in rows[i]}
+        if len(owners) > 1:
+            raise NotImplementedError(
+                "composed meshes need each data shard's inner device group "
+                f"on ONE process; data row {i} spans processes {owners}"
+            )
+        if owners == {pid}:
+            out.append(i)
+    return np.asarray(out, dtype=np.int64)
 
 
 def global_from_local(sharding: NamedSharding, local: Any):
@@ -75,11 +91,28 @@ def host_allgather_varlen(x: np.ndarray) -> np.ndarray:
 
 def local_view(x) -> np.ndarray:
     """Host numpy of this process's slice of a leading-axis-sharded global
-    array: addressable shards concatenated in mesh order -> [L, ...].
-    Single-process this equals np.asarray(x) (L == D)."""
-    shards = sorted(
-        x.addressable_shards, key=lambda s: s.index[0].start or 0
-    )
+    array -> [L, ...].  Single-process: the logical array itself (L == D) —
+    np.asarray handles ANY sharding layout, including the auto-axis
+    shardings a composed mesh's partitioner may leave on non-leading dims.
+    Multi-process: assemble addressable shards; only leading-axis sharding
+    is supported there (asserted), deduplicating inner-axis replicas."""
+    if not is_multiprocess():
+        return np.asarray(x)
+    seen = {}
+    for s in x.addressable_shards:
+        for dim, sl in enumerate(s.index[1:], start=1):
+            full = sl.start in (None, 0) and sl.stop in (
+                None, x.shape[dim]
+            )
+            if not full:
+                raise NotImplementedError(
+                    "multi-process local_view supports leading-axis "
+                    f"sharding only; dim {dim} is sharded ({sl})"
+                )
+        start = s.index[0].start or 0
+        if start not in seen:
+            seen[start] = s
+    shards = [seen[k] for k in sorted(seen)]
     return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
 
 

@@ -113,7 +113,9 @@ class ShardedSparseTable(SparseTable):
                 "dims — model-side masks — which work on every path)"
             )
         self.mesh = mesh
-        self.n_shards = int(mesh.devices.size)
+        # composed (data x inner) meshes shard the table over the DATA
+        # axis only; the inner axis replicates it and splits dense work
+        self.n_shards = int(mesh.shape[DATA_AXIS])
         # all_to_all bucket capacity multiplier over the uniform-hash
         # expectation K / n_shards.  This sizes the BASE bucket only: a
         # group whose worst shard needs more grows the bucket in

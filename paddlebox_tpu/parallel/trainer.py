@@ -54,6 +54,7 @@ from paddlebox_tpu.parallel.mesh import DATA_AXIS
 from paddlebox_tpu.parallel.multiprocess import (
     global_from_local,
     host_allgather,
+    local_device_indices,
     local_view,
     read_replicated,
 )
@@ -211,13 +212,12 @@ class MultiChipTrainer:
         self.model = model
         self.table_conf = table_conf
         self.mesh = mesh
-        self.n_dev = int(mesh.devices.size)
+        self.n_dev = int(mesh.shape[DATA_AXIS])  # data shards (==
+        # devices on a 1-D mesh; a composed mesh's inner axis splits
+        # dense compute inside the step, invisible to feeds/params)
         # local (this-process) device count: feeds/params are assembled from
         # per-process slices, so multi-host runs need no global host arrays
-        self.n_local = sum(
-            1 for d in mesh.devices.reshape(-1)
-            if d.process_index == jax.process_index()
-        )
+        self.n_local = int(local_device_indices(mesh).shape[0])
         self.conf = trainer_conf or TrainerConfig()
         from paddlebox_tpu.models.layers import apply_compute_dtype_override
 
@@ -364,6 +364,7 @@ class MultiChipTrainer:
             mesh=self.mesh,
             in_specs=(spec, spec, spec, spec, spec, spec),
             out_specs=(spec,) * n_out,
+            axis_names={DATA_AXIS},
         )
         return jax.jit(mapped, donate_argnums=(0, 1, 2, 3, 4))
 
@@ -382,7 +383,8 @@ class MultiChipTrainer:
 
         spec = P(DATA_AXIS)
         mapped = shard_map(
-            body, mesh=self.mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+            body, mesh=self.mesh, in_specs=(spec, spec),
+            out_specs=(spec, spec), axis_names={DATA_AXIS},
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
@@ -742,7 +744,8 @@ class MultiChipTrainer:
 
         spec = P(DATA_AXIS)
         mapped = shard_map(
-            body, mesh=self.mesh, in_specs=(spec,) * 4, out_specs=spec
+            body, mesh=self.mesh, in_specs=(spec,) * 4, out_specs=spec,
+            axis_names={DATA_AXIS},
         )
         return jax.jit(mapped, donate_argnums=(2,))
 

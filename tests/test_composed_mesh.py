@@ -1,0 +1,95 @@
+"""Composed 2-D mesh training: data x expert in ONE jitted step.
+
+The sparse table + batch shard over 'data' exactly as on a 1-D mesh while
+MMoE's expert bank shards over the inner 'expert' axis
+(expert_mesh="inherit": the model's shard_map binds the inner axis inside
+MultiChipTrainer's outer data-axis shard_map — nested shard_map over
+disjoint axes of one mesh).  Parity oracle: the SAME run on a plain
+4-device data mesh, which must produce identical metrics — the expert
+axis splits compute, never math."""
+
+import jax
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import MMoE
+from paddlebox_tpu.parallel import make_mesh
+from paddlebox_tpu.parallel.expert import EXPERT_AXIS
+from paddlebox_tpu.parallel.mesh import data_axis_size, make_composed_mesh
+from paddlebox_tpu.parallel.sharded_table import ShardedSparseTable
+from paddlebox_tpu.parallel.trainer import MultiChipTrainer
+
+S, DENSE, B, E = 3, 2, 16, 4
+
+
+def _data(tmp_path, n_ins=256):
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+        max_feasigns_per_ins=8, n_task_labels=1,
+    )
+    files = write_synth_files(
+        str(tmp_path), n_files=1, ins_per_file=n_ins, n_sparse_slots=S,
+        vocab_per_slot=50, dense_dim=DENSE, seed=9, n_task_labels=1,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    return conf, ds
+
+
+def _run(mesh, model, tmp_path, passes=2):
+    conf, ds = _data(tmp_path)
+    tconf = SparseTableConfig(embedding_dim=4)
+    table = ShardedSparseTable(tconf, mesh, seed=0)
+    trainer = MultiChipTrainer(
+        model, tconf, mesh, TrainerConfig(auc_buckets=1 << 10), seed=0
+    )
+    out = None
+    for p in range(passes):
+        table.begin_pass(ds.unique_keys())
+        out = trainer.train_from_dataset(ds, table)
+        table.end_pass()
+    state = table.state_dict()
+    ds.close()
+    return out, state
+
+
+def test_mesh_helpers():
+    mesh = make_composed_mesh(4, 2, EXPERT_AXIS)
+    assert mesh.axis_names == ("data", EXPERT_AXIS)
+    assert data_axis_size(mesh) == 4
+    assert data_axis_size(make_mesh(8)) == 8
+    with pytest.raises(ValueError, match="need"):
+        make_composed_mesh(8, 2, EXPERT_AXIS)
+
+
+def test_composed_data_expert_matches_data_only(tmp_path):
+    kw = dict(dense_dim=DENSE, n_tasks=2, n_experts=E, expert_hidden=(16,),
+              expert_dim=8, tower_hidden=(8,))
+    mesh1 = make_mesh(4)
+    m1, s1 = _run(mesh1, MMoE(S, 6, **kw), tmp_path / "a")
+
+    mesh2 = make_composed_mesh(4, 2, EXPERT_AXIS)
+    m2, s2 = _run(
+        mesh2, MMoE(S, 6, expert_mesh="inherit", **kw), tmp_path / "b"
+    )
+
+    assert m1["steps"] == m2["steps"] > 0
+    # What must be EXACT: the data path.  show/clk counters are pure
+    # data-side sums — any composed-mesh plumbing error (wrong batch
+    # routing, double counting over the inner axis) breaks them first.
+    np.testing.assert_array_equal(s1["keys"], s2["keys"])
+    np.testing.assert_array_equal(s1["values"][:, :2], s2["values"][:, :2])
+    # What is close but NOT bitwise: gradients.  The auto expert axis lets
+    # the partitioner regroup float reductions (~1e-7/apply), and a ReLU
+    # pre-activation sitting within that of a boundary flips its unit's
+    # gradient path discretely — isolated O(lr*grad) embedding diffs that
+    # training dynamics then amplify.  Single-apply EP parity at 2e-5 is
+    # pinned in test_moe_ep; here the claim is structural equivalence.
+    assert m2["loss"] == pytest.approx(m1["loss"], rel=5e-3)
+    assert m2["auc"] == pytest.approx(m1["auc"], abs=2e-2)
+    assert m2["task1/auc"] == pytest.approx(m1["task1/auc"], abs=2e-2)
+    np.testing.assert_allclose(s1["values"], s2["values"], atol=2e-2)

@@ -37,7 +37,8 @@ def _sharded_fn(mesh):
         jax.shard_map(
             expert_parallel_forward,
             mesh=mesh,
-            in_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS), P(), P()),
+            in_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS), P(),
+                      P(None, EXPERT_AXIS)),
             out_specs=P(),
         )
     )
@@ -67,7 +68,8 @@ def test_gradients_match_serial():
         body = jax.shard_map(
             expert_parallel_forward,
             mesh=mesh,
-            in_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS), P(), P()),
+            in_specs=(P(EXPERT_AXIS), P(EXPERT_AXIS), P(),
+                      P(None, EXPERT_AXIS)),
             out_specs=P(),
         )
         return jnp.mean((body(w_, b_, x, gates) - tgt) ** 2)
