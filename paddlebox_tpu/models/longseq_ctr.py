@@ -30,7 +30,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from paddlebox_tpu.models.layers import init_mlp, mlp, resolve_compute_dtype
 from paddlebox_tpu.ops import fused_seqpool_cvm, pooled_width
@@ -63,14 +63,14 @@ class LongSeqCtrDnn:
         max_seq_len: int = 64,
         n_heads: int = 2,
         head_dim: int = 16,
-        seq_mesh: Optional[Mesh] = None,  # None = single-device attention
+        seq_mesh=None,  # Mesh | "inherit" | None (single-device)
         seq_impl: str = "ring",  # "ring" | "ulysses" (with seq_mesh)
         compute_dtype: str = "",
     ):
         self.compute_dtype = resolve_compute_dtype(compute_dtype)
         if seq_impl not in ("ring", "ulysses"):
             raise ValueError(f"unknown seq_impl {seq_impl!r}")
-        if seq_mesh is not None:
+        if seq_mesh is not None and seq_mesh != "inherit":
             if SEQ_AXIS not in seq_mesh.axis_names:
                 raise ValueError(
                     f"seq_mesh needs a {SEQ_AXIS!r} axis, has "
@@ -124,20 +124,41 @@ class LongSeqCtrDnn:
         """[B, T, H, D] attention, sequence-sharded when a mesh is given."""
         if self.seq_mesh is None:
             return full_attention(q, k, v, key_valid=valid)
+
         impl = ring_attention if self.seq_impl == "ring" else ulysses_attention
+        T, H, name = self.max_seq_len, self.n_heads, self.seq_impl
 
         def body(q, k, v, valid):
+            # trace-time shape validation for the "inherit" mode, where no
+            # concrete mesh exists at __init__ (axis_size is static here)
+            p = jax.lax.axis_size(SEQ_AXIS)
+            if T % p:
+                raise ValueError(
+                    f"max_seq_len {T} not divisible by the {SEQ_AXIS!r} "
+                    f"axis size {p}"
+                )
+            if name == "ulysses" and H % p:
+                raise ValueError(
+                    f"ulysses needs n_heads ({H}) divisible by the seq "
+                    f"axis size ({p})"
+                )
+            # non-causal: ring attention carries no positions and uses no
+            # axis_index, so the body nests inside an outer shard_map
+            # (composed data x seq meshes) as-is
             return impl(q, k, v, key_valid=valid)
 
-        return jax.shard_map(
-            body,
-            mesh=self.seq_mesh,
-            in_specs=(
-                P(None, SEQ_AXIS), P(None, SEQ_AXIS), P(None, SEQ_AXIS),
-                P(None, SEQ_AXIS),
-            ),
-            out_specs=P(None, SEQ_AXIS),
-        )(q, k, v, valid)
+        sspec = P(None, SEQ_AXIS)
+        in_specs = (sspec, sspec, sspec, sspec)
+        if self.seq_mesh == "inherit":
+            sm = jax.shard_map(
+                body, in_specs=in_specs, out_specs=sspec,
+                axis_names={SEQ_AXIS}, check_vma=False,
+            )
+        else:
+            sm = jax.shard_map(
+                body, mesh=self.seq_mesh, in_specs=in_specs, out_specs=sspec,
+            )
+        return sm(q, k, v, valid)
 
     def apply(
         self,

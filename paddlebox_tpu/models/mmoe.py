@@ -146,13 +146,27 @@ class MMoE:
             feats = feats.astype(dt)
             stacked = cast_tree(stacked, dt)
 
+        E = self.n_experts
+
+        def checked_mix(stacked, feats, gates):
+            # trace-time validation for "inherit" mode (no concrete mesh at
+            # __init__): axis_size is static here, so raise the same clear
+            # error the Mesh path raises instead of an opaque shard error
+            p_ax = jax.lax.axis_size(EXPERT_AXIS)
+            if E % p_ax:
+                raise ValueError(
+                    f"n_experts {E} not divisible by the {EXPERT_AXIS!r} "
+                    f"axis size {p_ax}"
+                )
+            return expert_parallel_mlp_mix(stacked, feats, gates)
+
         in_specs = (P(EXPERT_AXIS), P(), P(None, None, EXPERT_AXIS))
         if self.expert_mesh == "inherit":
             # composed mode: an OUTER shard_map (e.g. MultiChipTrainer on a
             # data x expert mesh) already established the context mesh; bind
             # only the expert axis here and let the rest stay as-is
             sm = jax.shard_map(
-                expert_parallel_mlp_mix, in_specs=in_specs, out_specs=P(),
+                checked_mix, in_specs=in_specs, out_specs=P(),
                 axis_names={EXPERT_AXIS}, check_vma=False,
             )
         else:

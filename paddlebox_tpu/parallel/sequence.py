@@ -68,6 +68,7 @@ def ring_attention(
     causal: bool = False,
     axis_name: str = SEQ_AXIS,
     key_valid: Optional[jax.Array] = None,
+    positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ring attention over sequence chunks (call INSIDE shard_map over
     ``axis_name``; every array is this device's chunk [B, T_local, H, D],
@@ -76,23 +77,34 @@ def ring_attention(
     key_valid: optional bool [B, T_local] — this chunk's key validity; it
     rides the ring with its K/V block so padded positions are masked
     wherever the block is folded.
+    positions: optional int32 [T_local] — this chunk's GLOBAL sequence
+    positions.  They ride the ring with their K/V block, so causal masking
+    needs no ``axis_index`` — which also makes the body legal inside an
+    OUTER shard_map (composed data x seq meshes), where axis_index of a
+    nested axis does not lower.  Default: derived from axis_index
+    (standalone use).
     """
     p_axis = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
     b, t, h, d = q.shape
     scale = 1.0 / jnp.sqrt(float(d))
-    q_pos = idx * t + jnp.arange(t)  # global positions of local queries
+    # positions are only consumed by causal masking: derive (axis_index) and
+    # ring-carry them ONLY then, so a non-causal call never pays the carry
+    # and stays free of axis_index — legal inside an outer shard_map with no
+    # positions passed at all
+    if causal and positions is None:
+        idx = jax.lax.axis_index(axis_name)
+        positions = idx * t + jnp.arange(t, dtype=jnp.int32)
+    q_pos = positions  # global positions of local queries (None: non-causal)
 
     def fold(args):
         """One online-softmax fold (flash recursion) in f32 accumulators."""
-        k_blk, v_blk, valid_blk, acc, m, l, src = args
+        k_blk, v_blk, valid_blk, pos_blk, acc, m, l = args
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k_blk,
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            k_pos = src * t + jnp.arange(t)
-            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            mask = q_pos[:, None] >= pos_blk[None, :]  # [Tq, Tk]
             s = jnp.where(mask[None, None], s, -jnp.inf)
         s = jnp.where(valid_blk[:, None, None, :], s, -jnp.inf)
         s_max = s.max(axis=-1)  # [B, H, Tq]
@@ -107,32 +119,36 @@ def ring_attention(
         return acc, m_new, l
 
     def tick(carry, j):
-        k_blk, v_blk, valid_blk, acc, m, l = carry
-        src = (idx - j) % p_axis  # which chunk this block is
+        k_blk, v_blk, valid_blk, pos_blk, acc, m, l = carry
         if causal:
             # a block entirely in the causal future folds to a no-op: skip
-            # its matmuls at runtime (the ring shift still happens below)
+            # its matmuls at runtime (the ring shift still happens below).
+            # "entirely in the future" reads off the riding positions, so
+            # no axis_index is needed.
             acc, m, l = jax.lax.cond(
-                src <= idx,
+                pos_blk.min() <= q_pos.max(),
                 fold,
-                lambda args: (args[3], args[4], args[5]),
-                (k_blk, v_blk, valid_blk, acc, m, l, src),
+                lambda args: (args[4], args[5], args[6]),
+                (k_blk, v_blk, valid_blk, pos_blk, acc, m, l),
             )
         else:
-            acc, m, l = fold((k_blk, v_blk, valid_blk, acc, m, l, src))
+            acc, m, l = fold((k_blk, v_blk, valid_blk, pos_blk, acc, m, l))
         # the last tick's rotation would be discarded: skip it (the scan
         # counter is replicated, so every device takes the same branch and
         # the collective stays coherent)
-        k_blk, v_blk, valid_blk = jax.lax.cond(
+        ring = (k_blk, v_blk, valid_blk) + ((pos_blk,) if causal else ())
+        ring = jax.lax.cond(
             j < p_axis - 1,
             lambda kv: jax.lax.ppermute(
                 kv, axis_name,
                 [(i, (i + 1) % p_axis) for i in range(p_axis)],
             ),
             lambda kv: kv,
-            (k_blk, v_blk, valid_blk),
+            ring,
         )
-        return (k_blk, v_blk, valid_blk, acc, m, l), None
+        k_blk, v_blk, valid_blk = ring[:3]
+        pos_blk = ring[3] if causal else pos_blk
+        return (k_blk, v_blk, valid_blk, pos_blk, acc, m, l), None
 
     # accumulate in f32 whatever the input dtype (flash-attention practice:
     # bf16 inputs, f32 running max/normalizer/weighted-sum)
@@ -142,12 +158,16 @@ def ring_attention(
     kv_valid = (
         vary(jnp.ones((b, t), bool)) if key_valid is None else key_valid
     )
+    pos0 = (
+        positions if causal
+        else jnp.zeros((), jnp.int32)  # placeholder, never read or shifted
+    )
     acc0 = jnp.zeros((b, h, t, d), jnp.float32)
     m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
-    (_, _, _, acc, _, l), _ = jax.lax.scan(
+    (_, _, _, _, acc, _, l), _ = jax.lax.scan(
         tick,
-        (k, v, kv_valid, vary(acc0), vary(m0), vary(l0)),
+        (k, v, kv_valid, pos0, vary(acc0), vary(m0), vary(l0)),
         jnp.arange(p_axis),
     )
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B, H, T, D] f32

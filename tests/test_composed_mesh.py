@@ -93,3 +93,56 @@ def test_composed_data_expert_matches_data_only(tmp_path):
     assert m2["auc"] == pytest.approx(m1["auc"], abs=2e-2)
     assert m2["task1/auc"] == pytest.approx(m1["task1/auc"], abs=2e-2)
     np.testing.assert_allclose(s1["values"], s2["values"], atol=2e-2)
+
+
+def test_composed_data_seq_matches_data_only(tmp_path):
+    """data x seq composition: LongSeqCtrDnn's ring attention (positions
+    riding the ring — no axis_index) nested inside MultiChipTrainer's
+    data-axis shard_map."""
+    from paddlebox_tpu.models import LongSeqCtrDnn
+    from paddlebox_tpu.parallel.sequence import SEQ_AXIS
+
+    T = 8
+
+    def data(tmp_path):
+        conf = make_synth_config(
+            n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+            max_feasigns_per_ins=12, sequence_slot="slot0", max_seq_len=T,
+        )
+        files = write_synth_files(
+            str(tmp_path), n_files=1, ins_per_file=256, n_sparse_slots=S,
+            vocab_per_slot=50, dense_dim=DENSE, seed=9, max_keys_per_slot=9,
+        )
+        ds = PadBoxSlotDataset(conf, read_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        return conf, ds
+
+    def run(mesh, model, tp):
+        conf, ds = data(tp)
+        tconf = SparseTableConfig(embedding_dim=4)
+        table = ShardedSparseTable(tconf, mesh, seed=0)
+        trainer = MultiChipTrainer(
+            model, tconf, mesh, TrainerConfig(auc_buckets=1 << 10), seed=0
+        )
+        table.begin_pass(ds.unique_keys())
+        m = trainer.train_from_dataset(ds, table)
+        table.end_pass()
+        state = table.state_dict()
+        ds.close()
+        return m, state
+
+    kw = dict(dense_dim=DENSE, hidden=(16,), max_seq_len=T, n_heads=2,
+              head_dim=4)
+    m1, s1 = run(make_mesh(4), LongSeqCtrDnn(S, 6, **kw), tmp_path / "a")
+    m2, s2 = run(
+        make_composed_mesh(4, 2, SEQ_AXIS),
+        LongSeqCtrDnn(S, 6, seq_mesh="inherit", seq_impl="ring", **kw),
+        tmp_path / "b",
+    )
+    assert m1["steps"] == m2["steps"] > 0
+    np.testing.assert_array_equal(s1["keys"], s2["keys"])
+    np.testing.assert_array_equal(s1["values"][:, :2], s2["values"][:, :2])
+    assert m2["loss"] == pytest.approx(m1["loss"], rel=5e-3)
+    assert m2["auc"] == pytest.approx(m1["auc"], abs=2e-2)
+    np.testing.assert_allclose(s1["values"], s2["values"], atol=2e-2)
