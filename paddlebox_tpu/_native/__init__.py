@@ -28,20 +28,31 @@ _lib = None
 _tried = False
 
 
-def _build() -> Optional[str]:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    tmp = _SO + f".tmp-{os.getpid()}"
+def _build_so(src: str, so: str, extra_flags=()) -> Optional[str]:
+    """Build ``so`` from ``src`` if stale; None on ANY failure (including a
+    missing source file — a cached .so without its source must fall back,
+    not raise)."""
+    try:
+        if os.path.exists(so) and \
+                os.path.getmtime(so) >= os.path.getmtime(src):
+            return so
+    except OSError:
+        return None
+    tmp = so + f".tmp-{os.getpid()}"
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-           "-o", tmp, _SRC]
+           *extra_flags, "-o", tmp, src]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
-        return _SO
+        os.replace(tmp, so)
+        return so
     except (OSError, subprocess.SubprocessError):
         if os.path.exists(tmp):
             os.remove(tmp)
         return None
+
+
+def _build() -> Optional[str]:
+    return _build_so(_SRC, _SO)
 
 
 def get_lib():
@@ -210,3 +221,105 @@ class NativeParser:
             cmatches=cmatches,
             task_labels=tasks,
         )
+
+
+# --------------------------------------------------------------------------- #
+# Native batch planner (plan_resolve.cpp) — own .so, same build discipline
+# --------------------------------------------------------------------------- #
+_PLAN_SRC = os.path.join(_DIR, "plan_resolve.cpp")
+_PLAN_SO = os.path.join(_DIR, "_plan_resolve.so")
+_plan_lock = threading.Lock()
+_plan_lib = None
+_plan_tried = False
+
+
+def _build_plan() -> Optional[str]:
+    return _build_so(_PLAN_SRC, _PLAN_SO)
+
+
+def get_plan_lib():
+    """The loaded planner library, or None (build unavailable/failed)."""
+    global _plan_lib, _plan_tried
+    with _plan_lock:
+        if _plan_tried:
+            return _plan_lib
+        _plan_tried = True
+        so = _build_plan()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        lib.pbx_census_index_build.restype = ctypes.c_void_p
+        lib.pbx_census_index_build.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+        ]
+        lib.pbx_census_index_free.restype = None
+        lib.pbx_census_index_free.argtypes = [ctypes.c_void_p]
+        lib.pbx_plan_resolve.restype = ctypes.c_int64
+        lib.pbx_plan_resolve.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+        ]
+        _plan_lib = lib
+        return _plan_lib
+
+
+class CensusIndex:
+    """Per-pass census hash index (native).  Holds a REFERENCE to the
+    census array — the caller must keep it alive for the index lifetime
+    (SparseTable owns its sorted pass keys for the whole pass)."""
+
+    def __init__(self, lib, census: np.ndarray):
+        self._lib = lib
+        self._census = np.ascontiguousarray(census, dtype=np.uint64)
+        self._lock = threading.Lock()  # close vs concurrent resolve
+        self._handle = lib.pbx_census_index_build(
+            self._census.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            self._census.shape[0],
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._handle:
+                self._lib.pbx_census_index_free(self._handle)
+                self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def resolve(self, keys: np.ndarray, n_real: int, dead: int,
+                scratch_base: int):
+        """(idx, uniq_idx, inverse, key_mask, n_missing) or None."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        K = keys.shape[0]
+        idx = np.empty(K, dtype=np.int32)
+        uniq_idx = np.empty(K, dtype=np.int32)
+        inverse = np.empty(K, dtype=np.int32)
+        key_mask = np.empty(K, dtype=np.float32)
+        i32p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        with self._lock:
+            if not self._handle:
+                return None
+            n_missing = self._lib.pbx_plan_resolve(
+                self._handle,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                K, int(n_real), int(dead), int(scratch_base),
+                i32p(idx), i32p(uniq_idx), i32p(inverse),
+                key_mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            )
+        if n_missing < 0:
+            return None
+        return idx, uniq_idx, inverse, key_mask, int(n_missing)
+
+
+def build_census_index(census: np.ndarray):
+    """A CensusIndex over the sorted pass keys, or None (no native lib)."""
+    lib = get_plan_lib()
+    if lib is None:
+        return None
+    return CensusIndex(lib, census)

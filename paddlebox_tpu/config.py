@@ -39,6 +39,9 @@ class _Flags:
         # use the native (C++/ctypes) slot parser when it builds; falls back
         # to the pure-Python parser automatically
         "use_native_parser": True,
+        # use the native (C++/ctypes) batch planner (dedup + census
+        # resolve, _native/plan_resolve.cpp) when it builds; numpy fallback
+        "use_native_planner": True,
         # reference: FLAGS_padbox_auc_runner_mode (flags.cc:495)
         "auc_runner_mode": False,
         # preferred device compute dtype for dense towers

@@ -113,6 +113,9 @@ class SparseTable:
         # largest key buffer planned so far: sizes the next pass's scratch
         # region (pass 1 falls back to conf.plan_scratch_rows)
         self._last_plan_k = 0
+        # native per-pass census hash index (lazily built on first plan;
+        # borrows self._pass_keys, so it must drop with the pass)
+        self._census_index = None
         # stats
         self.missing_key_count = 0
 
@@ -170,6 +173,7 @@ class SparseTable:
         self.values = jnp.asarray(vals[:, :w])
         self.g2sum = jnp.asarray(vals[:, w])
         self._pass_keys = pk
+        self._census_index = None  # stale: points at the previous census
         self._in_pass = True
         self._delta_keys.append(pk)
 
@@ -186,6 +190,12 @@ class SparseTable:
         self._merge_into_store(pk, vals)
         self.values = None
         self.g2sum = None
+        # DROP the native index reference rather than eagerly closing it: a
+        # feed-prefetch producer that outlived its 5s close() join may still
+        # be inside resolve() holding its own reference — refcounting frees
+        # the handle (CensusIndex.__del__) only after the last user is done,
+        # where an eager close here would be a native use-after-free
+        self._census_index = None
         self._pass_keys = None
         self._in_pass = False
 
@@ -213,6 +223,29 @@ class SparseTable:
         dead = self.dead_row
         scratch_base = self._pass_keys.shape[0]
         self._last_plan_k = max(self._last_plan_k, K)
+
+        from paddlebox_tpu.config import flags
+
+        if flags.use_native_planner:
+            # C++ planner (_native/plan_resolve.cpp): a per-pass census
+            # hash index + one sort-free O(K) batch walk (first-seen slot
+            # order).  Training results are BIT-identical to the numpy
+            # path — idx is order-free and the push permutes
+            # inverse/uniq_idx consistently — pinned by
+            # test_native_planner's e2e equality.
+            ix = self._census_index
+            if ix is None:
+                from paddlebox_tpu._native import build_census_index
+
+                ix = build_census_index(self._pass_keys)
+                self._census_index = ix
+            if ix is not None:
+                out = ix.resolve(keys, n_real, dead, scratch_base)
+                if out is not None:
+                    idx, uniq_idx, inverse, mask, n_missing = out
+                    self.missing_key_count += n_missing
+                    return BatchPlan(idx, uniq_idx, inverse, mask, n_missing)
+
         idx = np.full(K, dead, dtype=np.int32)
         # slots beyond the provisioned scratch clamp to the dead row:
         # push_and_update zeroes every dead-targeted delta, so the clamped

@@ -1,0 +1,147 @@
+"""Native C++ batch planner (_native/plan_resolve.cpp): exact parity with
+the numpy plan_keys path on every output, including scratch-row layout,
+missing keys, duplicates, and padding."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu._native import build_census_index
+from paddlebox_tpu.config import SparseTableConfig, flags
+from paddlebox_tpu.sparse.table import SparseTable
+
+native_available = build_census_index(np.arange(4, dtype=np.uint64)) is not None
+pytestmark = pytest.mark.skipif(
+    not native_available, reason="native planner did not build"
+)
+
+
+def _plans(pass_keys, keys, n_real, conf=None):
+    """(native plan, numpy plan) for identical inputs through the REAL
+    SparseTable.plan_keys — flag-flipped, so the test also pins that the
+    flag routes."""
+    conf = conf or SparseTableConfig(embedding_dim=4, plan_scratch_rows=64)
+    plans = {}
+    for native in (True, False):
+        flags.set("use_native_planner", native)
+        try:
+            t = SparseTable(conf, seed=0)
+            t.begin_pass(pass_keys)
+            plans[native] = (t.plan_keys(keys, n_real), t.missing_key_count)
+            t.end_pass()
+        finally:
+            flags.set("use_native_planner", True)
+    return plans[True], plans[False]
+
+
+def _assert_equal(a, b):
+    """Order-insensitive plan equivalence: the native planner numbers
+    unique slots in first-seen order (numpy: sorted order), so compare
+    the training-visible quantities — idx (order-free), mask, missing
+    counts — and the per-occurrence PUSH TARGET uniq_idx[inverse[occ]],
+    which must agree wherever it aims at a live row (scratch targets
+    differ by slot numbering; their deltas are zero or discarded)."""
+    plan_a, miss_a = a
+    plan_b, miss_b = b
+    np.testing.assert_array_equal(plan_a.idx, plan_b.idx)
+    np.testing.assert_array_equal(plan_a.key_mask, plan_b.key_mask)
+    assert plan_a.n_missing == plan_b.n_missing
+    assert miss_a == miss_b
+    # per-occurrence push target: for occurrences whose key is IN the
+    # census, the target is the pull row (order-free, must match exactly);
+    # missing-key occurrences aim at scratch rows whose numbering is
+    # slot-order-dependent — assert both sides agree on WHICH occurrences
+    # those are, and that their targets are valid scratch/dead rows
+    tgt_a = plan_a.uniq_idx[plan_a.inverse]
+    tgt_b = plan_b.uniq_idx[plan_b.inverse]
+    found_a = (plan_a.idx == tgt_a) & (plan_a.key_mask > 0)
+    found_b = (plan_b.idx == tgt_b) & (plan_b.key_mask > 0)
+    np.testing.assert_array_equal(found_a, found_b)
+    np.testing.assert_array_equal(tgt_a[found_a], plan_b.idx[found_b])
+    # occurrences sharing a key must share a slot (both planners)
+    for plan in (plan_a, plan_b):
+        real = plan.key_mask > 0
+        inv = plan.inverse[real]
+        assert len(set(zip(inv.tolist(), plan.idx[real].tolist()))) == \
+            len(set(inv.tolist()))
+
+
+def test_parity_random_batches():
+    rng = np.random.default_rng(0)
+    pass_keys = np.unique(rng.integers(1, 1 << 40, 5000).astype(np.uint64))
+    for trial in range(5):
+        K = int(rng.integers(64, 512))
+        n_real = int(rng.integers(0, K + 1))
+        keys = np.zeros(K, np.uint64)
+        # mix of census keys (with duplicates) and unseen keys
+        n_hit = n_real * 3 // 4
+        keys[:n_hit] = rng.choice(pass_keys, n_hit)
+        keys[n_hit:n_real] = rng.integers(1 << 41, 1 << 42,
+                                          n_real - n_hit).astype(np.uint64)
+        _assert_equal(*_plans(pass_keys, keys, n_real))
+
+
+def test_parity_edge_cases():
+    pass_keys = np.array([5, 9, 12, 700], dtype=np.uint64)
+    K = 16
+    # all-padding batch
+    _assert_equal(*_plans(pass_keys, np.zeros(K, np.uint64), 0))
+    # every key the same (heavy duplication)
+    keys = np.full(K, 9, np.uint64)
+    _assert_equal(*_plans(pass_keys, keys, K))
+    # keys below/above the whole census (boundary searches)
+    keys = np.array([1, 1, 900, 900, 5, 700] + [0] * 10, np.uint64)
+    _assert_equal(*_plans(pass_keys, keys, 6))
+
+
+def test_parity_under_provisioned_scratch():
+    """Scratch clamping (the dead-row fallback) must match bit-for-bit."""
+    conf = SparseTableConfig(embedding_dim=4, plan_scratch_rows=2)
+    pass_keys = np.arange(1, 900, dtype=np.uint64)
+    rng = np.random.default_rng(1)
+    K = 256
+    keys = np.zeros(K, np.uint64)
+    keys[:100] = rng.choice(pass_keys, 100)
+    _assert_equal(*_plans(pass_keys, keys, 100, conf=conf))
+
+
+def test_e2e_training_same_result(tmp_path):
+    """One real training pass, native vs numpy planner: identical loss and
+    table state (the planner feeds the jitted step, so full-step parity is
+    the end-to-end proof)."""
+    from paddlebox_tpu.config import TrainerConfig
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.train.trainer import Trainer
+
+    conf = make_synth_config(n_sparse_slots=3, dense_dim=2, batch_size=32,
+                             max_feasigns_per_ins=8)
+    files = write_synth_files(str(tmp_path), n_files=1, ins_per_file=128,
+                              n_sparse_slots=3, vocab_per_slot=40,
+                              dense_dim=2, seed=3)
+
+    def run(native):
+        flags.set("use_native_planner", native)
+        try:
+            ds = PadBoxSlotDataset(conf, read_threads=1)
+            ds.set_filelist(files)
+            ds.load_into_memory()
+            tconf = SparseTableConfig(embedding_dim=4)
+            model = CtrDnn(3, tconf.row_width, dense_dim=2, hidden=(8,))
+            table = SparseTable(tconf, seed=0)
+            trainer = Trainer(model, tconf,
+                              TrainerConfig(auc_buckets=1 << 10), seed=0)
+            table.begin_pass(ds.unique_keys())
+            m = trainer.train_from_dataset(ds, table)
+            table.end_pass()
+            state = table.state_dict()
+            ds.close()
+            return m, state
+        finally:
+            flags.set("use_native_planner", True)
+
+    m1, s1 = run(True)
+    m2, s2 = run(False)
+    assert m1["loss"] == m2["loss"]
+    np.testing.assert_array_equal(s1["keys"], s2["keys"])
+    np.testing.assert_array_equal(s1["values"], s2["values"])
