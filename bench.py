@@ -267,6 +267,127 @@ def bench_trainer_path(ds, tconf, trconf, model, seed=0):
     return sps
 
 
+def _ablation_times(trainer, model, tconf, params, opt_state, values, g2sum,
+                    dev, n_it: int = 30):
+    """(times_dict, live_state_tuple): ms per step for progressively larger
+    step programs — the decomposition that tells WHICH op group (tower fwd,
+    bwd+dense update, sparse push, AUC) owns a step-time regression.
+    Mirrors Trainer._build_step's structure on the same feed; the live
+    state tuple hands back usable (possibly updated) buffers because the
+    push stage donates its inputs like the real step does.
+
+    Scope: the PLAIN model contract only.  Models needing extra feed
+    inputs (rank_offset/seq_pos/multi-task labels) or push extras
+    (counter_label_tasks, slot LR map) would need the trainer's full feed
+    matrix mirrored here — rather than silently measuring a DIFFERENT
+    program for them, the ablation skips and says so."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddlebox_tpu.models.layers import bce_with_logits
+    from paddlebox_tpu.sparse.table import pull_rows, push_and_update
+
+    state = (params, opt_state, values, g2sum)
+    if (
+        getattr(model, "uses_rank_offset", False)
+        or getattr(model, "uses_seq_pos", False)
+        or getattr(model, "n_tasks", 1) > 1
+        or trainer.conf.counter_label_tasks
+        or tconf.slot_learning_rates
+    ):
+        log("ablation skipped: model/config needs extra feed or push "
+            "inputs the ablated programs do not mirror")
+        return {}, state
+
+    optimizer = trainer.optimizer
+    bsz = dev["labels"].shape[0]
+
+    def fwd(params, values, batch):
+        rows = pull_rows(values, batch["idx"],
+                         create_threshold=tconf.create_threshold,
+                         cvm_offset=tconf.cvm_offset,
+                         pull_embedx_scale=tconf.pull_embedx_scale)
+        logits = model.apply(params, rows, batch["key_segments"],
+                             batch["dense"], bsz)
+        per_ins = bce_with_logits(logits, batch["labels"]) * batch["ins_mask"]
+        return per_ins.sum() / jnp.maximum(batch["ins_mask"].sum(), 1.0)
+
+    def fwd_only(params, opt_state, values, g2sum, batch):
+        return fwd(params, values, batch)
+
+    def with_bwd(params, opt_state, values, g2sum, batch):
+        def loss_fn(p):  # grad wrt params only: a (0, 1) argnums would
+            # declare a full-table cotangent that belongs to the push bucket
+            return fwd(p, values, batch)
+
+        loss, pg = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(pg, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def with_push(params, opt_state, values, g2sum, batch):
+        # mirrors Trainer._build_step: pull outside the grad, rows as a
+        # differentiated argument, ONE backward for both cotangents
+        rows = pull_rows(values, batch["idx"],
+                         create_threshold=tconf.create_threshold,
+                         cvm_offset=tconf.cvm_offset,
+                         pull_embedx_scale=tconf.pull_embedx_scale)
+
+        def loss_fn(p, r):
+            logits = model.apply(p, r, batch["key_segments"],
+                                 batch["dense"], bsz)
+            per_ins = bce_with_logits(logits, batch["labels"]) \
+                * batch["ins_mask"]
+            return per_ins.sum() / jnp.maximum(batch["ins_mask"].sum(), 1.0)
+
+        loss, (pg, row_grads) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(params, rows)
+        updates, opt_state = optimizer.update(pg, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        v2, g2 = push_and_update(
+            values, g2sum, row_grads, batch["idx"], batch["uniq_idx"],
+            batch["inverse"], batch["key_mask"], batch["key_clicks"], tconf,
+        )
+        return params, opt_state, v2, g2, loss
+
+    out = {}
+    # donate like the real step does (its scatter updates the table
+    # in place; without donation XLA copies the whole table per push and
+    # the ablation overstates the push cost).  Each donated stage runs on
+    # SNAPSHOT copies, so a mid-stage device error (async — it surfaces at
+    # block_until_ready, after rebinding) can only poison the copies: the
+    # caller always gets back the pristine pre-ablation state.
+    for name, fn, donate in [("fwd", fwd_only, ()),
+                             ("fwd_bwd_dense", with_bwd, (0, 1)),
+                             ("plus_push", with_push, (0, 1, 2, 3))]:
+        jf = jax.jit(fn, donate_argnums=donate)
+        p, o, v, g = jax.tree.map(jnp.array, (params, opt_state, values,
+                                              g2sum)) if donate else (
+            params, opt_state, values, g2sum)
+        try:
+            def rebind(res):
+                nonlocal p, o, v, g
+                if name == "fwd_bwd_dense":
+                    p, o = res[0], res[1]
+                elif name == "plus_push":
+                    p, o, v, g = res[0], res[1], res[2], res[3]
+                return res
+
+            res = rebind(jf(p, o, v, g, dev))
+            jax.block_until_ready(res)
+            t0 = time.perf_counter()
+            for _ in range(n_it):
+                res = rebind(jf(p, o, v, g, dev))
+            jax.block_until_ready(res)
+            out[name] = (time.perf_counter() - t0) / n_it * 1e3
+        except Exception as e:
+            log(f"ablation {name} failed: {e!r}")
+            out[name] = float("nan")
+    return ({k: round(v, 2) for k, v in out.items()},
+            (params, opt_state, values, g2sum))
+
+
 def device_profile(ds, tconf, trconf, model, scan_k: int = 8, seed=0):
     """Pin down WHERE per-step time goes on the real chip: device-step-only
     (feed reused, no host work), H2D-only, scan-group-only (stacked feed
@@ -325,6 +446,13 @@ def device_profile(ds, tconf, trconf, model, scan_k: int = 8, seed=0):
     step_ms = (time.perf_counter() - t0) / n_it * 1e3
     log(f"device step only: {step_ms:.2f} ms -> {B / step_ms * 1e3:,.0f} samples/s")
 
+    # ablated steps: where inside the step does the time go?  fwd -> +bwd
+    # and dense update -> +sparse push -> (full, incl. AUC, above)
+    ablate, (params, opt_state, values, g2sum) = _ablation_times(
+        trainer, model, tconf, params, opt_state, values, g2sum, dev)
+    for name, ms in ablate.items():
+        log(f"ablation {name}: {ms:.2f} ms")
+
     # scan group alone: stacked feed reused
     scan_ms = None
     if scan_k > 1:
@@ -354,7 +482,11 @@ def device_profile(ds, tconf, trconf, model, scan_k: int = 8, seed=0):
     return {"host_ms": round(host_ms, 2), "h2d_ms": round(h2d_ms, 2),
             "step_ms": round(step_ms, 2),
             "scan_tick_ms": None if scan_ms is None else round(scan_ms, 2),
-            "feed_mb": round(feed_mb, 2)}
+            "feed_mb": round(feed_mb, 2),
+            "ablation": {
+                k: (None if not np.isfinite(v) else v)
+                for k, v in ablate.items()
+            }}
 
 
 def bench_pallas(n_rows: int = 1 << 21, width: int = 10,
