@@ -295,6 +295,7 @@ def _ablation_times(trainer, model, tconf, params, opt_state, values, g2sum,
         or getattr(model, "n_tasks", 1) > 1
         or trainer.conf.counter_label_tasks
         or tconf.slot_learning_rates
+        or trainer.slot_mask is not None
     ):
         log("ablation skipped: model/config needs extra feed or push "
             "inputs the ablated programs do not mirror")
@@ -362,9 +363,12 @@ def _ablation_times(trainer, model, tconf, params, opt_state, values, g2sum,
                              ("fwd_bwd_dense", with_bwd, (0, 1)),
                              ("plus_push", with_push, (0, 1, 2, 3))]:
         jf = jax.jit(fn, donate_argnums=donate)
-        p, o, v, g = jax.tree.map(jnp.array, (params, opt_state, values,
-                                              g2sum)) if donate else (
-            params, opt_state, values, g2sum)
+        # snapshot ONLY the donated leaves (copying the whole table for the
+        # dense-only stage would transiently double table memory)
+        p, o = (jax.tree.map(jnp.array, (params, opt_state))
+                if donate else (params, opt_state))
+        v, g = ((jnp.array(values), jnp.array(g2sum))
+                if 2 in donate else (values, g2sum))
         try:
             def rebind(res):
                 nonlocal p, o, v, g

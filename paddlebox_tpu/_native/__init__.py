@@ -262,6 +262,13 @@ def get_plan_lib():
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
         ]
+        lib.pbx_census_lookup_unique.restype = ctypes.c_int64
+        lib.pbx_census_lookup_unique.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         _plan_lib = lib
         return _plan_lib
 
@@ -291,6 +298,30 @@ class CensusIndex:
             self.close()
         except Exception:
             pass
+
+    def lookup_unique(self, keys: np.ndarray, n_real: int):
+        """(inverse[:n_real], uniq_key[:n_uniq], uniq_pos[:n_uniq]) with
+        first-seen slot order and census position -1 for absent keys, or
+        None.  The sharded planner's per-device dedup+resolve."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64)
+        K = keys.shape[0]
+        inverse = np.empty(K, dtype=np.int32)
+        uniq_key = np.empty(K, dtype=np.uint64)
+        uniq_pos = np.empty(K, dtype=np.int64)
+        with self._lock:
+            if not self._handle:
+                return None
+            n_uniq = self._lib.pbx_census_lookup_unique(
+                self._handle,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                K, int(n_real),
+                inverse.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                uniq_key.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                uniq_pos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            )
+        if n_uniq < 0:
+            return None
+        return (inverse[:n_real], uniq_key[:n_uniq], uniq_pos[:n_uniq])
 
     def resolve(self, keys: np.ndarray, n_real: int, dead: int,
                 scratch_base: int):

@@ -158,3 +158,65 @@ long long pbx_plan_resolve(
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Sharded-path resolve: dedup occurrences (first-seen slot order) and look
+// every unique key up in the census index — WITHOUT the single-chip plan's
+// scratch/dead semantics (the sharded planner derives owner shards and
+// within-shard rows itself from the census position).
+//
+// Outputs (preallocated, length K):
+//   inverse[occ]   = slot of the occurrence (occ < n_real; tail untouched)
+//   uniq_key[j]    = the slot's key                     (j < n_uniq)
+//   uniq_pos[j]    = census position or -1 when absent  (j < n_uniq)
+// Returns n_uniq (or -1 on bad arguments).
+long long pbx_census_lookup_unique(
+    void* handle,
+    const unsigned long long* keys, long long K, long long n_real,
+    int* inverse, unsigned long long* uniq_key, long long* uniq_pos) {
+  if (n_real < 0 || n_real > K) return -1;
+  const CensusIndex* ix = static_cast<CensusIndex*>(handle);
+  if (n_real == 0) return 0;
+
+  unsigned long long lmask =
+      pow2_at_least((unsigned long long)(2 * n_real)) - 1;
+  std::vector<unsigned int> lslot((size_t)lmask + 1, kEmpty);
+
+  long long n_uniq = 0;
+  for (long long o = 0; o < n_real; ++o) {
+    const unsigned long long k = keys[o];
+    unsigned long long h = splitmix64(k) & lmask;
+    long long slot = -1;
+    while (true) {
+      unsigned int s = lslot[h];
+      if (s == kEmpty) break;
+      if (uniq_key[s] == k) {
+        slot = (long long)s;
+        break;
+      }
+      h = (h + 1) & lmask;
+    }
+    if (slot < 0) {
+      slot = n_uniq++;
+      lslot[h] = (unsigned int)slot;
+      uniq_key[(size_t)slot] = k;
+      long long row = -1;
+      unsigned long long ch = splitmix64(k) & ix->mask;
+      while (true) {
+        unsigned int c = ix->slot[ch];
+        if (c == kEmpty) break;
+        if (ix->keys[c] == k) {
+          row = (long long)c;
+          break;
+        }
+        ch = (ch + 1) & ix->mask;
+      }
+      uniq_pos[slot] = row;
+    }
+    inverse[o] = (int)slot;
+  }
+  return n_uniq;
+}
+
+}  // extern "C"

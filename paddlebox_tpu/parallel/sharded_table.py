@@ -148,6 +148,19 @@ class ShardedSparseTable(SparseTable):
                 "jax.devices() default order"
             )
 
+    def _native_index(self):
+        """Lazily built native census index for this pass (None when the
+        native planner is off/unavailable)."""
+        from paddlebox_tpu.config import flags
+
+        if not flags.use_native_planner:
+            return None
+        if self._census_index is None:
+            from paddlebox_tpu._native import build_census_index
+
+            self._census_index = build_census_index(self._pass_keys)
+        return self._census_index
+
     @property
     def n_local(self) -> int:
         """Devices (== shards) owned by this process."""
@@ -195,6 +208,7 @@ class ShardedSparseTable(SparseTable):
         self.values = global_from_local(sharding, jnp.asarray(lvals[:, :, :w]))
         self.g2sum = global_from_local(sharding, jnp.asarray(lvals[:, :, w]))
         self._shard_keys = shard_keys
+        self._census_index = None  # stale: points at the previous census
         self._shard_live = np.asarray(
             [shard_keys[o].shape[0] for o in self._local_pos], np.int32
         )  # per-LOCAL-shard scratch base
@@ -211,6 +225,9 @@ class ShardedSparseTable(SparseTable):
     def end_pass(self) -> None:
         if not self._in_pass:
             raise RuntimeError("no pass in flight")
+        # drop (never eagerly close) the native index: a prefetch producer
+        # may still hold a reference — see SparseTable.end_pass
+        self._census_index = None
         vals = local_view(self.values)  # [L, cap, W]
         g2 = local_view(self.g2sum)  # [L, cap]
         for i, o in enumerate(self._local_pos):
@@ -329,13 +346,30 @@ class ShardedSparseTable(SparseTable):
         per_dev: list = []
         needed = 0
         n_missing = 0
+        ix = self._native_index()
         for b in batches:
             if b.n_keys == 0:
                 per_dev.append(None)
                 continue
             real = b.keys[: b.n_keys]
-            uk, inv = np.unique(real, return_inverse=True)
-            rows, owner, miss = self._resolve_shard_rows(uk)
+            out = ix.lookup_unique(real, b.n_keys) if ix is not None else None
+            if out is not None:
+                # native dedup+census lookup (first-seen slot order —
+                # self-consistent within the plan, like the single-chip
+                # planner; _native/plan_resolve.cpp)
+                inv, uk, pos = out
+                found = pos >= 0
+                if self._pass_row.shape[0]:
+                    rows = np.where(
+                        found, self._pass_row[np.clip(pos, 0, None)], dead
+                    ).astype(np.int32)
+                else:  # empty census: nothing can be found
+                    rows = np.full(uk.shape[0], dead, np.int32)
+                owner = (uk % np.uint64(n)).astype(np.int64)
+                miss = int((~found).sum())
+            else:
+                uk, inv = np.unique(real, return_inverse=True)
+                rows, owner, miss = self._resolve_shard_rows(uk)
             slot = _rank_within_group(owner, n)
             n_missing += miss
             per_dev.append((b.n_keys, inv, rows, owner, slot))
