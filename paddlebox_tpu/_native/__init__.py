@@ -248,29 +248,44 @@ def get_plan_lib():
         if so is None:
             return None
         lib = ctypes.CDLL(so)
-        lib.pbx_census_index_build.restype = ctypes.c_void_p
-        lib.pbx_census_index_build.argtypes = [
-            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
-        ]
-        lib.pbx_census_index_free.restype = None
-        lib.pbx_census_index_free.argtypes = [ctypes.c_void_p]
-        lib.pbx_plan_resolve.restype = ctypes.c_int64
-        lib.pbx_plan_resolve.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
-        ]
-        lib.pbx_census_lookup_unique.restype = ctypes.c_int64
-        lib.pbx_census_lookup_unique.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_int64),
-        ]
+        try:
+            _bind_plan_symbols(lib)
+        except AttributeError:
+            # a cached .so from an older source (flattened mtimes skip the
+            # rebuild) lacks newer symbols: fall back to numpy rather than
+            # crash the planner — same discipline as pbx_hash_ids
+            return None
         _plan_lib = lib
         return _plan_lib
+
+
+def _bind_plan_symbols(lib) -> None:
+    lib.pbx_census_index_build.restype = ctypes.c_void_p
+    lib.pbx_census_index_build.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+    ]
+    lib.pbx_census_index_free.restype = None
+    lib.pbx_census_index_free.argtypes = [ctypes.c_void_p]
+    lib.pbx_plan_resolve.restype = ctypes.c_int64
+    lib.pbx_plan_resolve.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.pbx_dedup_rows.restype = ctypes.c_int64
+    lib.pbx_dedup_rows.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.pbx_census_lookup_unique.restype = ctypes.c_int64
+    lib.pbx_census_lookup_unique.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
 
 
 class CensusIndex:
@@ -354,3 +369,19 @@ def build_census_index(census: np.ndarray):
     if lib is None:
         return None
     return CensusIndex(lib, census)
+
+
+def dedup_rows_native(rows: np.ndarray):
+    """First-seen-order unique of an int32 id buffer: (inverse, uniq) or
+    None when the native lib is unavailable.  The sharded serve-side
+    np.unique replacement (no census involved; stateless)."""
+    lib = get_plan_lib()
+    if lib is None:
+        return None
+    rows = np.ascontiguousarray(rows, dtype=np.int32).reshape(-1)
+    n = rows.shape[0]
+    inverse = np.empty(n, dtype=np.int32)
+    uniq = np.empty(max(n, 1), dtype=np.int32)
+    i32p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    n_uniq = lib.pbx_dedup_rows(i32p(rows), n, i32p(inverse), i32p(uniq))
+    return inverse, uniq[:n_uniq]

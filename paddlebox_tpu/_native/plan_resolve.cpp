@@ -220,3 +220,45 @@ long long pbx_census_lookup_unique(
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Row dedup for the sharded serve side: first-seen-order unique of an
+// int32 row-id buffer (no census involved).  Replaces per-shard
+// np.unique(serve_rows, return_inverse=True) on the plan_group hot path.
+//
+// Outputs (preallocated, length n):
+//   inverse[i] = slot of rows[i]
+//   uniq[j]    = the slot's row id (j < n_uniq)
+// Returns n_uniq.
+long long pbx_dedup_rows(const int* rows, long long n,
+                         int* inverse, int* uniq) {
+  if (n <= 0) return 0;
+  unsigned long long lmask = pow2_at_least((unsigned long long)(2 * n)) - 1;
+  std::vector<unsigned int> lslot((size_t)lmask + 1, kEmpty);
+  long long n_uniq = 0;
+  for (long long i = 0; i < n; ++i) {
+    const int r = rows[i];
+    unsigned long long h =
+        splitmix64((unsigned long long)(unsigned int)r) & lmask;
+    long long slot = -1;
+    while (true) {
+      unsigned int s = lslot[h];
+      if (s == kEmpty) break;
+      if (uniq[s] == r) {
+        slot = (long long)s;
+        break;
+      }
+      h = (h + 1) & lmask;
+    }
+    if (slot < 0) {
+      slot = n_uniq++;
+      lslot[h] = (unsigned int)slot;
+      uniq[slot] = r;
+    }
+    inverse[i] = (int)slot;
+  }
+  return n_uniq;
+}
+
+}  // extern "C"

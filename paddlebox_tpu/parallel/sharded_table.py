@@ -148,19 +148,6 @@ class ShardedSparseTable(SparseTable):
                 "jax.devices() default order"
             )
 
-    def _native_index(self):
-        """Lazily built native census index for this pass (None when the
-        native planner is off/unavailable)."""
-        from paddlebox_tpu.config import flags
-
-        if not flags.use_native_planner:
-            return None
-        if self._census_index is None:
-            from paddlebox_tpu._native import build_census_index
-
-            self._census_index = build_census_index(self._pass_keys)
-        return self._census_index
-
     @property
     def n_local(self) -> int:
         """Devices (== shards) owned by this process."""
@@ -429,7 +416,18 @@ class ShardedSparseTable(SparseTable):
             dead,
         )
         for o in range(L):
-            uq, inv = np.unique(serve_rows[o].reshape(-1), return_inverse=True)
+            out = None
+            if ix is not None:  # same flag/availability as the request side
+                from paddlebox_tpu._native import dedup_rows_native
+
+                out = dedup_rows_native(serve_rows[o])
+            if out is not None:
+                inv, uq = out  # first-seen order: self-consistent, like
+                # the request side (training-visible results unchanged)
+            else:
+                uq, inv = np.unique(
+                    serve_rows[o].reshape(-1), return_inverse=True
+                )
             serve_uniq[o, : uq.shape[0]] = uq
             serve_map[o] = inv.reshape(n, C).astype(np.int32)
         self.missing_key_count += n_missing

@@ -119,6 +119,21 @@ class SparseTable:
         # stats
         self.missing_key_count = 0
 
+    def _native_index(self):
+        """Lazily built native census index for this pass (None when the
+        native planner is off/unavailable).  Shared by the single-chip and
+        sharded planners; reset (dropped, never eagerly freed) at every
+        pass boundary."""
+        from paddlebox_tpu.config import flags
+
+        if not flags.use_native_planner:
+            return None
+        if self._census_index is None:
+            from paddlebox_tpu._native import build_census_index
+
+            self._census_index = build_census_index(self._pass_keys)
+        return self._census_index
+
     # -- introspection --------------------------------------------------- #
     @property
     def n_features(self) -> int:
@@ -224,27 +239,18 @@ class SparseTable:
         scratch_base = self._pass_keys.shape[0]
         self._last_plan_k = max(self._last_plan_k, K)
 
-        from paddlebox_tpu.config import flags
-
-        if flags.use_native_planner:
-            # C++ planner (_native/plan_resolve.cpp): a per-pass census
-            # hash index + one sort-free O(K) batch walk (first-seen slot
-            # order).  Training results are BIT-identical to the numpy
-            # path — idx is order-free and the push permutes
-            # inverse/uniq_idx consistently — pinned by
-            # test_native_planner's e2e equality.
-            ix = self._census_index
-            if ix is None:
-                from paddlebox_tpu._native import build_census_index
-
-                ix = build_census_index(self._pass_keys)
-                self._census_index = ix
-            if ix is not None:
-                out = ix.resolve(keys, n_real, dead, scratch_base)
-                if out is not None:
-                    idx, uniq_idx, inverse, mask, n_missing = out
-                    self.missing_key_count += n_missing
-                    return BatchPlan(idx, uniq_idx, inverse, mask, n_missing)
+        # C++ planner (_native/plan_resolve.cpp): a per-pass census hash
+        # index + one sort-free O(K) batch walk (first-seen slot order).
+        # Training results are BIT-identical to the numpy path — idx is
+        # order-free and the push permutes inverse/uniq_idx consistently —
+        # pinned by test_native_planner's e2e equality.
+        ix = self._native_index()
+        if ix is not None:
+            out = ix.resolve(keys, n_real, dead, scratch_base)
+            if out is not None:
+                idx, uniq_idx, inverse, mask, n_missing = out
+                self.missing_key_count += n_missing
+                return BatchPlan(idx, uniq_idx, inverse, mask, n_missing)
 
         idx = np.full(K, dead, dtype=np.int32)
         # slots beyond the provisioned scratch clamp to the dead row:
