@@ -9,9 +9,12 @@ The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
 measured speedup of our pass-scoped design (host key planning + dedup merge +
 fused segment-sum pooling, sparse/table.py) over a *naive JAX port* of the
 same model (no dedup, per-slot masked pooling — what a line-for-line
-translation of pull_box_sparse + sequence_pool would look like).  Details and
-host-parser throughput land in BASELINE.md by hand; stderr carries the
-breakdown.
+translation of pull_box_sparse + sequence_pool would look like).  The
+headline measures BOTH driver loops over that design — the plain async
+loop and the prefetch+scan trainer path — and reports the better one,
+labeled by the "path" field (plain | scan8), so the number tracks the
+best honest configuration on the day's backend.  Details and host-parser
+throughput land in BASELINE.md by hand; stderr carries the breakdown.
 """
 
 from __future__ import annotations
@@ -713,14 +716,40 @@ def stage_headline(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
     no try/except can catch) still leaves the ours line on stdout.  The
     ONE body behind both `python bench.py [--model X]` and run_all —
     single-metric CLI and --all capture cannot drift."""
+    import dataclasses
+
     with tempfile.TemporaryDirectory() as td:
         conf, ds, _, model = _data_and_model(
             td, args, tconf, n_slots, dense, bsz, n_ins, hidden, model_name)
         try:
             ours = bench_ours(ds, tconf, trconf, model)
+            path = "plain"
+            # partial emit FIRST: everything after this (scan variant,
+            # naive) can die to an uncatchable OOM/SIGKILL without losing
+            # the measured number — the driver parses the LAST line
             emit({"metric": f"{model_name}_samples_per_sec",
                   "value": round(ours, 1), "unit": "samples/sec",
-                  "vs_baseline": None, "backend": backend})
+                  "vs_baseline": None, "backend": backend, "path": path})
+            if with_naive:
+                # the true headline additionally tries the production path
+                # (prefetch + scan dispatch): it wins when dispatch latency
+                # dominates and loses when the scan program is slow on the
+                # day's backend — report the best honest number, labeled
+                # by "path" (same model/data/work; only the driver loop
+                # differs).  Zoo rows stay single-pass for run_all time.
+                try:
+                    sps2 = bench_trainer_path(
+                        ds, tconf, dataclasses.replace(trconf, scan_steps=8),
+                        model)
+                    if sps2 > ours:
+                        ours, path = sps2, "scan8"
+                        emit({"metric": f"{model_name}_samples_per_sec",
+                              "value": round(ours, 1),
+                              "unit": "samples/sec", "vs_baseline": None,
+                              "backend": backend, "path": path})
+                except Exception as e:
+                    log(f"trainer-path variant failed: {e!r}")
+                log(f"headline path: {path} ({ours:,.0f} samples/s)")
             naive = float("nan")
             if with_naive:
                 try:
@@ -735,7 +764,7 @@ def stage_headline(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
             else None
         emit({"metric": f"{model_name}_samples_per_sec",
               "value": round(ours, 1), "unit": "samples/sec",
-              "vs_baseline": vs, "backend": backend})
+              "vs_baseline": vs, "backend": backend, "path": path})
 
 
 def stage_device_profile(backend, args, tconf, trconf, n_slots, dense, bsz,
