@@ -730,17 +730,24 @@ def stage_headline(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
             emit({"metric": f"{model_name}_samples_per_sec",
                   "value": round(ours, 1), "unit": "samples/sec",
                   "vs_baseline": None, "backend": backend, "path": path})
+            naive = float("nan")
             if with_naive:
                 # the true headline additionally tries the production path
                 # (prefetch + scan dispatch): it wins when dispatch latency
                 # dominates and loses when the scan program is slow on the
                 # day's backend — report the best honest number, labeled
                 # by "path" (same model/data/work; only the driver loop
-                # differs).  Zoo rows stay single-pass for run_all time.
+                # differs).  Zoo rows stay single-pass for run_all time;
+                # this measurement also stands in for a dedicated
+                # trainer-path stage (its own metric line below).
                 try:
                     sps2 = bench_trainer_path(
                         ds, tconf, dataclasses.replace(trconf, scan_steps=8),
                         model)
+                    emit({"metric":
+                          f"{model_name}_trainer_path_samples_per_sec",
+                          "value": round(sps2, 1), "unit": "samples/sec",
+                          "vs_baseline": None, "backend": backend})
                     if sps2 > ours:
                         ours, path = sps2, "scan8"
                         emit({"metric": f"{model_name}_samples_per_sec",
@@ -750,8 +757,6 @@ def stage_headline(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
                 except Exception as e:
                     log(f"trainer-path variant failed: {e!r}")
                 log(f"headline path: {path} ({ours:,.0f} samples/s)")
-            naive = float("nan")
-            if with_naive:
                 try:
                     naive = bench_naive(ds, tconf, trconf, hidden)
                 except Exception as e:
@@ -792,6 +797,83 @@ def stage_trainer_path(backend, args, tconf, trconf, n_slots, dense, bsz,
     emit({"metric": f"{args.model}_trainer_path_samples_per_sec",
           "value": round(sps, 1), "unit": "samples/sec", "vs_baseline": None,
           "backend": backend})
+
+
+def stage_ops(backend, args) -> None:
+    """Per-op micro-benchmarks of the CTR op zoo on the live backend — the
+    analog of the reference's op_tester harness
+    (operators/benchmark/op_tester.cc): one jitted call per op at bench
+    shapes, ms per call."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddlebox_tpu.ops import (
+        fused_concat,
+        fused_seqpool_cvm,
+        rank_attention,
+    )
+    from paddlebox_tpu.ops.seqpool_cvm import (
+        fused_seqpool_cvm_with_conv,
+        fused_seqpool_cvm_with_pcoc,
+    )
+
+    rng = np.random.default_rng(0)
+    B, S, W = 2048, args.slots, args.emb + 2
+    K = B * S * 4
+    rows = jnp.asarray(np.abs(rng.normal(size=(K, W))).astype(np.float32))
+    rows_conv = jnp.asarray(
+        np.abs(rng.normal(size=(K, W + 1))).astype(np.float32))
+    rows_pcoc = jnp.asarray(
+        np.abs(rng.normal(size=(K, W + 3))).astype(np.float32))
+    segs = jnp.asarray(np.sort(rng.integers(0, B * S, K)).astype(np.int32))
+
+    N, F, C, MR = 2048, 64, 32, 3
+    x = jnp.asarray(rng.normal(size=(N, F)).astype(np.float32))
+    ro = np.full((N, 2 * MR + 1), -1, np.int32)
+    ro[:, 0] = rng.integers(1, MR + 1, N)
+    ro[:, 2] = rng.integers(0, N, N)
+    ro[:, 1] = rng.integers(1, MR + 1, N)
+    rparam = jnp.asarray(
+        rng.normal(size=(MR * MR * F, C)).astype(np.float32))
+    ro = jnp.asarray(ro)
+    parts = [jnp.asarray(rng.normal(size=(B, 37)).astype(np.float32))
+             for _ in range(4)]
+
+    ops = {
+        "fused_seqpool_cvm": (
+            jax.jit(lambda r, s: fused_seqpool_cvm(r, s, B, S)), (rows, segs)),
+        "seqpool_cvm_conv": (
+            jax.jit(lambda r, s: fused_seqpool_cvm_with_conv(
+                r, s, B, S, cvm_offset=3)), (rows_conv, segs)),
+        "seqpool_cvm_pcoc": (
+            jax.jit(lambda r, s: fused_seqpool_cvm_with_pcoc(
+                r, s, B, S, pclk_num=1)), (rows_pcoc, segs)),
+        "rank_attention": (
+            jax.jit(lambda a, b, c: rank_attention(a, b, c, MR)),
+            (x, ro, rparam)),
+        "fused_concat": (
+            jax.jit(lambda a, b, c, d: fused_concat(
+                [a, b], [c, d],
+                [(0, i) for i in range(16)] + [(1, i) for i in range(16)],
+            )), tuple(parts)),
+    }
+    res = {}
+    for name, (fn, fa) in ops.items():
+        try:
+            out = fn(*fa)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(50):
+                out = fn(*fa)
+            jax.block_until_ready(out)
+            res[name] = round((time.perf_counter() - t0) / 50 * 1e3, 3)
+            log(f"op {name}: {res[name]:.3f} ms")
+        except Exception as e:
+            log(f"op {name} failed: {e!r}")
+            res[name] = None
+    rep = next((v for v in res.values() if v is not None), None)
+    emit({"metric": "ctr_op_microbench", "value": rep,
+          "unit": "ms", "vs_baseline": None, "backend": backend, **res})
 
 
 def stage_pallas(backend) -> None:
@@ -837,9 +919,7 @@ def run_all(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
           with_naive=True)
     stage("device_profile", stage_device_profile, *common, scan_k=8)
     stage("pallas", stage_pallas, backend)
-    tp_conf = dataclasses.replace(trconf, scan_steps=8)
-    stage("trainer_path", stage_trainer_path, backend, args, tconf, tp_conf,
-          n_slots, dense, bsz, n_ins, hidden)
+    stage("ops", stage_ops, backend, args)
     for name in ("deepfm", "widedeep", "xdeepfm", "dcn", "mmoe"):
         stage(f"zoo_{name}", stage_headline, *common, model_name=name,
               with_naive=False)
@@ -880,6 +960,8 @@ def main() -> None:
                     help="isolate host/H2D/step/scan stage timings")
     ap.add_argument("--pallas", action="store_true",
                     help="Pallas vs XLA gather/scatter at table shapes")
+    ap.add_argument("--ops", action="store_true",
+                    help="per-op micro-benchmarks of the CTR op zoo")
     ap.add_argument("--all", action="store_true",
                     help="one process, every measurement: headline+naive, "
                          "device profile, pallas, trainer path, model zoo, "
@@ -910,7 +992,9 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
 
-    if args.pallas:
+    if args.ops:
+        fail_metric, fail_unit = "ctr_op_microbench", "ms"
+    elif args.pallas:
         fail_metric, fail_unit = "pallas_vs_xla_gather_scatter", "ms"
     elif args.device_profile:
         fail_metric, fail_unit = f"{args.model}_device_profile", "ms/step"
@@ -942,6 +1026,10 @@ def main() -> None:
 
     if args.pallas:
         stage_pallas(backend)
+        return
+
+    if args.ops:
+        stage_ops(backend, args)
         return
 
     if args.all:
