@@ -871,8 +871,11 @@ def stage_ops(backend, args) -> None:
         except Exception as e:
             log(f"op {name} failed: {e!r}")
             res[name] = None
-    rep = next((v for v in res.values() if v is not None), None)
-    emit({"metric": "ctr_op_microbench", "value": rep,
+    # "value" is ALWAYS fused_seqpool_cvm (the canonical hot op) so the
+    # field means the same thing run-to-run; the per-op keys carry every
+    # other measurement even when the canonical one failed (null)
+    emit({"metric": "ctr_op_microbench",
+          "value": res.get("fused_seqpool_cvm"),
           "unit": "ms", "vs_baseline": None, "backend": backend, **res})
 
 
@@ -963,9 +966,10 @@ def main() -> None:
     ap.add_argument("--ops", action="store_true",
                     help="per-op micro-benchmarks of the CTR op zoo")
     ap.add_argument("--all", action="store_true",
-                    help="one process, every measurement: headline+naive, "
-                         "device profile, pallas, trainer path, model zoo, "
-                         "sustained north-star — one JSON line each")
+                    help="one process, every measurement: headline (plain "
+                         "AND scan trainer path) + naive, device profile, "
+                         "pallas, op micro-bench, model zoo, sustained "
+                         "north-star — one JSON line each")
     ap.add_argument("--slots", type=int, default=16,
                     help="sparse slots (north-star sustained shape: 26)")
     ap.add_argument("--emb", type=int, default=8,

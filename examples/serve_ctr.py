@@ -3,9 +3,9 @@
 
 The reference ships a C++ AnalysisPredictor + HTTP/Go/R clients
 (/root/reference/paddle/fluid/inference/); here the equivalent loop is a
-few lines over the exported StableHLO artifact: a stdlib HTTP server whose
-POST /score body is canonical slot-data text lines, scored through
-``Predictor`` (inference/predictor.py).
+few lines over the exported StableHLO artifact: the packaged
+``ScoringServer`` (inference/server.py — POST /score with slot-text
+lines, /healthz, multi-model routing), driven end to end.
 
     python examples/serve_ctr.py            # train + export + demo request
     python examples/serve_ctr.py --port 0   # pick a free port and stay up
@@ -16,7 +16,6 @@ import json
 import os
 import sys
 import tempfile
-from http.server import BaseHTTPRequestHandler, HTTPServer
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -68,43 +67,6 @@ def build_artifact(work: str) -> tuple[str, "object"]:
     return art, conf
 
 
-def make_handler(predictor, conf):
-    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
-
-    class Handler(BaseHTTPRequestHandler):
-        def do_POST(self):
-            if self.path != "/score":
-                self.send_error(404)
-                return
-            body = self.rfile.read(int(self.headers["Content-Length"]))
-            # body = canonical slot text lines; run them through the same
-            # parser/batcher the trainer uses
-            with tempfile.TemporaryDirectory() as td:
-                p = os.path.join(td, "req.txt")
-                with open(p, "wb") as f:
-                    f.write(body)
-                ds = PadBoxSlotDataset(conf, read_threads=1)
-                ds.set_filelist([p])
-                ds.load_into_memory()
-                scores = [
-                    float(s)
-                    for out in predictor.predict_dataset(ds)
-                    for s in out
-                ]
-                ds.close()
-            payload = json.dumps({"scores": scores}).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-
-        def log_message(self, *a):
-            pass
-
-    return Handler
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, default=None,
@@ -112,35 +74,33 @@ def main():
     args = ap.parse_args()
 
     from paddlebox_tpu.data.synth import write_synth_files
-    from paddlebox_tpu.inference import Predictor
+    from paddlebox_tpu.inference import ScoringServer
 
     work = tempfile.mkdtemp(prefix="pbox_serve_")
     art, conf = build_artifact(work)
-    predictor = Predictor.load(art)
-    server = HTTPServer(("127.0.0.1", args.port or 0), make_handler(predictor, conf))
-    host, port = server.server_address
-    print(f"serving on http://{host}:{port}/score")
+    server = ScoringServer()
+    server.register("ctr", art, conf)
+    port = server.start(port=args.port or 0)
+    print(f"serving on http://127.0.0.1:{port}/score "
+          f"(also /score/ctr, /healthz, /models)")
 
     if args.port is None:
         # demo mode: fire one request against ourselves, print, exit
-        import threading
         import urllib.request
 
-        t = threading.Thread(target=server.handle_request, daemon=True)
-        t.start()
         demo_files = write_synth_files(
             os.path.join(work, "demo"), n_files=1, ins_per_file=8,
             n_sparse_slots=4, vocab_per_slot=1000, dense_dim=4, seed=9,
         )
         with open(demo_files[0], "rb") as f:
             req = urllib.request.Request(
-                f"http://{host}:{port}/score", data=f.read(), method="POST"
+                f"http://127.0.0.1:{port}/score", data=f.read(), method="POST"
             )
         with urllib.request.urlopen(req, timeout=30) as resp:
             print("scores:", json.load(resp)["scores"])
-        t.join(timeout=30)
+        server.stop()
     else:
-        server.serve_forever()
+        server.wait()  # foreground until killed
 
 
 if __name__ == "__main__":

@@ -1,4 +1,5 @@
 from paddlebox_tpu.inference.export import export_model
 from paddlebox_tpu.inference.predictor import Predictor
+from paddlebox_tpu.inference.server import ScoringServer
 
-__all__ = ["export_model", "Predictor"]
+__all__ = ["export_model", "Predictor", "ScoringServer"]

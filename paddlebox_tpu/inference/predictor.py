@@ -47,6 +47,11 @@ class Predictor:
         self._programs: dict = {}  # filename -> deserialized exported
 
     @property
+    def n_features(self) -> int:
+        """Features in the loaded sparse snapshot."""
+        return int(self._keys.shape[0])
+
+    @property
     def bucket_shapes(self) -> list:
         """[(batch_size, key_capacity), ...] of the exported ladder."""
         return [(b, k) for b, k, _ in self._buckets]

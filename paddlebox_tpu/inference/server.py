@@ -1,0 +1,184 @@
+"""HTTP scoring server over export_model artifacts.
+
+The packaged serving surface (the reference ships an AnalysisPredictor
+C++ stack plus HTTP-ish demo servers and C/Go/R clients,
+/root/reference/paddle/fluid/inference/): a threaded HTTP server that
+loads one or more artifacts and scores canonical slot-text lines through
+the SAME parser/feed the trainer uses, so a request line is scored exactly
+as training would have seen it.
+
+Endpoints:
+  POST /score               — body = slot-text lines; scores the default
+                              (first-registered) model
+  POST /score/<name>        — scores a named model
+  GET  /healthz             — liveness + per-model metadata
+  GET  /models              — registered model names + meta
+
+A serving host needs JAX (any StableHLO runtime) but none of this
+framework's training machinery beyond the feed parser; clients need only
+HTTP (see examples/serve_client.cpp for a ~100-line C++ one).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from paddlebox_tpu.config import DataFeedConfig
+from paddlebox_tpu.inference.predictor import Predictor
+
+
+class ModelEntry:
+    def __init__(self, name: str, predictor: Predictor,
+                 feed_conf: DataFeedConfig):
+        self.name = name
+        self.predictor = predictor
+        self.feed_conf = feed_conf
+        # one parser per model, reused across requests (thread-safe: the
+        # lock below serializes scoring; parsing itself is stateless)
+        from paddlebox_tpu.data.slot_parser import SlotParser
+
+        self.parser = SlotParser(feed_conf)
+        self.requests = 0
+        self.instances = 0
+
+
+class ScoringServer:
+    """Threaded HTTP server over one or more (Predictor, DataFeedConfig)
+    pairs.  start() binds and serves on a background thread; scoring is
+    serialized by a lock (one backend, one compiled program per shape
+    bucket — concurrent device dispatch buys nothing single-chip)."""
+
+    def __init__(self) -> None:
+        self._models: dict[str, ModelEntry] = {}
+        self._default: Optional[str] = None
+        self._lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registry ---------------------------------------------------------- #
+    def register(self, name: str, artifact_dir: str,
+                 feed_conf: DataFeedConfig) -> None:
+        """Load an artifact under ``name`` (first registered = default)."""
+        entry = ModelEntry(name, Predictor.load(artifact_dir), feed_conf)
+        with self._lock:
+            self._models[name] = entry
+            if self._default is None:
+                self._default = name
+
+    def model_names(self) -> list:
+        with self._lock:
+            return list(self._models)
+
+    # -- scoring ------------------------------------------------------------ #
+    def score_lines(self, text: bytes, name: Optional[str] = None) -> list:
+        """Scores for every instance in canonical slot-text ``text``."""
+        with self._lock:
+            entry = self._models[name or self._default]
+        from paddlebox_tpu.data.feed import BatchBuilder
+
+        lines = [ln for ln in text.decode().splitlines() if ln.strip()]
+        block = entry.parser.parse_lines(lines)
+        builder = BatchBuilder(entry.feed_conf)
+        scores: list = []
+        B = entry.feed_conf.batch_size
+        import numpy as np
+
+        with self._lock:
+            for lo in range(0, block.n_ins, B):
+                ids = np.arange(lo, min(lo + B, block.n_ins))
+                batch = builder.build(block, ids)
+                scores.extend(
+                    float(s) for s in entry.predictor.predict(batch)
+                )
+            entry.requests += 1
+            entry.instances += len(scores)
+        return scores
+
+    # -- http -------------------------------------------------------------- #
+    def _handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    with server._lock:
+                        models = {
+                            n: {"requests": e.requests,
+                                "instances": e.instances,
+                                "buckets": e.predictor.bucket_shapes,
+                                "n_features": e.predictor.n_features}
+                            for n, e in server._models.items()
+                        }
+                    self._send(200, {"ok": True, "models": models})
+                elif self.path == "/models":
+                    self._send(200, {"models": server.model_names(),
+                                     "default": server._default})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                # strict routing: exactly /score or /score/<name>
+                if self.path == "/score":
+                    name = None
+                elif self.path.startswith("/score/"):
+                    name = self.path[len("/score/"):]
+                    if not name or "/" in name or "?" in name:
+                        self._send(404, {"error": "not found"})
+                        return
+                else:
+                    self._send(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = self.rfile.read(n)
+                    scores = server.score_lines(body, name)
+                    self._send(200, {"scores": scores})
+                except KeyError:
+                    self._send(404, {"error": f"unknown model {name!r}"})
+                except Exception as e:  # bad input must not kill the server
+                    self._send(400, {"error": repr(e)[:300]})
+
+            def log_message(self, *a):  # quiet by default
+                pass
+
+        return Handler
+
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Bind + serve on a background thread; returns the bound port."""
+        if self._httpd is not None:
+            raise RuntimeError("server already started")
+        if not self._models:
+            raise RuntimeError("register at least one model first")
+        self._httpd = ThreadingHTTPServer((host, port), self._handler())
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="scoring-server",
+            daemon=True,
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def wait(self) -> None:
+        """Block the calling thread until stop() (foreground serving)."""
+        t = self._thread
+        if t is not None:
+            t.join()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None

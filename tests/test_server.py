@@ -1,0 +1,119 @@
+"""Packaged HTTP scoring server (inference/server.py): multi-artifact
+routing, health/metadata endpoints, training-exact scoring through the
+same parser/feed as the trainer."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.inference import ScoringServer, export_model
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train.trainer import Trainer
+
+S, DENSE, B = 3, 2, 16
+
+
+def _train_and_export(tmp_path, tag, seed):
+    conf = make_synth_config(n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+                             max_feasigns_per_ins=8)
+    files = write_synth_files(str(tmp_path / f"d{tag}"), n_files=1,
+                              ins_per_file=64, n_sparse_slots=S,
+                              vocab_per_slot=40, dense_dim=DENSE, seed=seed)
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    tconf = SparseTableConfig(embedding_dim=4)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(8,))
+    table = SparseTable(tconf, seed=seed)
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10),
+                      seed=seed)
+    table.begin_pass(ds.unique_keys())
+    trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    ds.close()
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    art = str(tmp_path / f"art{tag}")
+    export_model(model, trainer.params, table, art,
+                 batch_size=B, key_capacity=kcap, dense_dim=DENSE)
+    return conf, art
+
+
+def _lines(n, seed=5):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        parts = ["1 0"]
+        for s in range(S):
+            ks = rng.integers(0, 40, 2)
+            parts.append(f"{len(ks)} " + " ".join(map(str, ks)))
+        parts.append(f"{DENSE} " + " ".join(
+            f"{v:.3f}" for v in rng.random(DENSE)))
+        out.append(" ".join(parts))
+    return ("\n".join(out) + "\n").encode()
+
+
+@pytest.fixture
+def server(tmp_path):
+    conf_a, art_a = _train_and_export(tmp_path, "a", seed=1)
+    conf_b, art_b = _train_and_export(tmp_path, "b", seed=2)
+    srv = ScoringServer()
+    srv.register("a", art_a, conf_a)
+    srv.register("b", art_b, conf_b)
+    port = srv.start(port=0)
+    yield srv, port
+    srv.stop()
+
+
+def _post(port, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_score_default_and_named(server):
+    srv, port = server
+    body = _lines(23)  # more than one batch -> bucket padding path too
+    st, out = _post(port, "/score", body)
+    assert st == 200 and len(out["scores"]) == 23
+    assert all(0.0 < s < 1.0 for s in out["scores"])
+    st, out_a = _post(port, "/score/a", body)
+    assert out_a["scores"] == out["scores"]  # default == first registered
+    st, out_b = _post(port, "/score/b", body)
+    assert out_b["scores"] != out["scores"]  # different model, diff scores
+
+
+def test_health_models_and_errors(server):
+    srv, port = server
+    _post(port, "/score", _lines(3))
+    st, h = _get(port, "/healthz")
+    assert st == 200 and h["ok"]
+    assert h["models"]["a"]["requests"] == 1
+    assert h["models"]["a"]["instances"] == 3
+    assert h["models"]["a"]["n_features"] > 0
+    st, m = _get(port, "/models")
+    assert set(m["models"]) == {"a", "b"} and m["default"] == "a"
+
+    # unknown model -> 404; garbage body -> 400, server stays up
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, "/score/nope", _lines(1))
+    assert ei.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(port, "/score", b"not a slot line\n")
+    assert ei.value.code == 400
+    st, out = _post(port, "/score", _lines(2))
+    assert st == 200 and len(out["scores"]) == 2
