@@ -330,30 +330,33 @@ def _ablation_times(trainer, model, tconf, params, opt_state, values, g2sum,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    def with_push(params, opt_state, values, g2sum, batch):
-        # mirrors Trainer._build_step: pull outside the grad, rows as a
-        # differentiated argument, ONE backward for both cotangents
-        rows = pull_rows(values, batch["idx"],
-                         create_threshold=tconf.create_threshold,
-                         cvm_offset=tconf.cvm_offset,
-                         pull_embedx_scale=tconf.pull_embedx_scale)
+    def make_with_push(unique_indices):
+      def with_push(params, opt_state, values, g2sum, batch):
+          # mirrors Trainer._build_step: pull outside the grad, rows as a
+          # differentiated argument, ONE backward for both cotangents
+          rows = pull_rows(values, batch["idx"],
+                           create_threshold=tconf.create_threshold,
+                           cvm_offset=tconf.cvm_offset,
+                           pull_embedx_scale=tconf.pull_embedx_scale)
 
-        def loss_fn(p, r):
-            logits = model.apply(p, r, batch["key_segments"],
-                                 batch["dense"], bsz)
-            per_ins = bce_with_logits(logits, batch["labels"]) \
-                * batch["ins_mask"]
-            return per_ins.sum() / jnp.maximum(batch["ins_mask"].sum(), 1.0)
+          def loss_fn(p, r):
+              logits = model.apply(p, r, batch["key_segments"],
+                                   batch["dense"], bsz)
+              per_ins = bce_with_logits(logits, batch["labels"]) \
+                  * batch["ins_mask"]
+              return per_ins.sum() / jnp.maximum(batch["ins_mask"].sum(), 1.0)
 
-        loss, (pg, row_grads) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1))(params, rows)
-        updates, opt_state = optimizer.update(pg, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        v2, g2 = push_and_update(
-            values, g2sum, row_grads, batch["idx"], batch["uniq_idx"],
-            batch["inverse"], batch["key_mask"], batch["key_clicks"], tconf,
-        )
-        return params, opt_state, v2, g2, loss
+          loss, (pg, row_grads) = jax.value_and_grad(
+              loss_fn, argnums=(0, 1))(params, rows)
+          updates, opt_state = optimizer.update(pg, opt_state, params)
+          params = optax.apply_updates(params, updates)
+          v2, g2 = push_and_update(
+              values, g2sum, row_grads, batch["idx"], batch["uniq_idx"],
+              batch["inverse"], batch["key_mask"], batch["key_clicks"], tconf,
+              unique_indices=unique_indices,
+          )
+          return params, opt_state, v2, g2, loss
+      return with_push
 
     out = {}
     # donate like the real step does (its scatter updates the table
@@ -362,9 +365,15 @@ def _ablation_times(trainer, model, tconf, params, opt_state, values, g2sum,
     # SNAPSHOT copies, so a mid-stage device error (async — it surfaces at
     # block_until_ready, after rebinding) can only poison the copies: the
     # caller always gets back the pristine pre-ablation state.
+    # plus_push_dup is the SAME push without the unique_indices claim —
+    # the A/B that quantifies the duplicate-safe scatter lowering's cost
+    # on real hardware (the r4 step-regression hypothesis)
     for name, fn, donate in [("fwd", fwd_only, ()),
                              ("fwd_bwd_dense", with_bwd, (0, 1)),
-                             ("plus_push", with_push, (0, 1, 2, 3))]:
+                             ("plus_push", make_with_push(True),
+                              (0, 1, 2, 3)),
+                             ("plus_push_dup", make_with_push(False),
+                              (0, 1, 2, 3))]:
         jf = jax.jit(fn, donate_argnums=donate)
         # snapshot ONLY the donated leaves (copying the whole table for the
         # dense-only stage would transiently double table memory)
@@ -374,10 +383,12 @@ def _ablation_times(trainer, model, tconf, params, opt_state, values, g2sum,
                 if 2 in donate else (values, g2sum))
         try:
             def rebind(res):
+                # rebind whatever this stage donated so the next loop
+                # iteration never re-passes a consumed buffer
                 nonlocal p, o, v, g
-                if name == "fwd_bwd_dense":
+                if donate == (0, 1):
                     p, o = res[0], res[1]
-                elif name == "plus_push":
+                elif donate == (0, 1, 2, 3):
                     p, o, v, g = res[0], res[1], res[2], res[3]
                 return res
 

@@ -54,7 +54,8 @@ class ScoringServer:
     def __init__(self) -> None:
         self._models: dict[str, ModelEntry] = {}
         self._default: Optional[str] = None
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # serializes scoring (device work)
+        self._meta_lock = threading.Lock()  # registry/stats reads+writes
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -63,19 +64,25 @@ class ScoringServer:
                  feed_conf: DataFeedConfig) -> None:
         """Load an artifact under ``name`` (first registered = default)."""
         entry = ModelEntry(name, Predictor.load(artifact_dir), feed_conf)
-        with self._lock:
+        if entry.predictor.meta.get("n_tasks", 1) > 1:
+            raise ValueError(
+                "multi-task artifacts are not servable over the slot-text "
+                "endpoint yet (predict returns [b, n_tasks]); score them "
+                "via Predictor.predict directly"
+            )
+        with self._meta_lock:
             self._models[name] = entry
             if self._default is None:
                 self._default = name
 
     def model_names(self) -> list:
-        with self._lock:
+        with self._meta_lock:
             return list(self._models)
 
     # -- scoring ------------------------------------------------------------ #
     def score_lines(self, text: bytes, name: Optional[str] = None) -> list:
         """Scores for every instance in canonical slot-text ``text``."""
-        with self._lock:
+        with self._meta_lock:
             entry = self._models[name or self._default]
         from paddlebox_tpu.data.feed import BatchBuilder
 
@@ -86,13 +93,14 @@ class ScoringServer:
         B = entry.feed_conf.batch_size
         import numpy as np
 
-        with self._lock:
+        with self._lock:  # scoring only: /healthz never waits on this
             for lo in range(0, block.n_ins, B):
                 ids = np.arange(lo, min(lo + B, block.n_ins))
                 batch = builder.build(block, ids)
                 scores.extend(
                     float(s) for s in entry.predictor.predict(batch)
                 )
+        with self._meta_lock:
             entry.requests += 1
             entry.instances += len(scores)
         return scores
@@ -112,7 +120,7 @@ class ScoringServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    with server._lock:
+                    with server._meta_lock:
                         models = {
                             n: {"requests": e.requests,
                                 "instances": e.instances,

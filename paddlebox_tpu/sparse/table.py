@@ -428,6 +428,7 @@ def push_and_update(
     conf: SparseTableConfig,
     key_extras: Optional[jax.Array] = None,
     uniq_lr: Optional[jax.Array] = None,
+    unique_indices: bool = True,
 ):
     """Merge per-occurrence gradients by unique key and apply the sparse
     optimizer + show/clk counter update (reference: PushSparseGradCase,
@@ -479,10 +480,10 @@ def push_and_update(
     dead = values.shape[0] - 1
     ok = (plan_uniq_idx != dead).astype(delta.dtype)
     values = scatter_add_rows(
-        values, plan_uniq_idx, delta * ok[:, None], unique=True
+        values, plan_uniq_idx, delta * ok[:, None], unique=unique_indices
     )
     g2sum = g2sum.at[plan_uniq_idx].add(
-        g2_delta * ok, unique_indices=True
+        g2_delta * ok, unique_indices=unique_indices
     )
     # the dead row must stay zero (pulls read it as the zero row)
     values = values.at[dead].set(0.0)
