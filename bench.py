@@ -331,32 +331,32 @@ def _ablation_times(trainer, model, tconf, params, opt_state, values, g2sum,
         return params, opt_state, loss
 
     def make_with_push(unique_indices):
-      def with_push(params, opt_state, values, g2sum, batch):
-          # mirrors Trainer._build_step: pull outside the grad, rows as a
-          # differentiated argument, ONE backward for both cotangents
-          rows = pull_rows(values, batch["idx"],
-                           create_threshold=tconf.create_threshold,
-                           cvm_offset=tconf.cvm_offset,
-                           pull_embedx_scale=tconf.pull_embedx_scale)
+        def with_push(params, opt_state, values, g2sum, batch):
+            # mirrors Trainer._build_step: pull outside the grad, rows as a
+            # differentiated argument, ONE backward for both cotangents
+            rows = pull_rows(values, batch["idx"],
+                             create_threshold=tconf.create_threshold,
+                             cvm_offset=tconf.cvm_offset,
+                             pull_embedx_scale=tconf.pull_embedx_scale)
 
-          def loss_fn(p, r):
-              logits = model.apply(p, r, batch["key_segments"],
-                                   batch["dense"], bsz)
-              per_ins = bce_with_logits(logits, batch["labels"]) \
-                  * batch["ins_mask"]
-              return per_ins.sum() / jnp.maximum(batch["ins_mask"].sum(), 1.0)
+            def loss_fn(p, r):
+                logits = model.apply(p, r, batch["key_segments"],
+                                     batch["dense"], bsz)
+                per_ins = bce_with_logits(logits, batch["labels"]) \
+                    * batch["ins_mask"]
+                return per_ins.sum() / jnp.maximum(batch["ins_mask"].sum(), 1.0)
 
-          loss, (pg, row_grads) = jax.value_and_grad(
-              loss_fn, argnums=(0, 1))(params, rows)
-          updates, opt_state = optimizer.update(pg, opt_state, params)
-          params = optax.apply_updates(params, updates)
-          v2, g2 = push_and_update(
-              values, g2sum, row_grads, batch["idx"], batch["uniq_idx"],
-              batch["inverse"], batch["key_mask"], batch["key_clicks"], tconf,
-              unique_indices=unique_indices,
-          )
-          return params, opt_state, v2, g2, loss
-      return with_push
+            loss, (pg, row_grads) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(params, rows)
+            updates, opt_state = optimizer.update(pg, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            v2, g2 = push_and_update(
+                values, g2sum, row_grads, batch["idx"], batch["uniq_idx"],
+                batch["inverse"], batch["key_mask"], batch["key_clicks"], tconf,
+                unique_indices=unique_indices,
+            )
+            return params, opt_state, v2, g2, loss
+        return with_push
 
     out = {}
     # donate like the real step does (its scatter updates the table
@@ -367,13 +367,21 @@ def _ablation_times(trainer, model, tconf, params, opt_state, values, g2sum,
     # caller always gets back the pristine pre-ablation state.
     # plus_push_dup is the SAME push without the unique_indices claim —
     # the A/B that quantifies the duplicate-safe scatter lowering's cost
-    # on real hardware (the r4 step-regression hypothesis)
-    for name, fn, donate in [("fwd", fwd_only, ()),
-                             ("fwd_bwd_dense", with_bwd, (0, 1)),
-                             ("plus_push", make_with_push(True),
-                              (0, 1, 2, 3)),
-                             ("plus_push_dup", make_with_push(False),
-                              (0, 1, 2, 3))]:
+    # on real hardware (the r4 step-regression hypothesis).  Meaningless
+    # under the Pallas scatter (duplicate-safe by construction, ignores
+    # the claim): skip it there rather than report a vacuous ~0 delta.
+    from paddlebox_tpu.config import flags as _flags
+
+    stages = [("fwd", fwd_only, ()),
+              ("fwd_bwd_dense", with_bwd, (0, 1)),
+              ("plus_push", make_with_push(True), (0, 1, 2, 3))]
+    if _flags.use_pallas_sparse:
+        log("ablation plus_push_dup skipped: the Pallas scatter is "
+            "duplicate-safe by construction (unique claim has no effect)")
+    else:
+        stages.append(("plus_push_dup", make_with_push(False),
+                       (0, 1, 2, 3)))
+    for name, fn, donate in stages:
         jf = jax.jit(fn, donate_argnums=donate)
         # snapshot ONLY the donated leaves (copying the whole table for the
         # dense-only stage would transiently double table memory)

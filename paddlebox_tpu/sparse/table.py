@@ -444,6 +444,11 @@ def push_and_update(
     uniq_lr: optional [U] per-unique-key learning rates (the BoxPS LR-map
         analog: the Trainer resolves each key's slot-group lr host-side,
         reference box_wrapper.h:631 GetLRMap).  None = conf.learning_rate.
+    unique_indices: claim the plan's scatter targets are distinct (True —
+        the plan_keys scratch-row construction guarantees it) and let XLA
+        use the parallel scatter lowering.  False forces the
+        duplicate-safe lowering: numerics are identical either way; the
+        flag exists so bench.py can A/B the lowering cost on hardware.
     Returns (values, g2sum) updated.
     """
     del plan_idx  # pull-side only; kept in the signature for symmetry

@@ -19,17 +19,20 @@ from paddlebox_tpu.train.trainer import Trainer
 S, DENSE, B = 3, 2, 16
 
 
-def _train_and_export(tmp_path, tag, seed):
+def _train_and_export(tmp_path, tag, seed, model_fn=None, conf_kw=None,
+                      synth_kw=None):
     conf = make_synth_config(n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
-                             max_feasigns_per_ins=8)
+                             max_feasigns_per_ins=8, **(conf_kw or {}))
     files = write_synth_files(str(tmp_path / f"d{tag}"), n_files=1,
                               ins_per_file=64, n_sparse_slots=S,
-                              vocab_per_slot=40, dense_dim=DENSE, seed=seed)
+                              vocab_per_slot=40, dense_dim=DENSE, seed=seed,
+                              **(synth_kw or {}))
     ds = PadBoxSlotDataset(conf, read_threads=1)
     ds.set_filelist(files)
     ds.load_into_memory()
     tconf = SparseTableConfig(embedding_dim=4)
-    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(8,))
+    model = (model_fn or (lambda tc: CtrDnn(
+        S, tc.row_width, dense_dim=DENSE, hidden=(8,))))(tconf)
     table = SparseTable(tconf, seed=seed)
     trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10),
                       seed=seed)
@@ -117,3 +120,46 @@ def test_health_models_and_errors(server):
     assert ei.value.code == 400
     st, out = _post(port, "/score", _lines(2))
     assert st == 200 and len(out["scores"]) == 2
+
+
+def test_longseq_artifact_serves(tmp_path):
+    """A behavior-sequence model (uses_seq_pos) exports and serves over the
+    packaged server: the feed builds seq_pos from the configured
+    sequence_slot and the predictor re-buckets it."""
+    from paddlebox_tpu.models import LongSeqCtrDnn
+
+    T = 8
+    conf, art = _train_and_export(
+        tmp_path, "seq", seed=3,
+        model_fn=lambda tc: LongSeqCtrDnn(
+            S, tc.row_width, dense_dim=DENSE, hidden=(8,), max_seq_len=T,
+            n_heads=2, head_dim=4),
+        conf_kw={"sequence_slot": "slot0", "max_seq_len": T},
+    )
+
+    srv = ScoringServer()
+    srv.register("seq", art, conf)
+    port = srv.start()
+    try:
+        st, out = _post(port, "/score", _lines(5))
+        assert st == 200 and len(out["scores"]) == 5
+        assert all(0.0 < s < 1.0 for s in out["scores"])
+    finally:
+        srv.stop()
+
+
+def test_multitask_artifact_rejected(tmp_path):
+    """register() must refuse multi-task artifacts with a clear message
+    (predict returns [b, n_tasks], unservable over the slot-text route)."""
+    from paddlebox_tpu.models import MMoE
+
+    conf, art = _train_and_export(
+        tmp_path, "mt", seed=4,
+        model_fn=lambda tc: MMoE(
+            S, tc.row_width, dense_dim=DENSE, n_tasks=2, n_experts=2,
+            expert_hidden=(8,), expert_dim=4, tower_hidden=(4,)),
+        conf_kw={"n_task_labels": 1}, synth_kw={"n_task_labels": 1},
+    )
+    srv = ScoringServer()
+    with pytest.raises(ValueError, match="multi-task"):
+        srv.register("mt", art, conf)
