@@ -920,8 +920,6 @@ def run_all(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
     init): the post-recovery capture plan.  Stages are isolated — a stage
     failure logs and moves on so one bad path can't cost the whole run
     (except a SIGKILL; the headline's partial emit covers its worst case)."""
-    import dataclasses
-
     from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
 
     def stage(name, fn, *a, **kw):
