@@ -62,6 +62,7 @@ def build_artifact(work: str) -> tuple[str, "object"]:
     export_model(
         model, trainer.params, table, art,
         batch_size=B, key_capacity=kcap, dense_dim=DENSE,
+        feed_conf=conf,  # self-contained artifact: serving needs no config
     )
     ds.close()
     return art, conf
@@ -77,9 +78,9 @@ def main():
     from paddlebox_tpu.inference import ScoringServer
 
     work = tempfile.mkdtemp(prefix="pbox_serve_")
-    art, conf = build_artifact(work)
+    art, _conf = build_artifact(work)  # feed schema rides IN the artifact
     server = ScoringServer()
-    server.register("ctr", art, conf)
+    server.register("ctr", art)  # feed schema comes from the artifact
     port = server.start(port=args.port or 0)
     print(f"serving on http://127.0.0.1:{port}/score "
           f"(also /score/ctr, /healthz, /models)")

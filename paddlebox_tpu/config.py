@@ -145,6 +145,48 @@ class DataFeedConfig:
     def max_rank(self) -> int:
         return (self.rank_offset_cols - 1) // 2
 
+    def to_dict(self) -> dict:
+        """JSON-ready form (the artifact's feed.json): version-stamped,
+        tuples as lists.  from_dict is the exact inverse."""
+        d = dataclasses.asdict(self)
+        d["slots"] = [
+            {**sd, "shape": list(sd["shape"])} for sd in d["slots"]
+        ]
+        for k, v in list(d.items()):
+            if isinstance(v, tuple):
+                d[k] = list(v)
+        d["feed_format_version"] = 1
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "DataFeedConfig":
+        """Inverse of to_dict.  Unknown keys (a NEWER exporter's fields)
+        are dropped with a warning instead of crashing an older serving
+        host; tuple-typed fields are restored by inspecting the dataclass
+        defaults rather than a hand-maintained name list."""
+        import warnings
+
+        d = dict(d)
+        d.pop("feed_format_version", None)
+        known = {f.name: f for f in dataclasses.fields(DataFeedConfig)}
+        unknown = [k for k in d if k not in known]
+        for k in unknown:
+            warnings.warn(
+                f"feed.json key {k!r} unknown to this version — ignored",
+                RuntimeWarning, stacklevel=2,
+            )
+            d.pop(k)
+        d["slots"] = [
+            SlotConfig(**{**sd, "shape": tuple(sd["shape"])})
+            for sd in d.get("slots", [])
+        ]
+        for name, f in known.items():
+            if name == "slots" or name not in d:
+                continue
+            if isinstance(f.default, tuple) and isinstance(d[name], list):
+                d[name] = tuple(d[name])
+        return DataFeedConfig(**d)
+
     def used_slots(self) -> list[SlotConfig]:
         return [s for s in self.slots if s.is_used]
 

@@ -47,6 +47,7 @@ def export_model(
     quantize: bool = False,
     rank_offset_cols: int = 0,
     batch_buckets=None,
+    feed_conf=None,
 ) -> None:
     """Write a serving artifact for ``model`` + ``table`` to ``out_dir``.
 
@@ -69,6 +70,11 @@ def export_model(
     standard TPU recipe instead: export a ladder of shape buckets and let
     the Predictor pad each request up to the smallest bucket that fits
     (VERDICT r3 missing #5).
+    feed_conf: the training DataFeedConfig — serialized into the artifact
+    (feed.json) so a serving host can parse request lines from the
+    artifact ALONE (ScoringServer.register without a Python-side config),
+    the way the reference's __model__ dir carries its feed schema
+    (save_inference_model, python/paddle/fluid/io.py).
     """
     uses_rank = getattr(model, "uses_rank_offset", False)
     uses_seq = getattr(model, "uses_seq_pos", False)
@@ -191,3 +197,17 @@ def export_model(
     }
     with open(os.path.join(out_dir, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
+
+    if feed_conf is not None:
+        # fail fast on an inherently un-servable artifact: the server
+        # chunks requests by feed_conf.batch_size, so SOME bucket must fit
+        # a full chunk (Predictor._pick_bucket would otherwise reject
+        # every full-size request)
+        if not any(feed_conf.batch_size <= bb for bb, _ in buckets):
+            raise ValueError(
+                f"feed_conf.batch_size={feed_conf.batch_size} fits no "
+                f"exported bucket (batch sizes {[b for b, _ in buckets]}): "
+                "add a bucket via batch_buckets or lower the feed batch"
+            )
+        with open(os.path.join(out_dir, "feed.json"), "w") as f:
+            json.dump(feed_conf.to_dict(), f, indent=1)

@@ -61,8 +61,24 @@ class ScoringServer:
 
     # -- registry ---------------------------------------------------------- #
     def register(self, name: str, artifact_dir: str,
-                 feed_conf: DataFeedConfig) -> None:
-        """Load an artifact under ``name`` (first registered = default)."""
+                 feed_conf: Optional[DataFeedConfig] = None) -> None:
+        """Load an artifact under ``name`` (first registered = default).
+
+        feed_conf: None reads the artifact's own feed.json (written by
+        export_model(feed_conf=...)) — a self-contained artifact needs no
+        Python-side config at all."""
+        if feed_conf is None:
+            import os
+
+            path = os.path.join(artifact_dir, "feed.json")
+            if not os.path.exists(path):
+                raise ValueError(
+                    f"artifact {artifact_dir} carries no feed.json: either "
+                    "re-export with export_model(feed_conf=...) or pass "
+                    "feed_conf to register()"
+                )
+            with open(path) as f:
+                feed_conf = DataFeedConfig.from_dict(json.load(f))
         entry = ModelEntry(name, Predictor.load(artifact_dir), feed_conf)
         if entry.predictor.meta.get("n_tasks", 1) > 1:
             raise ValueError(

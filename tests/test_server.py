@@ -163,3 +163,66 @@ def test_multitask_artifact_rejected(tmp_path):
     srv = ScoringServer()
     with pytest.raises(ValueError, match="multi-task"):
         srv.register("mt", art, conf)
+
+
+def test_self_contained_artifact(tmp_path):
+    """export_model(feed_conf=...) embeds the feed schema; register() with
+    no config reconstructs it from the artifact alone — a serving host
+    needs nothing but the artifact directory."""
+    import dataclasses
+
+    conf = make_synth_config(n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+                             max_feasigns_per_ins=8)
+    files = write_synth_files(str(tmp_path / "d"), n_files=1, ins_per_file=64,
+                              n_sparse_slots=S, vocab_per_slot=40,
+                              dense_dim=DENSE, seed=1)
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    tconf = SparseTableConfig(embedding_dim=4)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(8,))
+    table = SparseTable(tconf, seed=1)
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10), seed=1)
+    table.begin_pass(ds.unique_keys())
+    trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    ds.close()
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    art = str(tmp_path / "art")
+    export_model(model, trainer.params, table, art,
+                 batch_size=B, key_capacity=kcap, dense_dim=DENSE,
+                 feed_conf=conf)
+
+    srv = ScoringServer()
+    srv.register("auto", art)  # NO feed_conf
+    port = srv.start()
+    try:
+        st, out = _post(port, "/score", _lines(4))
+        assert st == 200 and len(out["scores"]) == 4
+    finally:
+        srv.stop()
+
+    # the reconstructed config round-trips the original
+    from paddlebox_tpu.config import DataFeedConfig
+    import json as _json
+
+    with open(f"{art}/feed.json") as f:
+        raw = _json.load(f)
+    rt = DataFeedConfig.from_dict(raw)
+    assert dataclasses.asdict(rt) == dataclasses.asdict(conf)
+    # a NEWER exporter's unknown key is dropped with a warning, not a crash
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rt2 = DataFeedConfig.from_dict({**raw, "future_field": 7})
+    assert dataclasses.asdict(rt2) == dataclasses.asdict(conf)
+    assert any("future_field" in str(x.message) for x in w)
+
+    # artifact without feed.json -> clear error
+    import os
+
+    os.remove(f"{art}/feed.json")
+    srv2 = ScoringServer()
+    with pytest.raises(ValueError, match="feed.json"):
+        srv2.register("x", art)
