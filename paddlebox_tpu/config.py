@@ -167,7 +167,16 @@ class DataFeedConfig:
         import warnings
 
         d = dict(d)
-        d.pop("feed_format_version", None)
+        ver = d.pop("feed_format_version", 1)
+        if ver > 1:
+            # a same-named field may have CHANGED meaning in a newer
+            # format: unknown-key dropping can't catch that, so be loud
+            warnings.warn(
+                f"feed.json format version {ver} is newer than this "
+                "serving host understands (1): existing fields may have "
+                "changed semantics — upgrade before trusting scores",
+                RuntimeWarning, stacklevel=2,
+            )
         known = {f.name: f for f in dataclasses.fields(DataFeedConfig)}
         unknown = [k for k in d if k not in known]
         for k in unknown:
@@ -176,10 +185,18 @@ class DataFeedConfig:
                 RuntimeWarning, stacklevel=2,
             )
             d.pop(k)
-        d["slots"] = [
-            SlotConfig(**{**sd, "shape": tuple(sd["shape"])})
-            for sd in d.get("slots", [])
-        ]
+        slot_known = {f.name for f in dataclasses.fields(SlotConfig)}
+        slots = []
+        for sd in d.get("slots", []):
+            extra = [k for k in sd if k not in slot_known]
+            for k in extra:
+                warnings.warn(
+                    f"feed.json slot key {k!r} unknown — ignored",
+                    RuntimeWarning, stacklevel=2,
+                )
+            sd = {k: v for k, v in sd.items() if k in slot_known}
+            slots.append(SlotConfig(**{**sd, "shape": tuple(sd["shape"])}))
+        d["slots"] = slots
         for name, f in known.items():
             if name == "slots" or name not in d:
                 continue

@@ -129,6 +129,17 @@ def export_model(
     for bb, bk in batch_buckets or ():
         if (int(bb), int(bk)) not in buckets:
             buckets.append((int(bb), int(bk)))
+    if feed_conf is not None and not any(
+        feed_conf.batch_size <= bb for bb, _ in buckets
+    ):
+        # fail BEFORE the expensive lowering loop: the server chunks
+        # requests by feed_conf.batch_size, so some bucket must fit a full
+        # chunk or the artifact is inherently un-servable
+        raise ValueError(
+            f"feed_conf.batch_size={feed_conf.batch_size} fits no "
+            f"exported bucket (batch sizes {[b for b, _ in buckets]}): "
+            "add a bucket via batch_buckets or lower the feed batch"
+        )
     bucket_meta = []
     for B, K in buckets:
         # extras ride in a fixed order after the three core inputs:
@@ -199,15 +210,5 @@ def export_model(
         json.dump(meta, f, indent=1)
 
     if feed_conf is not None:
-        # fail fast on an inherently un-servable artifact: the server
-        # chunks requests by feed_conf.batch_size, so SOME bucket must fit
-        # a full chunk (Predictor._pick_bucket would otherwise reject
-        # every full-size request)
-        if not any(feed_conf.batch_size <= bb for bb, _ in buckets):
-            raise ValueError(
-                f"feed_conf.batch_size={feed_conf.batch_size} fits no "
-                f"exported bucket (batch sizes {[b for b, _ in buckets]}): "
-                "add a bucket via batch_buckets or lower the feed batch"
-            )
         with open(os.path.join(out_dir, "feed.json"), "w") as f:
             json.dump(feed_conf.to_dict(), f, indent=1)

@@ -1,0 +1,65 @@
+"""One-command scoring server over self-contained artifacts.
+
+    python -m paddlebox_tpu.serve --artifact /path/to/art [...more] \\
+        [--port 8080] [--host 0.0.0.0] [--cpu]
+
+Each --artifact may be DIR or NAME=DIR (NAME defaults to the directory
+basename; the first one registered is the default model).  Artifacts must
+carry their feed schema (export_model(feed_conf=...)); endpoints are
+POST /score[/NAME], GET /healthz, GET /models (inference/server.py).
+
+The reference's serving story is the C++ AnalysisPredictor stack plus
+demo servers (/root/reference/paddle/fluid/inference/); this is the
+whole of it as one module over the StableHLO artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddlebox_tpu.serve", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--artifact", action="append", required=True,
+                    metavar="[NAME=]DIR",
+                    help="artifact directory (repeatable); first = default")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend before any device init")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from paddlebox_tpu.inference import ScoringServer
+
+    server = ScoringServer()
+    for spec in args.artifact:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            name, path = os.path.basename(os.path.normpath(spec)), spec
+        if name in server.model_names():
+            ap.error(
+                f"model name {name!r} given twice (basenames collide?) — "
+                "disambiguate with NAME=DIR"
+            )
+        server.register(name, path)
+        print(f"registered {name!r} <- {path}")
+    port = server.start(port=args.port, host=args.host)
+    print(f"serving on http://{args.host}:{port}/score "
+          f"(models: {', '.join(server.model_names())})")
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -226,3 +226,63 @@ def test_self_contained_artifact(tmp_path):
     srv2 = ScoringServer()
     with pytest.raises(ValueError, match="feed.json"):
         srv2.register("x", art)
+
+
+def test_serve_cli_module(tmp_path):
+    """`python -m paddlebox_tpu.serve` registers artifacts (NAME=DIR and
+    bare-DIR forms) and serves; drive it in-process with start/stop via
+    the module's own pieces."""
+    import subprocess
+    import sys
+    import time
+
+    conf = make_synth_config(n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+                             max_feasigns_per_ins=8)
+    files = write_synth_files(str(tmp_path / "d"), n_files=1, ins_per_file=64,
+                              n_sparse_slots=S, vocab_per_slot=40,
+                              dense_dim=DENSE, seed=1)
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    tconf = SparseTableConfig(embedding_dim=4)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(8,))
+    table = SparseTable(tconf, seed=1)
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10), seed=1)
+    table.begin_pass(ds.unique_keys())
+    trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    ds.close()
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    art = str(tmp_path / "myart")
+    export_model(model, trainer.params, table, art,
+                 batch_size=B, key_capacity=kcap, dense_dim=DENSE,
+                 feed_conf=conf)
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddlebox_tpu.serve", "--artifact",
+         f"m={art}", "--artifact", art, "--port", "0", "--cpu"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd="/root/repo",
+    )
+    try:
+        port = None
+        t0 = time.time()
+        while time.time() - t0 < 120:
+            line = proc.stdout.readline()
+            if not line:  # pipe closed: the child died at startup
+                assert proc.poll() is None, (
+                    f"serve CLI exited rc={proc.returncode}"
+                )
+                time.sleep(0.2)
+                continue
+            if "serving on http://" in line:
+                port = int(line.split(":")[2].split("/")[0])
+                break
+        assert port, "server never came up"
+        st, out = _post(port, "/score/m", _lines(3))
+        assert st == 200 and len(out["scores"]) == 3
+        st, m = _get(port, "/models")
+        assert set(m["models"]) == {"m", "myart"} and m["default"] == "m"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
