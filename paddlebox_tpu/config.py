@@ -278,7 +278,8 @@ class SparseTableConfig:
     # per-slot learning-rate overrides: ((slot, lr), ...) — slots not listed
     # use `learning_rate`.  The BoxPS LR map analog (reference: GetLRMap/
     # SetLRMap, box_wrapper.h:631; per-param lr consumed by the PS update).
-    # Single-chip Trainer path; ShardedSparseTable rejects it for now.
+    # Works on both the single-chip Trainer and the sharded multi-chip path
+    # (plan_group resolves slot lrs requester-side; see sharded_table.py).
     slot_learning_rates: Sequence = ()
     initial_g2sum: float = 3.0
     initial_range: float = 0.02  # uniform init range for new features

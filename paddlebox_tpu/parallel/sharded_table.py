@@ -91,6 +91,12 @@ class ShardedBatchPlan:
     # structurally 0 since r4: the bucket grows to exact fit instead of
     # dropping keys (kept so callers' metrics plumbing keeps working)
     n_overflow: int = 0
+    # f32 [D, n*C] per-served-unique-row learning rates (aligned with
+    # serve_uniq), present only when the per-slot LR map is configured —
+    # the serve-side half of the BoxPS LR map (box_wrapper.h:631): each
+    # requester resolves its keys' slot lrs host-side and they ride the
+    # want-matrix allgather, so slot identity survives the serve merge
+    serve_lr: Optional[np.ndarray] = None
 
 
 class ShardedSparseTable(SparseTable):
@@ -105,13 +111,6 @@ class ShardedSparseTable(SparseTable):
         bucket_slack: float = 2.0,
     ):
         super().__init__(conf, seed)
-        if conf.slot_learning_rates:
-            raise NotImplementedError(
-                "slot_learning_rates is single-chip only for now: the "
-                "sharded push merges by served row and would need per-row "
-                "slot resolution on the serve side (use per-slot embedding "
-                "dims — model-side masks — which work on every path)"
-            )
         self.mesh = mesh
         # composed (data x inner) meshes shard the table over the DATA
         # axis only; the inner axis replicates it and splits dense work
@@ -295,6 +294,8 @@ class ShardedSparseTable(SparseTable):
         batches: Sequence[HostBatch],
         bucket_capacity: Optional[int] = None,
         gather=None,
+        slot_lr_vec: Optional[np.ndarray] = None,
+        n_slots: Optional[int] = None,
     ) -> ShardedBatchPlan:
         """Resolve one batch group (one batch per LOCAL device) into the
         stacked a2a plan.  All plan arrays carry this process's leading axis
@@ -315,10 +316,22 @@ class ShardedSparseTable(SparseTable):
         MultiChipTrainer's prefetch producer passes a host-plane KvChannel
         instead, because planning runs concurrently with the device step
         and must not enqueue device collectives (parallel/host_plane.py).
+
+        ``slot_lr_vec`` + ``n_slots``: the per-slot LR map ([S] float32 from
+        resolve_slot_lr_vec).  Each occurrence's slot lr is resolved here on
+        the requester, packed bitwise next to the row id in the want matrix
+        (so the existing allgather carries it — no extra collective), and
+        folded into a per-served-unique-row lr vector (plan.serve_lr) during
+        the serve dedup.  A key appearing in several slots takes the last
+        assignment, matching the single-chip _host_batch_dict caveat.
         """
         gather = gather or host_allgather
         if not self._in_pass:
             raise RuntimeError("begin_pass before planning batches")
+        if slot_lr_vec is not None and not n_slots:
+            raise ValueError("slot_lr_vec needs n_slots to resolve "
+                             "occurrence slots from key_segments")
+        default_lr = float(self.conf.learning_rate)
         L = self.n_local
         if len(batches) != L:
             raise ValueError(
@@ -379,6 +392,10 @@ class ShardedSparseTable(SparseTable):
             self.capacity_bumps += 1
 
         want = np.full((L, n, C), dead, dtype=np.int32)
+        want_lr = (
+            None if slot_lr_vec is None
+            else np.full((L, n, C), default_lr, dtype=np.float32)
+        )
         occ = np.full((L, K), n * C, dtype=np.int32)
         mask = np.zeros((L, K), dtype=np.float32)
         n_overflow = 0  # structurally zero now; kept for API compatibility
@@ -389,9 +406,33 @@ class ShardedSparseTable(SparseTable):
             want[d, owner, slot] = rows
             occ[d, :n_keys] = (owner * C + slot).astype(np.int32)[inv]
             mask[d, :n_keys] = 1.0
+            if want_lr is not None:
+                # occurrence slot -> lr, merged per unique key (last wins —
+                # keys never span slots in practice, same assumption as the
+                # single-chip feed and the reference's slot-keyed pull)
+                occ_lr = np.asarray(slot_lr_vec, np.float32)[
+                    np.asarray(batches[d].key_segments[:n_keys]) % n_slots
+                ]
+                klr = np.full(rows.shape[0], default_lr, np.float32)
+                klr[inv] = occ_lr
+                want_lr[d, owner, slot] = klr
         # every requester's matrix, in mesh order (processes own contiguous
-        # runs — asserted in __init__); single-process: want itself
-        want_all = gather(want).reshape(n, n, C)
+        # runs — asserted in __init__); single-process: want itself.  With an
+        # LR map the float lrs travel bit-packed beside the row ids so the
+        # multi-host path still pays exactly one want allgather.
+        if want_lr is None:
+            want_all = gather(want).reshape(n, n, C)
+            lr_serve = None
+        else:
+            packed = np.concatenate(
+                [want[..., None], want_lr.view(np.int32)[..., None]], axis=-1
+            )  # [L, n, C, 2] int32
+            packed_all = gather(packed).reshape(n, n, C, 2)
+            want_all = np.ascontiguousarray(packed_all[..., 0])
+            lr_all = np.ascontiguousarray(packed_all[..., 1]).view(np.float32)
+            lr_serve = np.ascontiguousarray(
+                lr_all[:, self._local_pos, :].transpose(1, 0, 2)
+            )  # [L, n, C] — aligned with serve_rows
         # the serve side: local shard o serves want_all[:, o, :]; dedup rows
         # so the push-side optimizer touches each row once (dead row shares
         # one segment — it is scrubbed after every push anyway)
@@ -415,6 +456,10 @@ class ShardedSparseTable(SparseTable):
             + np.arange(n * C, dtype=np.int32)[None, :],
             dead,
         )
+        serve_lr = (
+            None if lr_serve is None
+            else np.full((L, n * C), default_lr, np.float32)
+        )
         for o in range(L):
             out = None
             if ix is not None:  # same flag/availability as the request side
@@ -430,10 +475,17 @@ class ShardedSparseTable(SparseTable):
                 )
             serve_uniq[o, : uq.shape[0]] = uq
             serve_map[o] = inv.reshape(n, C).astype(np.int32)
+            if serve_lr is not None:
+                # fold per-request lrs onto the deduped rows: requesters of
+                # the same row carry the same key, hence the same slot lr
+                # (dead/pad rows may disagree — their deltas are zeroed in
+                # sharded_push_and_update, so any value is benign)
+                serve_lr[o][inv] = lr_serve[o].reshape(-1)
         self.missing_key_count += n_missing
         self.overflow_key_count += n_overflow
         return ShardedBatchPlan(
-            serve_rows, occ, serve_map, serve_uniq, mask, n_missing, n_overflow
+            serve_rows, occ, serve_map, serve_uniq, mask, n_missing,
+            n_overflow, serve_lr,
         )
 
     def _resolve_shard_rows(self, uk: np.ndarray):

@@ -61,6 +61,7 @@ from paddlebox_tpu.parallel.multiprocess import (
 from paddlebox_tpu.parallel.sharded_table import ShardedBatchPlan, ShardedSparseTable
 from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
 from paddlebox_tpu.sparse.table import gather_rows, scatter_add_rows
+from paddlebox_tpu.train.trainer import resolve_slot_lr_vec
 
 shard_map = jax.shard_map
 
@@ -93,6 +94,8 @@ def _stack_group(
         extra["metric_masks"] = np.stack(
             [metric_group.masks(b) for b in batches]
         )
+    if plan.serve_lr is not None:
+        extra["uniq_lr"] = plan.serve_lr
     return {
         **extra,
         "serve_rows": plan.serve_rows,
@@ -147,6 +150,7 @@ def sharded_push_and_update(
     key_mask: jax.Array,
     key_clicks: jax.Array,
     conf: SparseTableConfig,
+    uniq_lr: Optional[jax.Array] = None,
 ):
     """Device-local half of a cross-chip push (call inside shard_map).
 
@@ -155,6 +159,11 @@ def sharded_push_and_update(
     into one segment via the host-precomputed dedup (serve_map/serve_uniq),
     and applies show/clk counters + sparse adagrad to exactly the touched
     rows — O(batch), not O(shard).
+
+    uniq_lr: optional [US] per-served-unique-row learning rates (the LR-map
+    analog on the sharded path, planned host-side by plan_group — reference:
+    box_wrapper.h:631 GetLRMap applied in the multi-GPU push).  None = the
+    scalar conf.learning_rate.
     """
     n, C = serve_map.shape
     co = conf.cvm_offset
@@ -176,9 +185,9 @@ def sharded_push_and_update(
         recv.reshape(n * C, W), serve_map.reshape(-1), num_segments=US
     )  # [US, W]
     g2_rows = jnp.take(g2sum, serve_uniq)
+    lr = conf.learning_rate if uniq_lr is None else uniq_lr
     w_delta, g2_delta = sparse_adagrad_update(
-        g2_rows, acc[:, co:], conf.learning_rate, conf.initial_g2sum,
-        conf.grad_clip,
+        g2_rows, acc[:, co:], lr, conf.initial_g2sum, conf.grad_clip,
     )
     delta = jnp.concatenate([acc[:, :co], w_delta], axis=1)
     # serve_uniq targets are unique EXCEPT possibly repeated dead-row
@@ -224,6 +233,11 @@ class MultiChipTrainer:
         apply_compute_dtype_override(model, self.conf.compute_dtype)
         self.metric_group = metric_group
         self.n_tasks = getattr(model, "n_tasks", 1)
+        # per-slot LR map, same resolution/validation as the single-chip
+        # Trainer; consumed by plan_group -> plan.serve_lr -> the push
+        self._slot_lr_vec = resolve_slot_lr_vec(
+            table_conf, getattr(model, "n_sparse_slots", 0)
+        )
         if self.conf.dense_optimizer == "adam":
             self.optimizer = optax.adam(self.conf.dense_lr)
         elif self.conf.dense_optimizer == "sgd":
@@ -318,6 +332,7 @@ class MultiChipTrainer:
             values, g2sum = sharded_push_and_update(
                 values, g2sum, row_grads, batch["occ_flat"], batch["serve_map"],
                 batch["serve_uniq"], batch["key_mask"], batch["key_clicks"], tconf,
+                uniq_lr=batch.get("uniq_lr"),
             )
             primary = preds[:, 0] if n_tasks > 1 else preds
             mstate = dict(mstate)
@@ -599,7 +614,10 @@ class MultiChipTrainer:
                         "DataFeedConfig.task_label_slots with "
                         f"{self.n_tasks - 1} slots (task 0 is the primary label)"
                     )
-                plan = table.plan_group(group, gather=plan_gather)
+                plan = table.plan_group(
+                    group, gather=plan_gather,
+                    slot_lr_vec=self._slot_lr_vec, n_slots=n_slots,
+                )
                 feed = _stack_group(group, plan, n_slots, self.metric_group)
                 yield global_from_local(self._sharding, feed)
 

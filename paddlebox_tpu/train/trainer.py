@@ -38,6 +38,25 @@ from paddlebox_tpu.models.layers import bce_with_logits
 from paddlebox_tpu.sparse.table import SparseTable, pull_rows, push_and_update
 
 
+def resolve_slot_lr_vec(table_conf, n_sparse_slots: int):
+    """Resolve ``SparseTableConfig.slot_learning_rates`` into a dense [S]
+    float32 vector (default lr for unmapped slots), or None when no map is
+    configured — the host half of the BoxPS LR map (reference:
+    box_wrapper.h:631 GetLRMap/SetLRMap).  Shared by the single-chip Trainer
+    and MultiChipTrainer so both paths validate identically."""
+    if not table_conf.slot_learning_rates:
+        return None
+    v = np.full(n_sparse_slots, table_conf.learning_rate, np.float32)
+    for slot, lr in table_conf.slot_learning_rates:
+        if not 0 <= slot < n_sparse_slots:
+            raise ValueError(
+                f"slot_learning_rates slot {slot} out of range "
+                f"for {n_sparse_slots} sparse slots"
+            )
+        v[slot] = lr
+    return v
+
+
 @dataclasses.dataclass
 class TrainState:
     """Everything the jitted step reads and writes."""
@@ -253,18 +272,9 @@ class Trainer:
         # per-slot LR map (reference: BoxPS GetLRMap/SetLRMap,
         # box_wrapper.h:631): resolved host-side into a [S] vector; the
         # feed carries per-unique-key lr ("uniq_lr") when configured
-        self._slot_lr_vec: Optional[np.ndarray] = None
-        if table_conf.slot_learning_rates:
-            S = model.n_sparse_slots
-            v = np.full(S, table_conf.learning_rate, np.float32)
-            for slot, lr in table_conf.slot_learning_rates:
-                if not 0 <= slot < S:
-                    raise ValueError(
-                        f"slot_learning_rates slot {slot} out of range "
-                        f"for {S} sparse slots"
-                    )
-                v[slot] = lr
-            self._slot_lr_vec = v
+        self._slot_lr_vec = resolve_slot_lr_vec(
+            table_conf, model.n_sparse_slots
+        )
         if self.conf.dense_optimizer == "adam":
             self.optimizer = optax.adam(self.conf.dense_lr)
         elif self.conf.dense_optimizer == "sgd":

@@ -141,12 +141,88 @@ def test_bad_configs_rejected(synth):
         )
 
 
-def test_sharded_table_rejects_lr_map():
-    from paddlebox_tpu.parallel import ShardedSparseTable, make_mesh
+N_DEV = 8
 
-    with pytest.raises(NotImplementedError):
-        ShardedSparseTable(
-            SparseTableConfig(embedding_dim=8,
-                              slot_learning_rates=((0, 0.1),)),
-            make_mesh(8),
-        )
+
+def _train_sharded(paths, tconf, model, n_dev=N_DEV):
+    """Train one pass on the 8-device mesh: same files as _train, split into
+    per-device batches of B // n_dev so the global batch matches."""
+    import jax
+
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.parallel import (
+        MultiChipTrainer,
+        ShardedSparseTable,
+        make_mesh,
+    )
+
+    assert len(jax.devices()) >= n_dev, "conftest must force 8 CPU devices"
+    mesh = make_mesh(n_dev)
+    conf = make_synth_config(
+        n_sparse_slots=N_SLOTS, dense_dim=DENSE, batch_size=B // n_dev,
+        batch_key_capacity=B * N_SLOTS * 4 // n_dev,
+    )
+    ds = PadBoxSlotDataset(conf)
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    trainer = MultiChipTrainer(
+        model, tconf, mesh, TrainerConfig(auc_buckets=1 << 10), seed=0
+    )
+    table = ShardedSparseTable(tconf, mesh, seed=0, bucket_slack=float(n_dev))
+    table.begin_pass(ds.unique_keys())
+    m = trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    sd = table.state_dict()
+    ds.close()
+    return m, sd
+
+
+def test_sharded_uniform_lr_map_matches_scalar(synth):
+    """On the 8-device mesh a uniform LR map must be bit-identical to the
+    scalar path — the sharded LR plumbing itself changes nothing (VERDICT
+    r4 next #5: the map formerly raised NotImplementedError here)."""
+    paths, _ = synth
+
+    def mk():
+        return CtrDnn(n_sparse_slots=N_SLOTS, emb_width=10, dense_dim=DENSE,
+                      hidden=(16,))
+
+    base = SparseTableConfig(embedding_dim=8, learning_rate=0.05)
+    mapped = SparseTableConfig(
+        embedding_dim=8, learning_rate=0.05,
+        slot_learning_rates=tuple((s, 0.05) for s in range(N_SLOTS)),
+    )
+    m1, sd1 = _train_sharded(paths, base, mk())
+    m2, sd2 = _train_sharded(paths, mapped, mk())
+    assert m1["loss"] == pytest.approx(m2["loss"], rel=1e-7)
+    np.testing.assert_array_equal(sd1["keys"], sd2["keys"])
+    np.testing.assert_allclose(sd1["values"], sd2["values"], rtol=1e-7)
+
+
+def test_sharded_per_slot_lr_matches_single_chip(synth):
+    """The LR map must act identically on the sharded path and the
+    single-chip path: one pass over the same instances (global batch B as
+    8 x B/8), same seeds, table states must agree feature-by-feature
+    (reference: the LR map applies in the production multi-GPU push,
+    box_wrapper.h:631 / box_wrapper.cc:404-566)."""
+    paths, conf = synth
+    tconf = SparseTableConfig(
+        embedding_dim=8, learning_rate=0.05,
+        slot_learning_rates=((2, 0.0005), (3, 0.0005)),
+    )
+
+    def mk():
+        return CtrDnn(n_sparse_slots=N_SLOTS, emb_width=tconf.row_width,
+                      dense_dim=DENSE, hidden=(16,))
+
+    _, sd1 = _train(paths, conf, tconf, mk())
+    _, sd8 = _train_sharded(paths, tconf, mk())
+    np.testing.assert_array_equal(sd1["keys"], sd8["keys"])
+    np.testing.assert_allclose(sd1["values"], sd8["values"], atol=2e-4)
+    # and the per-slot effect itself is visible on the sharded table
+    co, w = tconf.cvm_offset, tconf.row_width
+    init = _key_uniform(sd8["keys"], seed=0, n_cols=w - co,
+                        rng_range=tconf.initial_range)
+    moved = np.abs(sd8["values"][:, co:w] - init).mean(axis=1)
+    slot = _slot_of(sd8["keys"])
+    assert moved[slot < 2].mean() > 20 * moved[slot >= 2].mean()
