@@ -101,9 +101,26 @@ def make_composed_mesh(
     Device layout is data-major, so each data shard's inner group is an
     ICI-adjacent block.  MultiChipTrainer binds only ``data`` manually
     (axis_names) and the model's inner shard_map (``expert_mesh="inherit"``
-    etc.) binds the inner axis inside the same jitted step."""
+    etc.) binds the inner axis inside the same jitted step.
+
+    Any ``n_data >= 2`` composes (odd totals simply leave the remaining
+    devices out of the mesh).  ``n_data == 1`` is rejected: XLA's SPMD
+    partitioner RET_CHECKs on a 1-sized *manual* data axis nested with an
+    auto inner axis ("Cross-partition allreduce must be in (partial) manual
+    partitioning mode", spmd_partitioner.cc:3497) — and that shape IS the
+    single-chip trainer with a model-parallel mesh, which the Trainer +
+    explicit ``expert_mesh``/``seq_mesh`` path already serves without the
+    sharded-table machinery."""
     if devices is None:
         devices = jax.devices()
+    if n_data < 2:
+        raise ValueError(
+            "make_composed_mesh needs a data axis of >= 2 (a 1-sized manual "
+            "data axis trips an XLA partial-manual partitioner RET_CHECK "
+            "when nested with an auto inner axis); for one data shard use "
+            "the single-chip Trainer with an explicit model mesh "
+            "(MMoE(expert_mesh=make_mesh(...)) / LongSeqCtrDnn(seq_mesh=...))"
+        )
     need = n_data * n_inner
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")
