@@ -146,6 +146,22 @@ class Predictor:
         or [b, n_tasks].  The batch may come from ANY feed shape whose real
         instance/key counts fit an exported bucket."""
         m = self.meta
+        # feed/artifact schema must agree BEFORE any resolve: a batch built
+        # under a different slot config produces segment ids (ins * S + slot)
+        # under the wrong S and would score garbage silently (ADVICE r4)
+        S = m["n_sparse_slots"]
+        if batch.n_sparse_slots != S:
+            raise ValueError(
+                f"batch was built with {batch.n_sparse_slots} sparse slots "
+                f"but the artifact serves {S}: feed config and exported "
+                "model disagree — re-export or fix DataFeedConfig.slots"
+            )
+        if batch.dense.shape[1] != m["dense_dim"]:
+            raise ValueError(
+                f"batch dense width {batch.dense.shape[1]} != artifact "
+                f"dense_dim {m['dense_dim']}: feed config and exported "
+                "model disagree"
+            )
         b = int(batch.ins_mask.sum())
         if b and not batch.ins_mask[:b].all():
             raise ValueError(
@@ -153,7 +169,6 @@ class Predictor:
             )
         nk = int(batch.n_keys)
         B, K, exported = self._pick_bucket(b, nk)
-        S = m["n_sparse_slots"]
 
         rows = self._resolve_rows(batch.keys, nk, K)
         # segments: the real keys' ids are ins * S + slot with ins < b <= B,
@@ -172,6 +187,12 @@ class Predictor:
                 )
             ro = np.zeros((B, m["rank_offset_cols"]), np.int32)
             ro_src = np.asarray(batch.rank_offset, np.int32)
+            if ro_src.shape[1] != m["rank_offset_cols"]:
+                raise ValueError(
+                    f"batch rank_offset has {ro_src.shape[1]} columns but "
+                    f"the artifact serves {m['rank_offset_cols']}: set "
+                    "DataFeedConfig.rank_offset_cols to the exported width"
+                )
             ro[:b] = ro_src[:b]
             args.append(ro)
         if m.get("seq_len", 0):
@@ -181,13 +202,21 @@ class Predictor:
                     "DataFeedConfig.sequence_slot so batches carry seq_pos"
                 )
             T = m["seq_len"]
+            src = np.asarray(batch.seq_pos, np.int32)
+            if src.shape[1] != T:
+                # a wider feed would silently drop behavior history at
+                # serving time, skewing scores vs training (which raises on
+                # the same mismatch — LongSeqCtrDnn.apply); match it (ADVICE)
+                raise ValueError(
+                    f"batch max_seq_len {src.shape[1]} != artifact seq_len "
+                    f"{T}: set DataFeedConfig.max_seq_len to the exported "
+                    "length"
+                )
             # re-bucket: real positions (< this batch's real key count) are
             # valid under the bucket's key buffer too; everything else
             # becomes the bucket's pad marker K
             sp = np.full((B, T), K, np.int32)
-            src = np.asarray(batch.seq_pos, np.int32)
-            tc = min(T, src.shape[1])
-            sp[:b, :tc] = np.where(src[:b, :tc] < nk, src[:b, :tc], K)
+            sp[:b] = np.where(src[:b] < nk, src[:b], K)
             args.append(sp)
         preds = np.asarray(exported.call(*args))
         return preds[:b]

@@ -129,6 +129,13 @@ def _scatter_kernel(idx_ref, delta_ref, values_ref, out_ref, rows, sems,
     before returning and grid steps run sequentially on a core, so later
     tiles read fully-updated rows.
 
+    Hardware caveat (ADVICE r4): concurrent same-address identical-byte DMA
+    stores are exercised by CI only in interpret mode; run
+    test_pallas_sparse on a real TPU (bench.py --pallas does) before
+    flipping flags.use_pallas_sparse on in production — if real DMA
+    semantics ever disagree, serialize duplicate stores by masking all but
+    each duplicate group's first occurrence.
+
     All loads AND stores go through ``out_ref`` — the aliased output buffer
     (initialized to the input table).  Reading the aliased *input* ref
     instead would see stale rows in interpret mode, where input and output

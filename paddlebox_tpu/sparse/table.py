@@ -377,7 +377,17 @@ def scatter_add_rows(values: jax.Array, idx: jax.Array, delta: jax.Array,
     accumulate identically on both paths.  ``unique=True`` promises the
     caller's indices are distinct (the plan's scratch-row construction) and
     unlocks XLA's parallel scatter lowering; the Pallas kernel is
-    duplicate-safe either way."""
+    duplicate-safe either way.
+
+    Caveat on the ``unique=True`` promise (ADVICE r4): plan index vectors
+    can still repeat DEAD-ROW entries (scratch-clamped pad slots and the
+    census-missing sink).  Callers zero every dead-targeted delta before
+    the scatter, so any lowering that races duplicate writes only ever
+    writes identical (unchanged) bytes — the claim relies on that
+    add-of-zero idempotence, which XLA's semantics leave formally
+    undefined for non-unique indices.  bench.py's ``--device-profile``
+    push vs push-dup ablation is the A/B check; pass ``unique=False``
+    here if a backend ever miscompiles the pattern."""
     from paddlebox_tpu.config import flags
 
     if flags.use_pallas_sparse:

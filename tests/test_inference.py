@@ -102,6 +102,93 @@ def test_predict_unseen_keys_and_batch_size_guard(tmp_path):
     ds.close()
 
 
+def test_predict_rejects_schema_mismatch(tmp_path):
+    """A batch built under a different feed schema must be rejected up
+    front (ADVICE r4: wrong slot count silently scored garbage — segment
+    ids ins*S+slot computed under the wrong S; wider seq feeds silently
+    dropped behavior history)."""
+    conf, ds, model, table, trainer = _train_small(str(tmp_path / "data"))
+    art = str(tmp_path / "artifact")
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    export_model(
+        model, trainer.params, table, art,
+        batch_size=B, key_capacity=kcap, dense_dim=DENSE,
+    )
+    pred = Predictor.load(art)
+    ds.close()
+
+    def batch_from(n_slots, dense_dim):
+        c = make_synth_config(
+            n_sparse_slots=n_slots, dense_dim=dense_dim, batch_size=B,
+            max_feasigns_per_ins=16,
+        )
+        files = write_synth_files(
+            str(tmp_path / f"d{n_slots}x{dense_dim}"), n_files=1,
+            ins_per_file=B, n_sparse_slots=n_slots, vocab_per_slot=50,
+            dense_dim=dense_dim, seed=3,
+        )
+        d = PadBoxSlotDataset(c, read_threads=1)
+        d.set_filelist(files)
+        d.load_into_memory()
+        b = next(d.batches(drop_last=False))
+        d.close()
+        return b
+
+    with pytest.raises(ValueError, match="sparse slots"):
+        pred.predict(batch_from(S + 1, DENSE))
+    with pytest.raises(ValueError, match="dense"):
+        pred.predict(batch_from(S, DENSE + 2))
+
+
+def test_predict_rejects_seq_len_mismatch(tmp_path):
+    """Serving raises on a seq-width mismatch exactly like training does,
+    instead of silently truncating behavior history (ADVICE r4)."""
+    from paddlebox_tpu.models import LongSeqCtrDnn
+
+    T = 8
+
+    def data(seq_len, tag):
+        c = make_synth_config(
+            n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+            max_feasigns_per_ins=16, sequence_slot="slot0",
+            max_seq_len=seq_len,
+        )
+        files = write_synth_files(
+            str(tmp_path / tag), n_files=1, ins_per_file=32,
+            n_sparse_slots=S, vocab_per_slot=50, dense_dim=DENSE, seed=11,
+            max_keys_per_slot=6,
+        )
+        d = PadBoxSlotDataset(c, read_threads=1)
+        d.set_filelist(files)
+        d.load_into_memory()
+        return c, d
+
+    conf, ds = data(T, "train")
+    tconf = SparseTableConfig(embedding_dim=8)
+    model = LongSeqCtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(8,),
+                          max_seq_len=T, n_heads=2, head_dim=4)
+    table = SparseTable(tconf, seed=0)
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10), seed=0)
+    table.begin_pass(ds.unique_keys())
+    trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    art = str(tmp_path / "artifact")
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+    export_model(model, trainer.params, table, art,
+                 batch_size=B, key_capacity=kcap, dense_dim=DENSE)
+    pred = Predictor.load(art)
+    # matching width serves fine
+    out = pred.predict(next(ds.batches(drop_last=False)))
+    assert np.all(np.isfinite(out))
+    ds.close()
+    # a WIDER feed (more history than the artifact was exported for) must
+    # raise, not silently slice
+    _, ds_wide = data(2 * T, "wide")
+    with pytest.raises(ValueError, match="seq_len"):
+        pred.predict(next(ds_wide.batches(drop_last=False)))
+    ds_wide.close()
+
+
 def test_predict_dataset_streams_all(tmp_path):
     conf, ds, model, table, trainer = _train_small(str(tmp_path / "data"))
     art = str(tmp_path / "artifact")
