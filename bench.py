@@ -542,6 +542,29 @@ def device_profile(ds, tconf, trconf, model, scan_k: int = 8, seed=0):
     h2d_ms = (time.perf_counter() - t0) / 10 * 1e3
     log(f"H2D: {feed_mb:.2f} MB/feed, {h2d_ms:.2f} ms/feed")
 
+    # dispatch overhead: how much a single no-op device call costs, async
+    # (pipelined, what the plain loop pays per step) and sync (adds the
+    # round trip — what any per-step host readback would pay).  The scan
+    # path exists to amortize exactly this; these two numbers say whether
+    # it still needs to on the day's backend.
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8, jnp.float32)
+    x = tiny(x)
+    x.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(100):
+        x = tiny(x)
+    x.block_until_ready()
+    dispatch_ms = (time.perf_counter() - t0) / 100 * 1e3
+    t0 = time.perf_counter()
+    for _ in range(20):
+        tiny(x).block_until_ready()
+    dispatch_sync_ms = (time.perf_counter() - t0) / 20 * 1e3
+    log(f"dispatch: {dispatch_ms:.3f} ms async, {dispatch_sync_ms:.3f} ms "
+        "sync")
+
     # device step alone: same feed, state carried, block only at the end
     out = trainer._step_fn(params, opt_state, values, g2sum, mstate, dev)
     jax.block_until_ready(out[5])
@@ -562,15 +585,44 @@ def device_profile(ds, tconf, trconf, model, scan_k: int = 8, seed=0):
     for name, ms in ablate.items():
         log(f"ablation {name}: {ms:.2f} ms")
 
+    # transfer/compute overlap: dispatch a step WITHOUT blocking, then time
+    # a feed transfer issued while it runs.  Overlap -> ~h2d_ms; a
+    # serializing backend (proxy/tunnel single stream) -> ~step + h2d, which
+    # voids the prefetcher's premise and is the prime trainer-path-regression
+    # suspect (BASELINE.md r4: prefetch+scan 3x slower than the plain loop
+    # on TPU while equal on CPU).
+    during = []
+    for i in range(5):  # averaged: a single race would be noise, and this
+        # number is the serialization verdict
+        out = trainer._step_fn(params, opt_state, values, g2sum, mstate, dev)
+        t0 = time.perf_counter()
+        jax.block_until_ready(_to_device(hosts[(i + 1) % len(hosts)]))
+        during.append((time.perf_counter() - t0) * 1e3)
+        params, opt_state, values, g2sum, mstate = out[:5]
+        jax.block_until_ready(out[5])
+    h2d_during_ms = sum(during) / len(during)
+    log(f"H2D during a running step: {h2d_during_ms:.2f} ms "
+        f"(idle: {h2d_ms:.2f} ms; >> idle means transfers serialize "
+        "with compute)")
+
     # scan group alone: stacked feed reused
     scan_ms = None
+    h2d_stacked_ms = None
     if scan_k > 1:
         scan_k = min(scan_k, len(hosts))  # ticks actually stacked
         trainer.conf = dataclasses.replace(trainer.conf, scan_steps=scan_k)
         scan_fn = trainer._build_scan_step()
-        stacked = _to_device(
-            {k: np.stack([h[k] for h in hosts[:scan_k]]) for k in hosts[0]}
-        )
+        stacked_host = {
+            k: np.stack([h[k] for h in hosts[:scan_k]]) for k in hosts[0]
+        }
+        stacked = _to_device(stacked_host)
+        jax.block_until_ready(stacked)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            jax.block_until_ready(_to_device(stacked_host))
+        h2d_stacked_ms = (time.perf_counter() - t0) / 5 * 1e3
+        log(f"H2D stacked [{scan_k}, ...] feed: {h2d_stacked_ms:.2f} ms "
+            f"({h2d_stacked_ms / scan_k:.2f} ms/tick)")
         t0 = time.perf_counter()
         out = scan_fn(params, opt_state, values, g2sum, mstate, stacked)
         jax.block_until_ready(out[5])
@@ -589,6 +641,12 @@ def device_profile(ds, tconf, trconf, model, scan_k: int = 8, seed=0):
     table.values, table.g2sum = values, g2sum
     table.end_pass()
     return {"host_ms": round(host_ms, 2), "h2d_ms": round(h2d_ms, 2),
+            "h2d_during_step_ms": round(h2d_during_ms, 2),
+            "h2d_stacked_ms": (
+                None if h2d_stacked_ms is None else round(h2d_stacked_ms, 2)
+            ),
+            "dispatch_ms": round(dispatch_ms, 3),
+            "dispatch_sync_ms": round(dispatch_sync_ms, 3),
             "step_ms": round(step_ms, 2),
             "scan_tick_ms": None if scan_ms is None else round(scan_ms, 2),
             "feed_mb": round(feed_mb, 2),
