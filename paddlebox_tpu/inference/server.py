@@ -97,7 +97,14 @@ class ScoringServer:
 
     # -- scoring ------------------------------------------------------------ #
     def score_lines(self, text: bytes, name: Optional[str] = None) -> list:
-        """Scores for every instance in canonical slot-text ``text``."""
+        """Scores for every instance in canonical slot-text ``text``.
+
+        Arbitrary request shapes: instances are scored in feed-batch-size
+        chunks, and a chunk whose KEY count overflows every exported shape
+        bucket (key-dense instances) is split in half recursively until it
+        fits — so any request serves as long as each single instance fits
+        some bucket (the reference's freely-resizable feed tensors,
+        analysis_predictor.cc, by decomposition instead of recompilation)."""
         with self._meta_lock:
             entry = self._models[name or self._default]
         from paddlebox_tpu.data.feed import BatchBuilder
@@ -109,13 +116,32 @@ class ScoringServer:
         B = entry.feed_conf.batch_size
         import numpy as np
 
+        # per-instance key counts, read once from the parsed block
+        # (key_offsets is per (instance, slot) — stride by S for the
+        # instance totals): chunks whose totals overflow are split BEFORE
+        # any batch is built, so each served chunk is packed exactly once
+        # and schema/config errors from predict() propagate immediately
+        # instead of surviving a split
+        lens = np.diff(block.key_offsets[:: block.n_sparse_slots])
+        buckets = entry.predictor.bucket_shapes
+
+        def score_ids(ids) -> list:
+            nk = int(lens[ids].sum())
+            overflow = nk > builder.key_capacity or not any(
+                len(ids) <= bb and nk <= bk for bb, bk in buckets
+            )
+            if overflow and len(ids) > 1:
+                mid = len(ids) // 2
+                return score_ids(ids[:mid]) + score_ids(ids[mid:])
+            # a SINGLE instance beyond key capacity serves clipped — exactly
+            # what training would have done with it (dropped_keys counts it)
+            batch = builder.build(block, ids)
+            return [float(s) for s in entry.predictor.predict(batch)]
+
         with self._lock:  # scoring only: /healthz never waits on this
             for lo in range(0, block.n_ins, B):
                 ids = np.arange(lo, min(lo + B, block.n_ins))
-                batch = builder.build(block, ids)
-                scores.extend(
-                    float(s) for s in entry.predictor.predict(batch)
-                )
+                scores.extend(score_ids(ids))
         with self._meta_lock:
             entry.requests += 1
             entry.instances += len(scores)

@@ -122,6 +122,50 @@ def test_health_models_and_errors(server):
     assert st == 200 and len(out["scores"]) == 2
 
 
+def test_key_dense_requests_split_not_clipped(tmp_path):
+    """A request whose key count overflows the feed's batch key capacity
+    must be scored by recursive chunk-splitting, not by silently dropping
+    features (the builder's training-parity clip).  Scores must equal the
+    same instances scored one at a time."""
+    from paddlebox_tpu.data.slot_parser import SlotParser
+    from paddlebox_tpu.data.feed import BatchBuilder
+
+    conf, art = _train_and_export(tmp_path, "kd", seed=6)
+    srv = ScoringServer()
+    srv.register("kd", art, conf)
+
+    # key-dense lines: ~6x the per-instance key budget the batch capacity
+    # assumes (B=16, max_feasigns_per_ins=8 -> capacity 128 keys/batch;
+    # 16 instances x 3 slots x 16 keys = 768 keys)
+    rng = np.random.default_rng(11)
+    out = []
+    for _ in range(16):
+        parts = ["1 0"]
+        for s in range(S):
+            ks = rng.integers(0, 40, 16)
+            parts.append(f"{len(ks)} " + " ".join(map(str, ks)))
+        parts.append(f"{DENSE} " + " ".join(
+            f"{v:.3f}" for v in rng.random(DENSE)))
+        out.append(" ".join(parts))
+    body = ("\n".join(out) + "\n").encode()
+
+    got = srv.score_lines(body)
+    assert len(got) == 16
+
+    # oracle: each instance alone (fits capacity: 48 keys) — no clipping
+    want = []
+    for line in out:
+        want.extend(srv.score_lines((line + "\n").encode()))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    # and the builder really WOULD have clipped these as one batch
+    parser = SlotParser(conf)
+    block = parser.parse_lines(out)
+    b = BatchBuilder(conf)
+    b.build(block, np.arange(16))
+    assert b.dropped_keys > 0
+
+
 def test_longseq_artifact_serves(tmp_path):
     """A behavior-sequence model (uses_seq_pos) exports and serves over the
     packaged server: the feed builds seq_pos from the configured
