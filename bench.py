@@ -1054,23 +1054,32 @@ def stage_headline(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
                 # differs).  Zoo rows stay single-pass for run_all time;
                 # this measurement also stands in for a dedicated
                 # trainer-path stage (its own metric line below).
-                try:
-                    sps2 = bench_trainer_path(
-                        ds, tconf, dataclasses.replace(trconf, scan_steps=8),
-                        model)
-                    emit({"metric":
-                          f"{model_name}_trainer_path_samples_per_sec",
-                          "value": round(sps2, 1), "unit": "samples/sec",
-                          "vs_baseline": None, "backend": backend})
-                    if sps2 > ours:
-                        ours, path = sps2, "scan8"
-                        util = util_fields(cost, ours, bsz)
-                        emit({"metric": f"{model_name}_samples_per_sec",
-                              "value": round(ours, 1),
-                              "unit": "samples/sec", "vs_baseline": None,
-                              "backend": backend, "path": path, **util})
-                except Exception as e:
-                    log(f"trainer-path variant failed: {e!r}")
+                # two variants, not one: prefetch+scan8 and prefetch+scan1.
+                # If scan8 loses while scan1 matches the plain loop, the
+                # scan PROGRAM is slow on this backend; if both lose, the
+                # prefetch overlap itself is broken (r4's open 3x question
+                # — see also device_profile's h2d_during_step_ms).
+                for scan_k in (8, 1):
+                    try:
+                        sps2 = bench_trainer_path(
+                            ds, tconf,
+                            dataclasses.replace(trconf, scan_steps=scan_k),
+                            model)
+                        suffix = "" if scan_k == 8 else f"_scan{scan_k}"
+                        emit({"metric":
+                              f"{model_name}_trainer_path{suffix}"
+                              "_samples_per_sec",
+                              "value": round(sps2, 1), "unit": "samples/sec",
+                              "vs_baseline": None, "backend": backend})
+                        if sps2 > ours:
+                            ours, path = sps2, f"scan{scan_k}"
+                            util = util_fields(cost, ours, bsz)
+                            emit({"metric": f"{model_name}_samples_per_sec",
+                                  "value": round(ours, 1),
+                                  "unit": "samples/sec", "vs_baseline": None,
+                                  "backend": backend, "path": path, **util})
+                    except Exception as e:
+                        log(f"trainer-path scan={scan_k} failed: {e!r}")
                 log(f"headline path: {path} ({ours:,.0f} samples/s)")
                 try:
                     naive = bench_naive(ds, tconf, trconf, hidden)

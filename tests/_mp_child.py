@@ -6,7 +6,7 @@ of every device group — so the N-process run must reproduce the
 single-process n-device run exactly (the reference's localhost-subprocess
 distributed tier, test_dist_base.py:642 "dist loss == local loss").
 
-argv: data_dir out_json
+argv: data_dir out_json [lrmap]
 """
 
 import glob
@@ -48,7 +48,15 @@ def main() -> None:
     ds.load_into_memory()
 
     mesh = make_mesh()
-    tconf = SparseTableConfig(embedding_dim=8)
+    # "lrmap=<json>" arm: per-slot LR map over the sharded path — its slot
+    # lrs ride the packed want-matrix allgather on the host-plane KV
+    # channel.  The map itself comes from the test via argv so the
+    # reference run and this child can never drift.
+    lr_map = ()
+    for a in sys.argv[3:]:
+        if a.startswith("lrmap="):
+            lr_map = tuple(tuple(p) for p in json.loads(a[6:]))
+    tconf = SparseTableConfig(embedding_dim=8, slot_learning_rates=lr_map)
     trconf = TrainerConfig(auc_buckets=1 << 10)
     model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(32, 16))
     trainer = MultiChipTrainer(model, tconf, mesh, trconf, seed=0)

@@ -32,7 +32,7 @@ def _write_data(tmp_path):
     )
 
 
-def _reference_run(files):
+def _reference_run(files, slot_lr=()):
     """Single-process 8-device run (the 'local' side of the parity)."""
     import jax
 
@@ -49,7 +49,7 @@ def _reference_run(files):
     ds.set_filelist(files)
     ds.load_into_memory()
     mesh = make_mesh(8)
-    tconf = SparseTableConfig(embedding_dim=8)
+    tconf = SparseTableConfig(embedding_dim=8, slot_learning_rates=slot_lr)
     trconf = TrainerConfig(auc_buckets=1 << 10)
     model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(32, 16))
     trainer = MultiChipTrainer(model, tconf, mesh, trconf, seed=0)
@@ -67,16 +67,26 @@ def _reference_run(files):
 
 
 @pytest.mark.slow
-def test_two_process_parity(tmp_path):
+@pytest.mark.parametrize("lrmap", [False, True])
+def test_two_process_parity(tmp_path, lrmap):
+    """lrmap arm: the per-slot LR map's packed want+lr allgather must agree
+    across the host-plane KV channel exactly like the plain plan does
+    (the single-process reference uses host_allgather; parity proves the
+    two transports carry the packed matrix identically)."""
     files = _write_data(tmp_path)
-    ref = _reference_run(files)
+    slot_lr = ((1, 0.005), (2, 0.5)) if lrmap else ()
+    ref = _reference_run(files, slot_lr=slot_lr)
 
     from paddlebox_tpu.launch import launch
 
     out_json = str(tmp_path / "rank0.json")
     log_dir = str(tmp_path / "logs")
+    child_args = [
+        os.path.join(HERE, "_mp_child.py"), os.path.dirname(files[0]),
+        out_json,
+    ] + ([f"lrmap={json.dumps(slot_lr)}"] if lrmap else [])
     rc = launch(
-        [os.path.join(HERE, "_mp_child.py"), os.path.dirname(files[0]), out_json],
+        child_args,
         nproc=2,
         devices_per_proc=4,
         log_dir=log_dir,
