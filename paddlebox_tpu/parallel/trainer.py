@@ -30,7 +30,7 @@ overlaps the all_to_alls with the dense tower compute where possible.
 
 from __future__ import annotations
 
-from typing import Iterator, Optional, Sequence
+from typing import Iterable, Iterator, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -61,7 +61,11 @@ from paddlebox_tpu.parallel.multiprocess import (
 from paddlebox_tpu.parallel.sharded_table import ShardedBatchPlan, ShardedSparseTable
 from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
 from paddlebox_tpu.sparse.table import gather_rows, scatter_add_rows
-from paddlebox_tpu.train.trainer import resolve_slot_lr_vec
+from paddlebox_tpu.train.trainer import (
+    normalize_slot_mask,
+    resolve_slot_lr_vec,
+    slot_participation_vec,
+)
 
 shard_map = jax.shard_map
 
@@ -217,10 +221,18 @@ class MultiChipTrainer:
         trainer_conf: Optional[TrainerConfig] = None,
         seed: int = 0,
         metric_group: Optional[MetricGroup] = None,
+        slot_mask: Optional[Iterable[int]] = None,
     ):
+        """slot_mask: participating sparse-slot indices (None = all) — the
+        per-phase slot participation of join/update two-phase training on
+        the multi-chip path (same semantics as the single-chip Trainer:
+        excluded slots read zero pooled features, receive zero gradients,
+        and increment no counters; reference box_wrapper.h:627-630 phase
+        state applied in the production multi-GPU workers)."""
         self.model = model
         self.table_conf = table_conf
         self.mesh = mesh
+        self.slot_mask = normalize_slot_mask(slot_mask, model.n_sparse_slots)
         self.n_dev = int(mesh.shape[DATA_AXIS])  # data shards (==
         # devices on a 1-D mesh; a composed mesh's inner axis splits
         # dense compute inside the step, invisible to feeds/params)
@@ -263,6 +275,7 @@ class MultiChipTrainer:
         self._copy_fn = None
         self.async_dense = None  # lazily created in "async" mode
         self.global_step = 0
+        self.last_metric_state = None  # dict after a pass (Trainer parity)
 
     # -- jitted bodies ----------------------------------------------------- #
     def _build_step(self):
@@ -282,6 +295,9 @@ class MultiChipTrainer:
         uses_seq = getattr(model, "uses_seq_pos", False)
         n_tasks = self.n_tasks
         has_group = self.metric_group is not None
+        part_vec = slot_participation_vec(
+            self.slot_mask, model.n_sparse_slots
+        )
 
         def body(params, opt_state, values, g2sum, mstate, batch):
             # local blocks all carry a leading device axis of size 1
@@ -299,8 +315,18 @@ class MultiChipTrainer:
             extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
             if uses_seq:
                 extra["seq_pos"] = batch["seq_pos"]
+            if part_vec is not None:
+                # occurrence-level participation (seg = ins*S + slot):
+                # gating inside loss_fn zeroes excluded slots' pooled
+                # features AND, via the chain rule, their row gradients —
+                # identical to the single-chip step
+                key_part = part_vec[batch["key_segments"] % part_vec.shape[0]]
+            else:
+                key_part = None
 
             def loss_fn(p, r):
+                if key_part is not None:
+                    r = r * key_part[:, None]
                 logits = model.apply(
                     p, r, batch["key_segments"], batch["dense"], bsz, **extra
                 )
@@ -329,9 +355,15 @@ class MultiChipTrainer:
             if not async_dense:
                 updates, opt_state = optimizer.update(pgrads, opt_state, params)
                 params = optax.apply_updates(params, updates)
+            key_mask = batch["key_mask"]
+            key_clicks = batch["key_clicks"]
+            if key_part is not None:
+                # excluded slots increment no show/clk counters either
+                key_mask = key_mask * key_part
+                key_clicks = key_clicks * key_part
             values, g2sum = sharded_push_and_update(
                 values, g2sum, row_grads, batch["occ_flat"], batch["serve_map"],
-                batch["serve_uniq"], batch["key_mask"], batch["key_clicks"], tconf,
+                batch["serve_uniq"], key_mask, key_clicks, tconf,
                 uniq_lr=batch.get("uniq_lr"),
             )
             primary = preds[:, 0] if n_tasks > 1 else preds

@@ -38,6 +38,37 @@ from paddlebox_tpu.models.layers import bce_with_logits
 from paddlebox_tpu.sparse.table import SparseTable, pull_rows, push_and_update
 
 
+def normalize_slot_mask(slot_mask, n_sparse_slots: int):
+    """Sorted unique participation tuple, validated against the model's
+    slot count (None = all slots participate).  Shared by the single-chip
+    Trainer and MultiChipTrainer (two-phase slot participation,
+    reference box_wrapper.h:627-630)."""
+    if slot_mask is None:
+        return None
+    mask = tuple(sorted(set(slot_mask)))
+    bad = [s for s in mask if not 0 <= s < n_sparse_slots]
+    if bad:
+        raise ValueError(
+            f"slot_mask indices {bad} out of range for "
+            f"{n_sparse_slots} sparse slots"
+        )
+    return mask
+
+
+def slot_participation_vec(slot_mask, n_sparse_slots: int):
+    """[S] 1.0/0.0 device vector for a normalized slot mask (None = no
+    gating).  Indexed per occurrence as ``vec[key_segments % S]`` inside the
+    jitted step: gating the pulled rows inside loss_fn zeroes excluded
+    slots' pooled features AND, via the chain rule, their row gradients;
+    the same per-occurrence factor gates the show/clk counter increments.
+    Shared by the single-chip and multi-chip steps."""
+    if slot_mask is None:
+        return None
+    v = np.zeros(n_sparse_slots, np.float32)
+    v[list(slot_mask)] = 1.0
+    return jnp.asarray(v)
+
+
 def resolve_slot_lr_vec(table_conf, n_sparse_slots: int):
     """Resolve ``SparseTableConfig.slot_learning_rates`` into a dense [S]
     float32 vector (default lr for unmapped slots), or None when no map is
@@ -246,17 +277,7 @@ class Trainer:
         self.model = model
         self.table_conf = table_conf
         self.conf = trainer_conf or TrainerConfig()
-        self.slot_mask = (
-            None if slot_mask is None else tuple(sorted(set(slot_mask)))
-        )
-        if self.slot_mask is not None:
-            S = model.n_sparse_slots
-            bad = [s for s in self.slot_mask if not 0 <= s < S]
-            if bad:
-                raise ValueError(
-                    f"slot_mask indices {bad} out of range for "
-                    f"{S} sparse slots"
-                )
+        self.slot_mask = normalize_slot_mask(slot_mask, model.n_sparse_slots)
         from paddlebox_tpu.models.layers import apply_compute_dtype_override
 
         apply_compute_dtype_override(model, self.conf.compute_dtype)
@@ -300,12 +321,9 @@ class Trainer:
         uses_seq = getattr(model, "uses_seq_pos", False)
         n_tasks = self.n_tasks
         has_group = self.metric_group is not None
-        part_vec = None
-        if self.slot_mask is not None:
-            S = model.n_sparse_slots
-            v = np.zeros(S, np.float32)
-            v[list(self.slot_mask)] = 1.0
-            part_vec = jnp.asarray(v)
+        part_vec = slot_participation_vec(
+            self.slot_mask, model.n_sparse_slots
+        )
 
         def step(params, opt_state, values, g2sum, mstate, batch):
             rows = pull_rows(

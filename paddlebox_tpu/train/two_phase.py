@@ -65,22 +65,38 @@ class TwoPhaseTrainer:
         table_conf: SparseTableConfig,
         trainer_conf: Optional[TrainerConfig] = None,
         seed: int = 0,
+        mesh=None,
     ):
+        """mesh: a ``jax.sharding.Mesh`` runs every phase as a
+        MultiChipTrainer over it (the reference's join/update schedule IS
+        its production multi-GPU shape); pass a ``ShardedSparseTable`` built
+        on the same mesh to the train calls.  None = single-chip."""
         if not phases:
             raise ValueError("need at least one PhaseSpec")
         names = [p.name for p in phases]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate phase names: {names}")
         self.specs = list(phases)
-        self.trainers = {
-            spec.name: Trainer(
-                spec.model,
-                table_conf,
-                trainer_conf,
-                seed=seed + i,
-                slot_mask=spec.slots,
+        if mesh is None:
+            make = lambda spec, i: Trainer(
+                spec.model, table_conf, trainer_conf,
+                seed=seed + i, slot_mask=spec.slots,
             )
-            for i, spec in enumerate(phases)
+        else:
+            from paddlebox_tpu.parallel.trainer import MultiChipTrainer
+
+            if any(spec.use_pv for spec in phases):
+                raise NotImplementedError(
+                    "use_pv phases are single-chip for now: the PV-merged "
+                    "rank_offset feed is not plumbed through the sharded "
+                    "group planner"
+                )
+            make = lambda spec, i: MultiChipTrainer(
+                spec.model, table_conf, mesh, trainer_conf,
+                seed=seed + i, slot_mask=spec.slots,
+            )
+        self.trainers = {
+            spec.name: make(spec, i) for i, spec in enumerate(phases)
         }
         # numeric phase for API parity: index into the training order;
         # starts at 0 (the first spec — canonically "join", which the
@@ -165,3 +181,16 @@ class TwoPhaseTrainer:
 
     def dense_states(self) -> dict:
         return {name: tr.dense_state() for name, tr in self.trainers.items()}
+
+    def close(self) -> None:
+        """Close every phase trainer (joins async-dense update threads and
+        re-raises a dead thread's error — required in
+        ``sync_dense_mode="async"``; harmless otherwise)."""
+        errs = []
+        for tr in self.trainers.values():
+            try:
+                tr.close()
+            except Exception as e:  # close the rest before re-raising
+                errs.append(e)
+        if errs:
+            raise errs[0]

@@ -140,6 +140,68 @@ def test_slot_participation_gates_grads_and_counters(synth):
     np.testing.assert_array_equal(sd["values"][~in_phase, -1], 0.0)
 
 
+def test_two_phase_multichip_matches_single_chip(synth):
+    """Join/update over the 8-device mesh == the single-chip schedule on
+    the same instances: per-phase slot participation gates identically
+    through the sharded pull/push (reference: the phase flip applies in
+    the production multi-GPU workers, box_wrapper.h:627-630)."""
+    import jax
+
+    from paddlebox_tpu.parallel import ShardedSparseTable, make_mesh
+
+    paths, conf = synth
+    tconf, mk = _model()
+    trconf = TrainerConfig(auc_buckets=1 << 10, dense_lr=3e-3)
+    phases = lambda: [
+        PhaseSpec("join", mk(), slots=(0, 1)),
+        PhaseSpec("update", mk(), slots=(2, 3)),
+    ]
+
+    # single-chip reference (2 passes: metric streams must carry)
+    tp1 = TwoPhaseTrainer(phases(), tconf, trconf, seed=0)
+    table1 = SparseTable(tconf, seed=0)
+    ds1 = PadBoxSlotDataset(conf)
+    ds1.set_filelist(paths)
+    ds1.load_into_memory()
+    for _ in range(2):
+        table1.begin_pass(ds1.unique_keys())
+        m1 = tp1.train_pass(ds1, table1)
+        table1.end_pass()
+    ds1.close()
+
+    # multi-chip: same instances as 8 per-device batches of B/8
+    n_dev = 8
+    assert len(jax.devices()) >= n_dev, "conftest must force 8 CPU devices"
+    mesh = make_mesh(n_dev)
+    conf8 = make_synth_config(
+        n_sparse_slots=N_SLOTS, dense_dim=DENSE, batch_size=B // n_dev,
+        batch_key_capacity=B * N_SLOTS * 4 // n_dev,
+    )
+    tp8 = TwoPhaseTrainer(phases(), tconf, trconf, seed=0, mesh=mesh)
+    table8 = ShardedSparseTable(tconf, mesh, seed=0,
+                               bucket_slack=float(n_dev))
+    ds8 = PadBoxSlotDataset(conf8)
+    ds8.set_filelist(paths)
+    ds8.load_into_memory()
+    for _ in range(2):
+        table8.begin_pass(ds8.unique_keys())
+        m8 = tp8.train_pass(ds8, table8)
+        table8.end_pass()
+    ds8.close()
+
+    for name in ("join", "update"):
+        assert m8[name]["count"] == m1[name]["count"]
+        assert abs(m1[name]["loss"] - m8[name]["loss"]) < 2e-4
+    s1, s8 = table1.state_dict(), table8.state_dict()
+    np.testing.assert_array_equal(s1["keys"], s8["keys"])
+    np.testing.assert_allclose(s1["values"], s8["values"], atol=2e-4)
+    # the phase gating itself is visible: join touched slots 0-1 only in
+    # its program, update 2-3 — every slot shows traffic across the pass
+    slot = (np.asarray(s8["keys"], np.int64) - 1) // VOCAB
+    for s in range(N_SLOTS):
+        assert s8["values"][slot == s, 0].sum() > 0
+
+
 def test_single_phase_matches_plain_trainer(synth):
     """A one-phase TwoPhaseTrainer with no slot mask is exactly a Trainer
     (same seed -> identical loss/auc): the phase machinery adds nothing."""
