@@ -61,7 +61,7 @@ from paddlebox_tpu.parallel.multiprocess import (
 from paddlebox_tpu.parallel.sharded_table import ShardedBatchPlan, ShardedSparseTable
 from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
 from paddlebox_tpu.sparse.table import gather_rows, scatter_add_rows
-from paddlebox_tpu.train.trainer import (
+from paddlebox_tpu.train.slot_policy import (
     normalize_slot_mask,
     resolve_slot_lr_vec,
     slot_participation_vec,

@@ -1,0 +1,60 @@
+"""Per-slot training policy helpers shared by the single-chip Trainer and
+MultiChipTrainer — a LEAF module (numpy/jnp only) so parallel/trainer.py
+can import it without riding the train.trainer <-> models <-> parallel
+import cycle.
+
+Reference provenance: the BoxPS LR map (box_wrapper.h:631 GetLRMap/
+SetLRMap) and the join/update phase slot participation
+(box_wrapper.h:627-630).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def normalize_slot_mask(slot_mask, n_sparse_slots: int):
+    """Sorted unique participation tuple, validated against the model's
+    slot count (None = all slots participate)."""
+    if slot_mask is None:
+        return None
+    mask = tuple(sorted(set(slot_mask)))
+    bad = [s for s in mask if not 0 <= s < n_sparse_slots]
+    if bad:
+        raise ValueError(
+            f"slot_mask indices {bad} out of range for "
+            f"{n_sparse_slots} sparse slots"
+        )
+    return mask
+
+
+def slot_participation_vec(slot_mask, n_sparse_slots: int):
+    """[S] 1.0/0.0 device vector for a normalized slot mask (None = no
+    gating).  Indexed per occurrence as ``vec[key_segments % S]`` inside the
+    jitted step: gating the pulled rows inside loss_fn zeroes excluded
+    slots' pooled features AND, via the chain rule, their row gradients;
+    the same per-occurrence factor gates the show/clk counter increments."""
+    if slot_mask is None:
+        return None
+    v = np.zeros(n_sparse_slots, np.float32)
+    v[list(slot_mask)] = 1.0
+    return jnp.asarray(v)
+
+
+def resolve_slot_lr_vec(table_conf, n_sparse_slots: int):
+    """Resolve ``SparseTableConfig.slot_learning_rates`` into a dense [S]
+    float32 vector (default lr for unmapped slots), or None when no map is
+    configured — the host half of the BoxPS LR map.  Both trainer paths
+    validate identically through this."""
+    if not table_conf.slot_learning_rates:
+        return None
+    v = np.full(n_sparse_slots, table_conf.learning_rate, np.float32)
+    for slot, lr in table_conf.slot_learning_rates:
+        if not 0 <= slot < n_sparse_slots:
+            raise ValueError(
+                f"slot_learning_rates slot {slot} out of range "
+                f"for {n_sparse_slots} sparse slots"
+            )
+        v[slot] = lr
+    return v

@@ -38,54 +38,14 @@ from paddlebox_tpu.models.layers import bce_with_logits
 from paddlebox_tpu.sparse.table import SparseTable, pull_rows, push_and_update
 
 
-def normalize_slot_mask(slot_mask, n_sparse_slots: int):
-    """Sorted unique participation tuple, validated against the model's
-    slot count (None = all slots participate).  Shared by the single-chip
-    Trainer and MultiChipTrainer (two-phase slot participation,
-    reference box_wrapper.h:627-630)."""
-    if slot_mask is None:
-        return None
-    mask = tuple(sorted(set(slot_mask)))
-    bad = [s for s in mask if not 0 <= s < n_sparse_slots]
-    if bad:
-        raise ValueError(
-            f"slot_mask indices {bad} out of range for "
-            f"{n_sparse_slots} sparse slots"
-        )
-    return mask
-
-
-def slot_participation_vec(slot_mask, n_sparse_slots: int):
-    """[S] 1.0/0.0 device vector for a normalized slot mask (None = no
-    gating).  Indexed per occurrence as ``vec[key_segments % S]`` inside the
-    jitted step: gating the pulled rows inside loss_fn zeroes excluded
-    slots' pooled features AND, via the chain rule, their row gradients;
-    the same per-occurrence factor gates the show/clk counter increments.
-    Shared by the single-chip and multi-chip steps."""
-    if slot_mask is None:
-        return None
-    v = np.zeros(n_sparse_slots, np.float32)
-    v[list(slot_mask)] = 1.0
-    return jnp.asarray(v)
-
-
-def resolve_slot_lr_vec(table_conf, n_sparse_slots: int):
-    """Resolve ``SparseTableConfig.slot_learning_rates`` into a dense [S]
-    float32 vector (default lr for unmapped slots), or None when no map is
-    configured — the host half of the BoxPS LR map (reference:
-    box_wrapper.h:631 GetLRMap/SetLRMap).  Shared by the single-chip Trainer
-    and MultiChipTrainer so both paths validate identically."""
-    if not table_conf.slot_learning_rates:
-        return None
-    v = np.full(n_sparse_slots, table_conf.learning_rate, np.float32)
-    for slot, lr in table_conf.slot_learning_rates:
-        if not 0 <= slot < n_sparse_slots:
-            raise ValueError(
-                f"slot_learning_rates slot {slot} out of range "
-                f"for {n_sparse_slots} sparse slots"
-            )
-        v[slot] = lr
-    return v
+# shared per-slot policy helpers live in a leaf module (importable from
+# parallel/trainer.py without the train <-> models <-> parallel cycle);
+# re-exported here for their historical import path
+from paddlebox_tpu.train.slot_policy import (  # noqa: E402,F401
+    normalize_slot_mask,
+    resolve_slot_lr_vec,
+    slot_participation_vec,
+)
 
 
 @dataclasses.dataclass
@@ -310,6 +270,12 @@ class Trainer:
         self._eval_fn = None
         self.global_step = 0
         self.last_metric_state = None
+
+    def close(self) -> None:
+        """API parity with MultiChipTrainer.close(): the single-chip
+        trainer holds no background threads (its per-pass prefetcher is
+        closed by train_from_dataset itself), so this is a no-op —
+        TwoPhaseTrainer.close() calls it on either path."""
 
     # -- the fused step ---------------------------------------------------- #
     def _build_step(self):

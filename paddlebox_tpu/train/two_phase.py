@@ -85,12 +85,6 @@ class TwoPhaseTrainer:
         else:
             from paddlebox_tpu.parallel.trainer import MultiChipTrainer
 
-            if any(spec.use_pv for spec in phases):
-                raise NotImplementedError(
-                    "use_pv phases are single-chip for now: the PV-merged "
-                    "rank_offset feed is not plumbed through the sharded "
-                    "group planner"
-                )
             make = lambda spec, i: MultiChipTrainer(
                 spec.model, table_conf, mesh, trainer_conf,
                 seed=seed + i, slot_mask=spec.slots,

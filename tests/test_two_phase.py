@@ -202,6 +202,56 @@ def test_two_phase_multichip_matches_single_chip(synth):
         assert s8["values"][slot == s, 0].sum() > 0
 
 
+def test_two_phase_multichip_pv_join(tmp_path):
+    """The canonical production schedule ON THE MESH: a PV-merged join
+    phase (rank_offset model) then a flat update phase, per-phase PV
+    gating intact (reference: per-phase PV channels, data_feed.cc:1663,
+    in the multi-GPU workers)."""
+    import jax
+
+    from paddlebox_tpu.models import RankCtrDnn
+    from paddlebox_tpu.parallel import ShardedSparseTable, make_mesh
+
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    conf = make_synth_config(
+        n_sparse_slots=3, dense_dim=2, batch_size=8,
+        max_feasigns_per_ins=16, parse_logkey=True, enable_pv_merge=True,
+        pv_batch_size=4, rank_cmatch_filter=(222, 223),
+    )
+    files = write_synth_files(
+        str(tmp_path), n_files=2, ins_per_file=96, n_sparse_slots=3,
+        vocab_per_slot=50, dense_dim=2, seed=3, with_logkey=True,
+        max_ads_per_pv=3,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    ds.preprocess_instance()
+
+    tconf = SparseTableConfig(embedding_dim=4)
+    mesh = make_mesh(8)
+    join_model = RankCtrDnn(3, tconf.row_width, dense_dim=2, hidden=(16,),
+                            max_rank=conf.max_rank, att_out_dim=8)
+    upd_model = CtrDnn(3, tconf.row_width, dense_dim=2, hidden=(16,))
+    tp = TwoPhaseTrainer(
+        [
+            PhaseSpec("join", join_model, slots=(0, 1), use_pv=True),
+            PhaseSpec("update", upd_model, slots=(2,)),
+        ],
+        tconf, TrainerConfig(auc_buckets=1 << 10), mesh=mesh,
+    )
+    table = ShardedSparseTable(tconf, mesh, seed=0)
+    for _ in range(2):
+        table.begin_pass(ds.unique_keys())
+        m = tp.train_pass(ds, table)
+        table.end_pass()
+    assert ds.pv_mode  # the flat phase restored the PV grouping after
+    ds.close()
+    tp.close()
+    assert np.isfinite(m["join"]["loss"]) and np.isfinite(m["update"]["loss"])
+    assert m["join"]["count"] == m["update"]["count"] > 0
+
+
 def test_single_phase_matches_plain_trainer(synth):
     """A one-phase TwoPhaseTrainer with no slot mask is exactly a Trainer
     (same seed -> identical loss/auc): the phase machinery adds nothing."""
@@ -231,6 +281,7 @@ def test_single_phase_matches_plain_trainer(synth):
         m = tp.train_pass(ds, table)["only"]
         table.end_pass()
         ds.close()
+        tp.close()  # no-op on the single-chip path, must not raise
         return m
 
     a, b = run_plain(), run_phased()
