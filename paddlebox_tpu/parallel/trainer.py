@@ -30,6 +30,7 @@ overlaps the all_to_alls with the dense tower compute where possible.
 
 from __future__ import annotations
 
+import os
 from typing import Iterable, Iterator, Optional, Sequence
 
 import jax
@@ -290,6 +291,7 @@ class MultiChipTrainer:
         # aggregate feeding the CPU double buffer, boxps_worker.cc:37-297)
         sync_step = conf.sync_dense_mode in ("step", "async")
         async_dense = conf.sync_dense_mode == "async"
+        dump_preds = bool(conf.need_dump_field and conf.dump_fields_path)
         check_nan = conf.check_nan_inf
         uses_rank = getattr(model, "uses_rank_offset", False)
         uses_seq = getattr(model, "uses_seq_pos", False)
@@ -402,10 +404,16 @@ class MultiChipTrainer:
             )
             if async_dense:
                 out = out + (restack(pgrads),)
+            if dump_preds:
+                # per-instance predictions for the field dumper — an extra
+                # output only in dump mode, so the normal step never pays
+                # the readback surface (reference: DumpField runs in the
+                # production multi-GPU workers, device_worker.cc)
+                out = out + (primary[None],)
             return out
 
         spec = P(DATA_AXIS)
-        n_out = 9 if async_dense else 8
+        n_out = 8 + int(async_dense) + int(dump_preds)
         mapped = shard_map(
             body,
             mesh=self.mesh,
@@ -651,8 +659,27 @@ class MultiChipTrainer:
                     slot_lr_vec=self._slot_lr_vec, n_slots=n_slots,
                 )
                 feed = _stack_group(group, plan, n_slots, self.metric_group)
-                yield global_from_local(self._sharding, feed)
+                yield (
+                    global_from_local(self._sharding, feed),
+                    group if dumper is not None else None,
+                )
 
+        dumper = None
+        if self.conf.need_dump_field and self.conf.dump_fields_path:
+            from paddlebox_tpu.train.dump import FieldDumper
+
+            # per-process file (the reference's per-node dump discipline):
+            # each process dumps exactly its local devices' instances
+            suffix = (
+                f"-r{jax.process_index()}" if multiproc else ""
+            )
+            dumper = FieldDumper(
+                os.path.join(
+                    self.conf.dump_fields_path,
+                    f"dump-{self.global_step}{suffix}.txt",
+                ),
+                self.conf.dump_fields,
+            )
         feed_iter = produce_feeds()
         prefetcher = None
         if self.conf.prefetch_batches > 0:
@@ -663,12 +690,17 @@ class MultiChipTrainer:
             )
             feed_iter = prefetcher
         try:
-            for feed in feed_iter:
+            for feed, dump_group in feed_iter:
                 out = self._step_fn(
                     self.params, self.opt_state, values, g2sum, mstate, feed
                 )
                 (self.params, self.opt_state, values, g2sum, mstate, loss,
                  cnt, finite) = out[:8]
+                if dumper is not None:
+                    # [L, B] local predictions; pad batches dump nothing
+                    preds = local_view(out[-1])
+                    for d, b in enumerate(dump_group):
+                        dumper.dump_batch(b, np.asarray(preds[d]))
                 if async_dense:
                     # push one step BEHIND: step t's grad is already computed
                     # when step t+1 dispatches, so reading it never stalls
@@ -711,6 +743,8 @@ class MultiChipTrainer:
             table.values, table.g2sum = values, g2sum
             if prefetcher is not None:
                 prefetcher.close()
+            if dumper is not None:
+                dumper.close()
         # cross-device merge: sum each stream's histograms over the device
         # axis (multi-host: jitted replicated sum + local read,
         # collect_data_nccl analog)
