@@ -29,7 +29,9 @@ its own store slice under the same tag.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import logging
 import os
 import shutil
 import time
@@ -37,6 +39,86 @@ from typing import Any, Optional
 
 import jax
 import numpy as np
+
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.monitor import stats
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint dir failed its integrity manifest (missing, truncated,
+    or bit-flipped file).  Load refuses it; AutoCheckpointer.resume walks
+    the donefile chain back to the newest tag that still verifies."""
+
+
+# --------------------------------------------------------------------------- #
+# integrity manifests: per-file sha256 + size, written atomically with the
+# checkpoint files themselves (same tmp-dir rename), verified at load and
+# after publish.  The reference relies on HDFS block checksums for this;
+# local disk and `hadoop fs -put` round-trips get no such guarantee.
+# --------------------------------------------------------------------------- #
+def write_manifest(dirname: str, manifest_name: str) -> None:
+    """Hash every regular file in ``dirname`` (except manifests) into
+    ``dirname/manifest_name``."""
+    files = {}
+    for name in sorted(os.listdir(dirname)):
+        if name.startswith("manifest"):
+            continue
+        path = os.path.join(dirname, name)
+        if not os.path.isfile(path):
+            continue
+        with open(path, "rb") as fh:
+            data = fh.read()
+        files[name] = {
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "size": len(data),
+        }
+    with open(os.path.join(dirname, manifest_name), "w") as fh:
+        json.dump({"version": 1, "files": files}, fh)
+
+
+def verify_checkpoint_dir(dirname: str, fs=None) -> None:
+    """Check ``dirname``'s files against its manifest(s); raises
+    CheckpointCorrupt on any mismatch.  ``fs`` (an utils.fs-like object)
+    lets the caller verify a REMOTE copy through the same code path —
+    publish_checkpoint re-reads the uploaded dir this way.
+
+    A dir with no manifest at all (pre-manifest checkpoint) is accepted
+    but counted to stats as ``ckpt.unverified`` — fail-open keeps old
+    checkpoints loadable."""
+    if fs is None:
+        from paddlebox_tpu.utils.fs import LocalFS
+
+        fs = LocalFS()
+    try:
+        names = [os.path.basename(p) for p in fs.ls(dirname)]
+    except Exception as e:
+        raise CheckpointCorrupt(f"{dirname}: cannot list ({e})") from e
+    manifests = [n for n in names if n.startswith("manifest")]
+    if not manifests:
+        stats.add("ckpt.unverified")
+        return
+    for mname in manifests:
+        try:
+            manifest = json.loads(fs.cat(os.path.join(dirname, mname)))
+        except (ValueError, OSError) as e:
+            raise CheckpointCorrupt(
+                f"{dirname}/{mname}: unreadable manifest ({e})"
+            ) from e
+        for name, want in manifest.get("files", {}).items():
+            path = os.path.join(dirname, name)
+            try:
+                data = fs.cat(path)
+            except Exception as e:
+                raise CheckpointCorrupt(f"{path}: missing ({e})") from e
+            if len(data) != want["size"]:
+                raise CheckpointCorrupt(
+                    f"{path}: size {len(data)} != manifest {want['size']}"
+                )
+            if hashlib.sha256(data).hexdigest() != want["sha256"]:
+                raise CheckpointCorrupt(f"{path}: sha256 mismatch")
+    stats.add("ckpt.verified")
 
 
 # --------------------------------------------------------------------------- #
@@ -102,6 +184,14 @@ class CheckpointManager:
     def _meta_name(self) -> str:
         return f"meta-{self.shard:05d}.json" if self.n_shards > 1 else "meta.json"
 
+    def _manifest_name(self) -> str:
+        # shard-unique so concurrent shard saves into one dir never collide
+        return (
+            f"manifest-{self.shard:05d}.json"
+            if self.n_shards > 1
+            else "manifest.json"
+        )
+
     def _write(
         self,
         kind: str,
@@ -111,6 +201,7 @@ class CheckpointManager:
         opt_state: Any = None,
         meta: Optional[dict] = None,
     ) -> str:
+        faults.inject("ckpt.save")
         dirname = os.path.join(self.root, f"{kind}-{tag}")
         tmp = dirname + f".tmp-{os.getpid()}-{self.shard}"
         os.makedirs(tmp, exist_ok=True)
@@ -135,6 +226,9 @@ class CheckpointManager:
         }
         with open(os.path.join(tmp, self._meta_name()), "w") as fh:
             json.dump(full_meta, fh)
+        # integrity manifest rides the same atomic rename as the data: a
+        # checkpoint dir either has files + matching manifest or neither
+        write_manifest(tmp, self._manifest_name())
         if self.n_shards == 1:
             if os.path.exists(dirname):
                 # keep the old checkpoint alive until the new one is in
@@ -212,6 +306,49 @@ class CheckpointManager:
                     out.append(CheckpointInfo(meta["kind"], meta["tag"], dirname, meta))
         return out
 
+    def find_valid_tag(self, upto: Optional[str] = None) -> Optional[str]:
+        """Newest tag (at or before ``upto``) whose whole restore chain —
+        its base and every intervening delta — passes integrity
+        verification.  None when no loadable chain exists.  This is the
+        fallback walk AutoCheckpointer.resume uses when the newest
+        checkpoint is truncated/corrupt: recovery loses at most the passes
+        after the last intact tag instead of the whole job."""
+        ckpts = self.list_checkpoints()
+        if upto is not None:
+            keep = []
+            for c in ckpts:
+                keep.append(c)
+                if c.tag == upto:
+                    break
+            # an upto tag missing from the donefile (its save never
+            # completed) just means "newest available": keep everything
+            ckpts = keep if any(c.tag == upto for c in keep) else ckpts
+        verdict: dict[str, bool] = {}  # dirname -> verified ok
+
+        def ok(c: CheckpointInfo) -> bool:
+            v = verdict.get(c.dirname)
+            if v is None:
+                try:
+                    verify_checkpoint_dir(c.dirname)
+                    v = True
+                except CheckpointCorrupt as e:
+                    logger.warning("checkpoint %s corrupt: %s", c.dirname, e)
+                    v = False
+                verdict[c.dirname] = v
+            return v
+
+        for end in range(len(ckpts) - 1, -1, -1):
+            sub = ckpts[: end + 1]
+            base_i = max(
+                (i for i, c in enumerate(sub) if c.kind == "base"),
+                default=None,
+            )
+            if base_i is None:
+                continue
+            if all(ok(c) for c in sub[base_i:]):
+                return sub[-1].tag
+        return None
+
     def load(
         self,
         table,
@@ -221,8 +358,12 @@ class CheckpointManager:
     ):
         """Restore the latest base plus all following deltas (optionally
         stopping at tag ``upto``).  Returns (params, opt_state, meta) — None
-        for pytrees without a template or file.  Reference:
-        InitializeGPUAndLoadModel (box_wrapper.cc:1329)."""
+        for pytrees without a template or file.  Every dir in the restore
+        chain is verified against its integrity manifest first (a truncated
+        file raises CheckpointCorrupt here, not a cryptic npz error mid-
+        restore).  Reference: InitializeGPUAndLoadModel
+        (box_wrapper.cc:1329)."""
+        faults.inject("ckpt.load")
         ckpts = self.list_checkpoints()
         if upto is not None:
             keep, found = [], False
@@ -240,6 +381,8 @@ class CheckpointManager:
         if base_i is None:
             raise FileNotFoundError(f"no base checkpoint under {self.root}")
         chain = ckpts[base_i:]
+        for c in chain:
+            verify_checkpoint_dir(c.dirname)
         sparse_name = self._sparse_name()
         with np.load(os.path.join(chain[0].dirname, sparse_name)) as d:
             table.load_state_dict({"keys": d["keys"], "values": d["values"]})

@@ -46,6 +46,18 @@ class _Flags:
         "auc_runner_mode": False,
         # preferred device compute dtype for dense towers
         "compute_dtype": "float32",
+        # unified retry/backoff defaults (utils/retry.py) — every transient-
+        # failure site (hadoop commands, publish uploads, data reads) uses
+        # these unless the caller passes an explicit RetryPolicy.  The
+        # reference hard-codes equivalent knobs per site in fs.cc/fleet_util.
+        "retry_max_attempts": 3,
+        "retry_base_delay_s": 1.0,
+        "retry_max_delay_s": 5.0,
+        # fault-injection plan (utils/faults.py): ';'-separated
+        # "site=spec" list, e.g. "fs.upload=first:2;data.read=p:0.01";
+        # empty = no injection.  Seed makes probabilistic specs replayable.
+        "fault_plan": "",
+        "fault_seed": 0,
     }
 
     def __getattr__(self, name: str):
@@ -134,6 +146,18 @@ class DataFeedConfig:
     # sequence-parallel tower (models/longseq_ctr.py).
     sequence_slot: str = ""
     max_seq_len: int = 64
+
+    # malformed-line policy (reference: the MultiSlot parser CHECKs and
+    # aborts; production daily logs carry occasional corrupt lines, so the
+    # trainer must be able to quarantine instead of dying):
+    #   "raise" — any malformed line aborts the read (strict, the default)
+    #   "skip"  — drop the line, count it (stats "data.quarantined_lines" /
+    #             "data.quarantined_files"), keep parsing
+    malformed_policy: str = "raise"
+    # with malformed_policy="skip": abort the pass anyway when more than
+    # this fraction of input lines was quarantined — pervasive corruption
+    # is an upstream incident, not line noise to skip past
+    quarantine_abort_frac: float = 0.01
 
     # fixed device-batch capacities (XLA static shapes): max total feasigns per
     # batch per sparse slot group.  Host feed pads/clips to these.
@@ -230,6 +254,16 @@ class DataFeedConfig:
         return sum(int(math.prod(s.shape)) for s in self.dense_slots())
 
     def __post_init__(self):
+        if self.malformed_policy not in ("raise", "skip"):
+            raise ValueError(
+                f"malformed_policy must be 'raise' or 'skip', "
+                f"got {self.malformed_policy!r}"
+            )
+        if not 0.0 <= self.quarantine_abort_frac <= 1.0:
+            raise ValueError(
+                "quarantine_abort_frac must be in [0, 1], "
+                f"got {self.quarantine_abort_frac}"
+            )
         seen = set()
         for s in self.slots:
             if s.name in seen:
@@ -374,6 +408,22 @@ class TrainerConfig:
     compute_dtype: str = ""
     # nan check after each batch (reference: FLAGS_check_nan_inf)
     check_nan_inf: bool = False
+    # what a non-finite loss/grad does to the pass (any value other than
+    # "raise" implies the per-batch finiteness check even when
+    # check_nan_inf is off):
+    #   "raise"      — FloatingPointError aborts the pass (the reference's
+    #                  FLAGS_check_nan_inf behavior)
+    #   "skip_batch" — the offending batch's updates AND metric
+    #                  contributions are discarded on-device (the step
+    #                  returns the pre-batch state) and training continues;
+    #                  counted to stats as train.nan_skipped_steps /
+    #                  train.nan_skipped_ins
+    #   "rollback"   — the pass aborts, and if an AutoCheckpointer is
+    #                  attached (trainer.checkpointer) the table + dense
+    #                  state are restored to the last completed pass;
+    #                  train_from_dataset raises PassRolledBack so the
+    #                  driver re-runs from there
+    nan_policy: str = "raise"
     # device-feed double buffering: a background thread runs key planning +
     # host->device transfer for the next batches while the current step
     # computes, bounded at this queue depth (the pinned-arena/double-buffered

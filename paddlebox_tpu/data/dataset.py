@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import concurrent.futures as futures
 import dataclasses
+import logging
 import os
 import threading
 from typing import Iterator, Optional, Sequence
@@ -31,7 +32,11 @@ from paddlebox_tpu.config import DataFeedConfig, flags
 from paddlebox_tpu.data.feed import BatchBuilder, HostBatch
 from paddlebox_tpu.data.record import RecordBlock
 from paddlebox_tpu.data.slot_parser import SlotParser
+from paddlebox_tpu.utils.monitor import stats
+from paddlebox_tpu.utils.retry import retry_call
 from paddlebox_tpu.utils.timer import Timer
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -71,12 +76,36 @@ class PadBoxSlotDataset:
         self.date = date
 
     # -- load ----------------------------------------------------------- #
+    def _parse_with_retry(self, path: str) -> RecordBlock:
+        """One file read through the unified retry helper: transient fs
+        failures (OSError, a failed `hadoop fs -cat` pipe) retry; parse
+        errors (ValueError) never do."""
+        return retry_call(self.parser.parse_file, path, site="data.read")
+
+    def _check_quarantine(self, q0: int, p0: int) -> None:
+        """Abort the load when the quarantined fraction of this load's
+        lines exceeds the configured threshold — pervasive corruption is
+        an upstream incident, not line noise to skip past."""
+        q = self.parser.quarantined_lines - q0
+        total = q + (self.parser.parsed_lines - p0)
+        limit = self.conf.quarantine_abort_frac
+        if q and total and q / total > limit:
+            stats.add("data.quarantine_aborts")
+            raise RuntimeError(
+                f"pass aborted: {q}/{total} input lines ({q / total:.2%}) "
+                f"quarantined, over quarantine_abort_frac={limit:.2%}"
+            )
+
     def _read_all(self) -> RecordBlock:
         self.read_timer.resume()
         try:
             if not self.filelist:
                 raise RuntimeError("set_filelist before loading")
-            blocks = list(self._pool.map(self.parser.parse_file, self.filelist))
+            q0, p0 = self.parser.quarantined_lines, self.parser.parsed_lines
+            blocks = list(
+                self._pool.map(self._parse_with_retry, self.filelist)
+            )
+            self._check_quarantine(q0, p0)
             block = RecordBlock.concat(blocks)
             if self.shuffler is not None:
                 block = self.shuffler.exchange(block)
@@ -119,10 +148,12 @@ class PadBoxSlotDataset:
             os.makedirs(spill_dir, exist_ok=True)
             if not self.filelist:
                 raise RuntimeError("set_filelist before loading")
+            q0, p0 = self.parser.quarantined_lines, self.parser.parsed_lines
             if self.shuffler is not None:
                 blocks = list(
-                    self._pool.map(self.parser.parse_file, self.filelist)
+                    self._pool.map(self._parse_with_retry, self.filelist)
                 )
+                self._check_quarantine(q0, p0)
                 block = RecordBlock.concat(blocks)
                 block = self.shuffler.exchange(block)
                 # chunk the exchanged pass so train-time _disk_batches
@@ -161,7 +192,7 @@ class PadBoxSlotDataset:
                 # the in-flight window, never the whole pass
 
             for f in self.filelist:
-                inflight.append(self._pool.submit(self.parser.parse_file, f))
+                inflight.append(self._pool.submit(self._parse_with_retry, f))
                 self.spill_peak_inflight = max(
                     self.spill_peak_inflight, len(inflight)
                 )
@@ -169,6 +200,7 @@ class PadBoxSlotDataset:
                     drain_one()
             while inflight:
                 drain_one()
+            self._check_quarantine(q0, p0)
             uniq = (
                 np.unique(np.concatenate(key_chunks))
                 if key_chunks
@@ -204,11 +236,21 @@ class PadBoxSlotDataset:
         self._block = None
         self._order = None
         if self._spill is not None:
+            logged = False
             for p in self._spill.paths:
                 try:
                     os.remove(p)
-                except OSError:
-                    pass
+                except OSError as e:
+                    # leaked spill files silently eat local disk across
+                    # day-scale runs: count every failure, log the first
+                    stats.add("dataset.spill_rm_failed")
+                    if not logged:
+                        logged = True
+                        logger.warning(
+                            "failed to remove spill file %s: %s "
+                            "(further failures counted to "
+                            "dataset.spill_rm_failed only)", p, e,
+                        )
             self._spill = None
 
     def close(self) -> None:

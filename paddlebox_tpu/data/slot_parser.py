@@ -26,12 +26,18 @@ Disable via PBOX_USE_NATIVE_PARSER=0.
 from __future__ import annotations
 
 import gzip
+import logging
 import subprocess
+import threading
 from typing import Iterable, Optional
 
 import numpy as np
 
 from paddlebox_tpu.config import DataFeedConfig, flags
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.monitor import stats
+
+logger = logging.getLogger(__name__)
 
 
 class SlotParser:
@@ -69,6 +75,14 @@ class SlotParser:
         self.n_sparse = len(self.sparse_slots)
         self._native = None
         self._native_tried = False
+        # bad-input quarantine accounting (malformed_policy="skip"):
+        # instance counters survive across files so the dataset can apply
+        # its abort threshold over a whole load; parse_file runs in reader
+        # threads, hence the lock
+        self._quar_lock = threading.Lock()
+        self.quarantined_lines = 0
+        self.quarantined_files = 0
+        self.parsed_lines = 0
 
     def _native_parser(self):
         """Build/load the C++ parser lazily; None when unavailable."""
@@ -76,6 +90,11 @@ class SlotParser:
             return self._native
         self._native_tried = True
         if not flags.use_native_parser:
+            return None
+        if self.conf.malformed_policy != "raise":
+            # the native parser aborts on the first malformed line; the
+            # quarantine walk (skip + count + rollback of partial appends)
+            # lives in the Python parser only
             return None
         try:
             from paddlebox_tpu._native import NativeParser
@@ -136,21 +155,51 @@ class SlotParser:
         ranks: Optional[list[int]] = [] if conf.parse_logkey else None
         cmatches: Optional[list[int]] = [] if conf.parse_logkey else None
 
+        skip_malformed = conf.malformed_policy == "skip"
+        acc = (keys, offsets, dense_rows, task_rows, labels,
+               ins_ids, search_ids, ranks, cmatches)
         n_ins = 0
+        n_skipped = 0
+        first_bad: Optional[str] = None
         for lineno, line in enumerate(lines, start=1):
             toks = line.split()
             if not toks:
                 continue
+            marks = [len(a) for a in acc if a is not None]
             try:
                 p = self._parse_one(
                     toks, keys, offsets, dense_rows, task_rows, labels,
                     ins_ids, search_ids, ranks, cmatches,
                 )
             except (IndexError, ValueError) as e:
-                raise ValueError(
-                    f"{path}:{lineno}: malformed instance ({e})"
-                ) from e
+                if not skip_malformed:
+                    raise ValueError(
+                        f"{path}:{lineno}: malformed instance ({e})"
+                    ) from e
+                # quarantine: roll back the partial appends _parse_one made
+                # before it hit the bad token, count, move on
+                for a, m in zip((a for a in acc if a is not None), marks):
+                    del a[m:]
+                n_skipped += 1
+                if first_bad is None:
+                    first_bad = f"{path}:{lineno}: {e}"
+                continue
             n_ins += 1
+
+        with self._quar_lock:
+            self.parsed_lines += n_ins
+            if n_skipped:
+                self.quarantined_lines += n_skipped
+                self.quarantined_files += 1
+        if n_skipped:
+            stats.add("data.quarantined_lines", n_skipped)
+            stats.add("data.quarantined_files")
+            # one line per file, not per bad line: daily logs can carry
+            # thousands of corrupt lines without flooding the log
+            logger.warning(
+                "quarantined %d malformed line(s) in %s (first: %s)",
+                n_skipped, path, first_bad,
+            )
 
         return RecordBlock(
             n_ins=n_ins,
@@ -244,7 +293,12 @@ class SlotParser:
         .gz input streams in bounded chunks (line-by-line for the Python
         parser, 64MB line-aligned chunks for the native one) — the whole
         decompressed shard is never held at once.
+
+        Transient read failures (OSError, a failed pipe_command — typically
+        ``hadoop fs -cat`` hiccups) raise retryable errors; the dataset
+        wraps this call in utils.retry at site "data.read".
         """
+        faults.inject("data.read")
         native = self._native_parser()
         if self.conf.pipe_command:
             with open(path, "rb") as src:
@@ -268,7 +322,11 @@ class SlotParser:
                     proc.stdout.close()
                     ret = proc.wait()
                 if ret != 0:
-                    raise RuntimeError(
+                    # FsError: a failed pipe (usually a remote cat) is the
+                    # transient class — retryable, unlike a parse error
+                    from paddlebox_tpu.utils.fs import FsError
+
+                    raise FsError(
                         f"pipe_command {self.conf.pipe_command!r} on {path} "
                         f"exited {ret}"
                     )

@@ -22,6 +22,7 @@ HTTP (see examples/serve_client.cpp for a ~100-line C++ one).
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -162,6 +163,9 @@ class ScoringServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    # liveness + readiness: 200 only when at least one
+                    # model is registered and scorable — a rolling deploy
+                    # probes this before routing traffic
                     with server._meta_lock:
                         models = {
                             n: {"requests": e.requests,
@@ -170,7 +174,11 @@ class ScoringServer:
                                 "n_features": e.predictor.n_features}
                             for n, e in server._models.items()
                         }
-                    self._send(200, {"ok": True, "models": models})
+                    ready = bool(models)
+                    self._send(
+                        200 if ready else 503,
+                        {"ok": ready, "ready": ready, "models": models},
+                    )
                 elif self.path == "/models":
                     self._send(200, {"models": server.model_names(),
                                      "default": server._default})
@@ -196,8 +204,19 @@ class ScoringServer:
                     self._send(200, {"scores": scores})
                 except KeyError:
                     self._send(404, {"error": f"unknown model {name!r}"})
-                except Exception as e:  # bad input must not kill the server
+                except (ValueError, UnicodeDecodeError) as e:
+                    # the client's fault: malformed slot-text / encoding —
+                    # parse errors surface as ValueError from the same
+                    # parser training uses
                     self._send(400, {"error": repr(e)[:300]})
+                except Exception as e:
+                    # OUR fault (predictor/runtime failure): distinguishable
+                    # from bad input so callers alert on 5xx, and the
+                    # server itself survives either way
+                    logging.getLogger(__name__).exception(
+                        "internal error scoring %s", self.path
+                    )
+                    self._send(500, {"error": repr(e)[:300]})
 
             def log_message(self, *a):  # quiet by default
                 pass

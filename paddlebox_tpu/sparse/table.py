@@ -214,6 +214,23 @@ class SparseTable:
         self._pass_keys = None
         self._in_pass = False
 
+    def abort_pass(self) -> None:
+        """Discard the in-flight working set WITHOUT merging it back — the
+        rollback path for a pass poisoned by non-finite updates
+        (TrainerConfig.nan_policy="rollback").  The host store keeps the
+        last completed pass's state; the aborted pass's delta-tracker entry
+        (appended by begin_pass) is removed since nothing of it persisted.
+        No-op when no pass is open."""
+        if not self._in_pass:
+            return
+        self.values = None
+        self.g2sum = None
+        self._census_index = None  # dropped, not closed — see end_pass
+        self._pass_keys = None
+        self._in_pass = False
+        if self._delta_keys:
+            self._delta_keys.pop()
+
     def _merge_into_store(self, keys: np.ndarray, vals: np.ndarray) -> None:
         """Write back rows for sorted unique ``keys`` (existing rows update
         in place; buckets with new keys rebuild — see sparse/store.py)."""

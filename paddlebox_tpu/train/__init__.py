@@ -1,11 +1,18 @@
 """Training runtime (SURVEY.md §2.5 analog)."""
 
 from paddlebox_tpu.train.auto_checkpoint import AutoCheckpointer
-from paddlebox_tpu.train.trainer import Trainer, TrainState
+from paddlebox_tpu.train.trainer import (
+    NonFiniteBatchError,
+    PassRolledBack,
+    Trainer,
+    TrainState,
+)
 from paddlebox_tpu.train.two_phase import PhaseSpec, TwoPhaseTrainer
 
 __all__ = [
     "AutoCheckpointer",
+    "NonFiniteBatchError",
+    "PassRolledBack",
     "PhaseSpec",
     "Trainer",
     "TrainState",

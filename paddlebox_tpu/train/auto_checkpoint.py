@@ -24,13 +24,22 @@ exactly (tested in tests/test_auto_checkpoint.py).
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
-from paddlebox_tpu.checkpoint import CheckpointManager, load_pytree, save_pytree
+from paddlebox_tpu.checkpoint import (
+    CheckpointCorrupt,
+    CheckpointManager,
+    load_pytree,
+    save_pytree,
+)
+from paddlebox_tpu.utils.monitor import stats
+
+logger = logging.getLogger(__name__)
 
 
 class AutoCheckpointer:
@@ -80,7 +89,11 @@ class AutoCheckpointer:
         """
         params, opt_state = trainer.dense_state()
         tag = f"{self.job_id}-p{pass_index:06d}"
+        # global_step rides the checkpoint meta (not just the status file)
+        # so a FALLBACK resume to an older tag can still restore the step
+        # counter that belongs to that pass
         meta = {"pass_index": pass_index, "file_cursor": file_cursor,
+                "global_step": int(getattr(trainer, "global_step", 0)),
                 **(extra or {})}
         if pass_index % self.base_every == 0:
             self.ckpt.save_base(tag, table, params, opt_state, meta=meta)
@@ -118,15 +131,48 @@ class AutoCheckpointer:
         """Restore table + dense + (optionally) metric state from the last
         recorded pass.  Returns (status dict, metric_state or None), or
         (None, None) for a fresh job (reference: TrainEpochRange restores
-        epoch_no and checkpoint_epoch_no for the job id)."""
+        epoch_no and checkpoint_epoch_no for the job id).
+
+        When the newest checkpoint is corrupt/truncated (integrity manifest
+        mismatch), resume walks the donefile chain back to the newest tag
+        that still fully verifies and restores THAT pass instead: the
+        returned status carries the older next_pass/file_cursor (rebuilt
+        from the checkpoint's own meta) plus ``"fallback": True``, and the
+        metric-state snapshot — which belongs to the newer, lost pass — is
+        dropped.  The driver replays from there; with a deterministic
+        pipeline the replay reproduces the lost passes exactly."""
         status = self.status()
         if status is None:
             return None, None
         params_t, opt_t = trainer.params, trainer.opt_state
-        params, opt_state, _meta = self.ckpt.load(
-            table, params_t, opt_t, upto=status["tag"]
+        tag = status["tag"]
+        valid_tag = self.ckpt.find_valid_tag(upto=tag)
+        if valid_tag is None:
+            raise CheckpointCorrupt(
+                f"no valid checkpoint chain under {self.root} for job "
+                f"{self.job_id!r} (status tag {tag!r})"
+            )
+        params, opt_state, meta = self.ckpt.load(
+            table, params_t, opt_t, upto=valid_tag
         )
         trainer.load_dense_state(params, opt_state)
+        if valid_tag != tag:
+            stats.add("ckpt.resume_fallback")
+            logger.warning(
+                "checkpoint tag %r failed verification; falling back to "
+                "newest valid tag %r (replaying pass %s onward)",
+                tag, valid_tag, meta.get("pass_index", "?"),
+            )
+            status = {
+                "job_id": self.job_id,
+                "next_pass": int(meta.get("pass_index", -1)) + 1,
+                "file_cursor": int(meta.get("file_cursor", 0)),
+                "global_step": int(meta.get("global_step", 0)),
+                "tag": valid_tag,
+                "fallback": True,
+            }
+            trainer.global_step = status["global_step"]
+            return status, None
         trainer.global_step = int(status.get("global_step", 0))
         mstate = None
         if metric_template is not None and os.path.exists(self._mstate_path()):

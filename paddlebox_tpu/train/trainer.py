@@ -36,6 +36,28 @@ from paddlebox_tpu.metrics.auc import (
 from paddlebox_tpu.metrics.variants import MetricGroup
 from paddlebox_tpu.models.layers import bce_with_logits
 from paddlebox_tpu.sparse.table import SparseTable, pull_rows, push_and_update
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.monitor import stats
+
+
+class NonFiniteBatchError(FloatingPointError):
+    """A batch produced a non-finite loss/grad and the nan_policy did not
+    absorb it (policy "raise", or "rollback" before the restore)."""
+
+
+class PassRolledBack(RuntimeError):
+    """nan_policy="rollback" fired: the in-flight pass was aborted and the
+    table + dense state were restored to the last completed pass via the
+    attached AutoCheckpointer.  ``status`` is the restored status dict —
+    the driver re-runs from ``status["next_pass"]`` and must NOT call
+    table.end_pass() for the aborted pass (it was already discarded)."""
+
+    def __init__(self, status: dict):
+        super().__init__(
+            f"pass rolled back to checkpoint tag {status['tag']!r}; "
+            f"re-run from pass {status['next_pass']}"
+        )
+        self.status = status
 
 
 # shared per-slot policy helpers live in a leaf module (importable from
@@ -140,6 +162,11 @@ def _device_batch(
     return _to_device(_host_batch_dict(batch, plan, n_slots, counter_label_tasks))
 
 
+# how long close() waits for the producer thread before declaring it stuck
+# (module-level so chaos tests can shrink it)
+_PREFETCH_JOIN_S = 5.0
+
+
 class _FeedPrefetcher:
     """Bounded background feed assembly: the producer thread runs host key
     planning + H2D staging up to ``depth`` batches ahead of the consumer
@@ -204,11 +231,13 @@ class _FeedPrefetcher:
                 self._q.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=5.0)
+        self._thread.join(timeout=_PREFETCH_JOIN_S)
         if self._thread.is_alive():
             # the producer is stuck in planning/H2D staging; it will exit at
             # its next _stop check, but make the leak visible instead of
-            # silent (advisor r3)
+            # silent (advisor r3) — and countable, so chaos tests can assert
+            # a stuck producer was detected rather than scraping logs
+            stats.add("trainer.prefetch_close_timeout")
             logging.getLogger(__name__).warning(
                 "feed-prefetch producer did not exit within 5s of close(); "
                 "daemon thread will retire at its next stop check"
@@ -262,6 +291,14 @@ class Trainer:
             self.optimizer = optax.sgd(self.conf.dense_lr)
         else:
             raise ValueError(f"unknown dense optimizer {self.conf.dense_optimizer!r}")
+        if self.conf.nan_policy not in ("raise", "skip_batch", "rollback"):
+            raise ValueError(
+                f"unknown nan_policy {self.conf.nan_policy!r} "
+                "(want raise | skip_batch | rollback)"
+            )
+        # AutoCheckpointer for nan_policy="rollback" (assign after
+        # construction); without one, rollback degrades to raise
+        self.checkpointer = None
         self.params = model.init(jax.random.PRNGKey(seed))
         self.opt_state = self.optimizer.init(self.params)
         self._step_fn = None
@@ -277,12 +314,18 @@ class Trainer:
         closed by train_from_dataset itself), so this is a no-op —
         TwoPhaseTrainer.close() calls it on either path."""
 
+    @property
+    def _check_nan(self) -> bool:
+        """Per-batch finiteness check: explicit flag, or implied by any
+        nan_policy that must SEE the flag to act on it."""
+        return self.conf.check_nan_inf or self.conf.nan_policy != "raise"
+
     # -- the fused step ---------------------------------------------------- #
     def _build_step(self):
         model = self.model
         tconf = self.table_conf
         optimizer = self.optimizer
-        check_nan = self.conf.check_nan_inf
+        check_nan = self._check_nan
         uses_rank = getattr(model, "uses_rank_offset", False)
         uses_seq = getattr(model, "uses_seq_pos", False)
         n_tasks = self.n_tasks
@@ -377,6 +420,24 @@ class Trainer:
             return params, opt_state, values, g2sum, mstate, loss, finite, primary
 
         self._step_body = step
+        if check_nan and self.conf.nan_policy == "skip_batch":
+            # skip_batch must discard the bad batch's updates, but the step
+            # donates its state buffers — so the decision lives ON DEVICE:
+            # run the body, then select pre- or post-batch state on the
+            # finite flag.  The skipped batch contributes neither updates
+            # nor metric counts; the host only observes finite=False.
+            body = step
+
+            def guarded(params, opt_state, values, g2sum, mstate, batch):
+                out = body(params, opt_state, values, g2sum, mstate, batch)
+                new_state, (loss, finite, primary) = out[:5], out[5:]
+                old_state = (params, opt_state, values, g2sum, mstate)
+                state = jax.lax.cond(
+                    finite, lambda _: new_state, lambda _: old_state, None
+                )
+                return (*state, loss, finite, primary)
+
+            return jax.jit(guarded, donate_argnums=(0, 1, 2, 3, 4))
         return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
 
     def _build_scan_step(self):
@@ -387,7 +448,8 @@ class Trainer:
         program once; preds/dump are unavailable (use scan_steps=1 when
         dumping)."""
         body = self._step_body
-        check_nan = self.conf.check_nan_inf
+        check_nan = self._check_nan
+        skip_mode = check_nan and self.conf.nan_policy == "skip_batch"
 
         def scan_fn(params, opt_state, values, g2sum, mstate, feeds):
             def tick(carry, feed):
@@ -396,7 +458,23 @@ class Trainer:
                     p, o, v, g, m, loss, finite, _ = body(p, o, v, g, m, feed)
                     return ((p, o, v, g, m), ok & finite), (loss, finite)
 
-                # with check_nan_inf on, a NaN at tick j must not let ticks
+                if skip_mode:
+                    # each tick independently discards its own batch when
+                    # non-finite (state passes through untouched) and later
+                    # ticks proceed normally — the scan analog of the
+                    # guarded single step
+                    np_, no_, nv_, ng_, nm_, loss, finite, _ = body(
+                        p, o, v, g, m, feed
+                    )
+                    state = jax.lax.cond(
+                        finite,
+                        lambda _: (np_, no_, nv_, ng_, nm_),
+                        lambda _: (p, o, v, g, m),
+                        None,
+                    )
+                    return (state, ok), (loss.astype(jnp.float32), finite)
+
+                # with a raising policy, a NaN at tick j must not let ticks
                 # j+1..k-1 keep applying corrupted dense/sparse updates
                 # before the host sees the flag (advisor r3): once ok goes
                 # False the remaining ticks pass state through untouched
@@ -427,8 +505,7 @@ class Trainer:
                 feeds,
             )
             return (
-                params, opt_state, values, g2sum, mstate, losses,
-                finites.all(),
+                params, opt_state, values, g2sum, mstate, losses, finites,
             )
 
         return jax.jit(scan_fn, donate_argnums=(0, 1, 2, 3, 4))
@@ -473,6 +550,30 @@ class Trainer:
         if opt_state is not None:
             self.opt_state = opt_state
 
+    def _rollback_to_checkpoint(self, table) -> None:
+        """nan_policy="rollback": abort the poisoned pass and restore the
+        last completed pass from the attached AutoCheckpointer, then raise
+        PassRolledBack.  Falls through (returning) when no checkpointer is
+        attached or no pass ever completed — the caller re-raises the
+        original NonFiniteBatchError."""
+        acp = self.checkpointer
+        if acp is None:
+            logging.getLogger(__name__).warning(
+                "nan_policy='rollback' but no checkpointer attached "
+                "(set trainer.checkpointer) — raising instead"
+            )
+            return
+        if acp.status() is None:
+            logging.getLogger(__name__).warning(
+                "nan_policy='rollback' but no completed pass recorded — "
+                "raising instead"
+            )
+            return
+        table.abort_pass()
+        status, _ = acp.resume(table, self)
+        stats.add("train.nan_rollback")
+        raise PassRolledBack(status)
+
     # -- public API --------------------------------------------------------- #
     def train_from_dataset(
         self,
@@ -485,6 +586,12 @@ class Trainer:
 
         The caller owns the pass lifecycle: table.begin_pass() before,
         table.end_pass() after.  Returns the pass metrics.
+
+        Non-finite batches follow TrainerConfig.nan_policy: "raise" aborts
+        (NonFiniteBatchError), "skip_batch" discards the batch on device
+        and continues, "rollback" (with trainer.checkpointer set) restores
+        the last completed pass and raises PassRolledBack — in that one
+        case the pass was aborted and the caller must skip end_pass().
         """
         if self._step_fn is None:
             self._step_fn = self._build_step()
@@ -557,6 +664,11 @@ class Trainer:
                     )
                     if self.metric_group is not None:
                         host["metric_masks"] = self.metric_group.masks(batch)
+                if faults.fire("train.nan"):
+                    # chaos injection: poison this batch's labels so the
+                    # loss/grads genuinely go NaN and the configured
+                    # nan_policy is exercised end to end on device
+                    host["labels"] = np.full_like(host["labels"], np.nan)
                 yield batch, host
 
         def feeds():
@@ -597,18 +709,33 @@ class Trainer:
         else:
             feed_iter = feeds()
 
+        check_nan = self._check_nan
+        skip_batches = check_nan and self.conf.nan_policy == "skip_batch"
         try:
-          with device_trace(self.conf.trace_dir or None):
-            for kind, batch, dev in feed_iter:
+          try:
+            with device_trace(self.conf.trace_dir or None):
+              for kind, batch, dev in feed_iter:
                 if kind == "scan":
                     (self.params, self.opt_state, values, g2sum, mstate,
-                     loss_k, finite) = (
+                     loss_k, finites) = (
                         self._scan_fn(self.params, self.opt_state, values,
                                       g2sum, mstate, dev)
                     )
                     k = int(loss_k.shape[0])
-                    if self.conf.check_nan_inf and not bool(finite):
-                        raise FloatingPointError(
+                    fin = np.asarray(finites)
+                    if check_nan and not fin.all():
+                        if skip_batches:
+                            # bad ticks already kept pre-batch state on
+                            # device; account for them and keep going
+                            n_bad = int((~fin).sum())
+                            stats.add("train.nan_skipped_steps", n_bad)
+                            good = np.nonzero(fin)[0]
+                            if good.size:
+                                losses.append(loss_k[good])
+                            n_steps += k - n_bad
+                            self.global_step += k - n_bad
+                            continue
+                        raise NonFiniteBatchError(
                             f"non-finite loss/grad within steps "
                             f"{self.global_step}..{self.global_step + k - 1} "
                             "(FLAGS_check_nan_inf analog)"
@@ -626,8 +753,19 @@ class Trainer:
                     if prof.enabled:
                         loss.block_until_ready()  # sync for honest timing
                 prof.step_done()
-                if self.conf.check_nan_inf and not bool(finite):
-                    raise FloatingPointError(
+                if check_nan and not bool(finite):
+                    if skip_batches:
+                        # the guarded step already returned the pre-batch
+                        # state: this batch contributed nothing — no
+                        # update, no metrics, no dump, no step count
+                        stats.add("train.nan_skipped_steps")
+                        if batch is not None:
+                            stats.add(
+                                "train.nan_skipped_ins",
+                                float(batch.ins_mask.sum()),
+                            )
+                        continue
+                    raise NonFiniteBatchError(
                         f"non-finite loss/grad at step {self.global_step} "
                         "(FLAGS_check_nan_inf analog)"
                     )
@@ -637,7 +775,7 @@ class Trainer:
                 losses.append(loss)  # device scalars; synced once at pass end
                 n_steps += 1
                 self.global_step += 1
-        finally:
+          finally:
             # old buffers were donated to the jitted step: always hand the
             # live ones back so end_pass() works even after a NaN raise
             table.values, table.g2sum = values, g2sum
@@ -645,6 +783,10 @@ class Trainer:
                 prefetcher.close()
             if dumper is not None:
                 dumper.close()
+        except NonFiniteBatchError:
+            if self.conf.nan_policy == "rollback":
+                self._rollback_to_checkpoint(table)  # raises PassRolledBack
+            raise
         if self.conf.need_dump_param and self.conf.dump_fields_path:
             from paddlebox_tpu.train.dump import dump_params
 

@@ -1,0 +1,194 @@
+"""Deterministic, seeded fault injection for chaos testing.
+
+Production recovery paths (hadoop retries, checkpoint fallback, bad-batch
+skipping) are exactly the code that never runs in a clean test environment.
+This registry makes them exercisable on demand: a ``FaultPlan`` maps site
+names (the same names ``utils.retry`` uses for stats) to a failure spec, and
+each instrumented site calls ``inject(site)`` — a no-op unless a plan is
+active and the spec says this hit fails.
+
+Spec forms (string or FaultSpec):
+
+    "first:N"      fail the first N hits of the site, then succeed — the
+                   transient-failure shape retry loops must absorb
+    "at:3,7"       fail exactly hits 3 and 7 (0-based) — e.g. one NaN batch
+                   mid-pass
+    "p:0.05"       fail each hit with probability 0.05, drawn from a
+                   per-site stream seeded by (plan seed, site) — the same
+                   plan + seed always fails the same hits
+
+Activation: programmatic (``install(plan)`` / the ``fault_plan`` context
+manager in tests) or environmental — ``PBOX_FAULT_PLAN`` holds a
+';'-separated spec list ("fs.upload=first:2;data.read=p:0.01") and
+``PBOX_FAULT_SEED`` the seed, so a chaos run needs no code change.
+
+Site names may end in '*' to match a prefix ("fs.*").  Every injected fault
+counts to ``stats`` as ``faults.injected.<site>``; every check counts as
+``faults.checked.<site>`` so a chaos test can assert its sites were actually
+reached.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import zlib
+from typing import Dict, Optional, Union
+
+from paddlebox_tpu.utils.monitor import stats
+from paddlebox_tpu.utils.retry import register_retryable
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an injection site the active plan told to fail."""
+
+
+# injected faults model transient infrastructure failures: retry loops
+# must treat them exactly like the real thing
+register_retryable(FaultInjected)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    fail_first: int = 0  # fail hits 0..fail_first-1
+    at: tuple = ()  # fail exactly these hit indices (0-based)
+    probability: float = 0.0  # additionally fail each hit with this p
+
+    @staticmethod
+    def parse(text: str) -> "FaultSpec":
+        kind, _, arg = text.partition(":")
+        if kind == "first":
+            return FaultSpec(fail_first=int(arg))
+        if kind == "at":
+            return FaultSpec(at=tuple(int(x) for x in arg.split(",") if x))
+        if kind == "p":
+            return FaultSpec(probability=float(arg))
+        raise ValueError(f"bad fault spec {text!r} (want first:N|at:I,J|p:F)")
+
+
+class FaultPlan:
+    """Site -> FaultSpec map with deterministic per-site hit counting."""
+
+    def __init__(
+        self,
+        sites: Dict[str, Union[str, FaultSpec]],
+        seed: int = 0,
+    ):
+        self.seed = int(seed)
+        self.sites: Dict[str, FaultSpec] = {
+            name: spec if isinstance(spec, FaultSpec) else FaultSpec.parse(spec)
+            for name, spec in sites.items()
+        }
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self._rngs: Dict[str, random.Random] = {}
+
+    @staticmethod
+    def from_env() -> Optional["FaultPlan"]:
+        from paddlebox_tpu.config import flags
+
+        text = flags.fault_plan
+        if not text:
+            return None
+        sites = {}
+        for part in text.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, spec = part.partition("=")
+            sites[name.strip()] = spec.strip()
+        return FaultPlan(sites, seed=flags.fault_seed)
+
+    def _spec_for(self, site: str) -> Optional[FaultSpec]:
+        spec = self.sites.get(site)
+        if spec is not None:
+            return spec
+        for name, s in self.sites.items():
+            if name.endswith("*") and site.startswith(name[:-1]):
+                return s
+        return None
+
+    def check(self, site: str) -> bool:
+        """One hit of ``site``; True = this hit must fail."""
+        spec = self._spec_for(site)
+        if spec is None:
+            return False
+        with self._lock:
+            hit = self._hits.get(site, 0)
+            self._hits[site] = hit + 1
+            fail = hit < spec.fail_first or hit in spec.at
+            if not fail and spec.probability > 0.0:
+                rng = self._rngs.get(site)
+                if rng is None:
+                    rng = random.Random(
+                        (self.seed << 32) ^ zlib.crc32(site.encode())
+                    )
+                    self._rngs[site] = rng
+                fail = rng.random() < spec.probability
+        return fail
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+
+_active: Optional[FaultPlan] = None
+_env_checked = False
+_lock = threading.Lock()
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Make ``plan`` the process-wide active plan (None deactivates)."""
+    global _active, _env_checked
+    with _lock:
+        _active = plan
+        _env_checked = True  # an explicit install outranks the env
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    global _active, _env_checked
+    with _lock:
+        if not _env_checked:
+            _env_checked = True
+            _active = FaultPlan.from_env()
+        return _active
+
+
+def fire(site: str) -> bool:
+    """True when the active plan wants this hit of ``site`` to fail.
+    For sites whose failure is not an exception (e.g. a NaN batch)."""
+    plan = active()
+    if plan is None:
+        return False
+    stats.add(f"faults.checked.{site}")
+    if plan.check(site):
+        stats.add(f"faults.injected.{site}")
+        return True
+    return False
+
+
+def inject(site: str) -> None:
+    """Raise FaultInjected when the active plan fails this hit of ``site``."""
+    if fire(site):
+        raise FaultInjected(f"injected fault at {site}")
+
+
+class fault_plan:
+    """Context manager for tests: installs a plan, restores the prior one."""
+
+    def __init__(self, sites: Dict[str, Union[str, FaultSpec]], seed: int = 0):
+        self.plan = FaultPlan(sites, seed=seed)
+        self._prev: Optional[FaultPlan] = None
+
+    def __enter__(self) -> FaultPlan:
+        self._prev = active()
+        install(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        install(self._prev)

@@ -28,18 +28,34 @@ from __future__ import annotations
 import os
 import shutil
 import subprocess
-import time
 from typing import Optional
+
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.retry import (
+    RetryPolicy,
+    register_retryable,
+    retry_call,
+)
 
 
 class FsError(RuntimeError):
     pass
 
 
+# fs failures are the canonical transient class (reference fs.cc retries
+# every hadoop command); retry loops treat FsError as retryable everywhere
+register_retryable(FsError)
+
+
 class LocalFS:
-    """Local filesystem with the same surface as HadoopFS."""
+    """Local filesystem with the same surface as HadoopFS.
+
+    Each op is a fault-injection site (``fs.<op>``) so chaos tests exercise
+    the same recovery paths against local paths that production hits on
+    HDFS/AFS."""
 
     def ls(self, path: str) -> list[str]:
+        faults.inject("fs.ls")
         if not os.path.isdir(path):
             raise FsError(f"ls: not a directory: {path}")
         return sorted(
@@ -47,15 +63,18 @@ class LocalFS:
         )
 
     def exists(self, path: str) -> bool:
+        faults.inject("fs.exists")
         return os.path.exists(path)
 
     def is_dir(self, path: str) -> bool:
         return os.path.isdir(path)
 
     def mkdir(self, path: str) -> None:
+        faults.inject("fs.mkdir")
         os.makedirs(path, exist_ok=True)
 
     def upload(self, local: str, remote: str) -> None:
+        faults.inject("fs.upload")
         self.mkdir(os.path.dirname(remote) or ".")
         if os.path.isdir(local):
             shutil.copytree(local, remote, dirs_exist_ok=True)
@@ -63,6 +82,7 @@ class LocalFS:
             shutil.copy2(local, remote)
 
     def download(self, remote: str, local: str) -> None:
+        faults.inject("fs.download")
         os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
         if os.path.isdir(remote):
             shutil.copytree(remote, local, dirs_exist_ok=True)
@@ -70,17 +90,20 @@ class LocalFS:
             shutil.copy2(remote, local)
 
     def rm(self, path: str) -> None:
+        faults.inject("fs.rm")
         if os.path.isdir(path):
             shutil.rmtree(path)
         elif os.path.exists(path):
             os.remove(path)
 
     def touch(self, path: str) -> None:
+        faults.inject("fs.touch")
         self.mkdir(os.path.dirname(path) or ".")
         with open(path, "a"):
             pass
 
     def cat(self, path: str) -> bytes:
+        faults.inject("fs.cat")
         with open(path, "rb") as f:
             return f.read()
 
@@ -95,13 +118,16 @@ class HadoopFS:
         fs_name: str = "",
         fs_ugi: str = "",
         hadoop_bin: Optional[str] = None,
-        retries: int = 2,
+        retries: Optional[int] = None,
     ):
         self.hadoop_bin = hadoop_bin or os.environ.get(
             "PBOX_HADOOP_BIN", "hadoop"
         )
         self.fs_name = fs_name or os.environ.get("PBOX_FS_NAME", "")
         self.fs_ugi = fs_ugi or os.environ.get("PBOX_FS_UGI", "")
+        # None = the flag-shim defaults (PBOX_RETRY_MAX_ATTEMPTS); an
+        # explicit N keeps the historical meaning of N retries after the
+        # first attempt
         self.retries = retries
 
     def _base(self) -> list[str]:
@@ -112,32 +138,42 @@ class HadoopFS:
             cmd += ["-D", f"hadoop.job.ugi={self.fs_ugi}"]
         return cmd
 
+    def _run_once(
+        self, args: list[str], text: bool = True
+    ) -> subprocess.CompletedProcess:
+        """One hadoop invocation; rc != 0 raises FsError (retryable)."""
+        faults.inject("fs." + args[0].lstrip("-"))
+        proc = subprocess.run(
+            self._base() + args, capture_output=True, text=text
+        )
+        if proc.returncode != 0:
+            err = proc.stderr if text else proc.stderr.decode(errors="replace")
+            raise FsError(
+                f"hadoop fs {' '.join(args)} failed rc={proc.returncode}: "
+                f"{err.strip()[-500:]}"
+            )
+        return proc
+
     def _run(
         self, args: list[str], check: bool = True, text: bool = True
     ) -> subprocess.CompletedProcess:
-        # check=False callers (-test probes) treat rc=1 as a definitive
-        # answer, not a transient failure: no retry, one JVM fork
-        tries = (self.retries + 1) if check else 1
-        last: Optional[subprocess.CompletedProcess] = None
-        for attempt in range(tries):
-            if attempt:
-                # transient HDFS failures need time to clear; back-to-back
-                # retries just fork JVMs (reference fs.cc sleeps between
-                # retries too). 1s, 2s, 3s... capped at 5s.
-                time.sleep(min(attempt, 5))
-            proc = subprocess.run(
+        if not check:
+            # check=False callers (-test probes) treat rc=1 as a definitive
+            # answer, not a transient failure: no retry, one JVM fork
+            return subprocess.run(
                 self._base() + args, capture_output=True, text=text
             )
-            if proc.returncode == 0:
-                return proc
-            last = proc
-        if check:
-            err = last.stderr if text else last.stderr.decode(errors="replace")
-            raise FsError(
-                f"hadoop fs {' '.join(args)} failed rc={last.returncode}: "
-                f"{err.strip()[-500:]}"
+        policy = RetryPolicy.from_flags()
+        if self.retries is not None:
+            policy = RetryPolicy(
+                max_attempts=self.retries + 1,
+                base_delay_s=policy.base_delay_s,
+                max_delay_s=policy.max_delay_s,
             )
-        return last
+        return retry_call(
+            self._run_once, args, text=text,
+            site="fs." + args[0].lstrip("-"), policy=policy,
+        )
 
     def ls(self, path: str) -> list[str]:
         out = self._run(["-ls", path]).stdout
@@ -185,22 +221,44 @@ def resolve_fs(path: str):
     return LocalFS()
 
 
-def publish_checkpoint(manager, tag: str, remote_root: str, fs=None) -> None:
+def publish_checkpoint(
+    manager, tag: str, remote_root: str, fs=None, verify: bool = True
+) -> None:
     """Upload a saved checkpoint tag + refreshed donefile to a remote root
     (the reference's post-SaveBase xbox publish: upload the day dir, then
     the donefile last so consumers never see a donefile entry whose data is
-    still uploading — fleet_util write_model_donefile discipline)."""
+    still uploading — fleet_util write_model_donefile discipline).
+
+    Each upload retries transient failures (site "publish.upload" /
+    "publish.donefile"), and with ``verify`` every uploaded checkpoint dir
+    is re-read through the remote fs and checked against its integrity
+    manifest BEFORE the donefile lands — a consumer following the donefile
+    never sees a tag whose remote bytes are wrong."""
+    from paddlebox_tpu.checkpoint import verify_checkpoint_dir
+
     fs = fs or resolve_fs(remote_root)
     entries = [e for e in manager.list_checkpoints() if e.tag == tag]
     if not entries:
         raise FsError(f"tag {tag!r} not in {manager.root}/donefile.txt")
-    fs.mkdir(remote_root)
+    retry_call(fs.mkdir, remote_root, site="publish.mkdir")
     for e in entries:  # a tag may have both a base and a delta entry
+        dest = os.path.join(remote_root, os.path.basename(e.dirname))
+
+        def upload_entry(e=e, dest=dest):
+            faults.inject("publish.upload")
+            fs.upload(e.dirname, dest)
+            if verify:
+                # verify THROUGH the remote fs so a partial/corrupt upload
+                # fails this attempt and the retry re-uploads
+                verify_checkpoint_dir(dest, fs=fs)
+
+        retry_call(upload_entry, site="publish.upload")
+
+    def upload_donefile():
+        faults.inject("publish.donefile")
         fs.upload(
-            e.dirname,
-            os.path.join(remote_root, os.path.basename(e.dirname)),
+            os.path.join(manager.root, "donefile.txt"),
+            os.path.join(remote_root, "donefile.txt"),
         )
-    fs.upload(
-        os.path.join(manager.root, "donefile.txt"),
-        os.path.join(remote_root, "donefile.txt"),
-    )
+
+    retry_call(upload_donefile, site="publish.donefile")

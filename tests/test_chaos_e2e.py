@@ -1,0 +1,163 @@
+"""Chaos end-to-end: a multi-pass training run survives transient fs
+failures, a malformed input line, one NaN batch (skip_batch), a failed
+publish attempt, and a truncated checkpoint across a restart — and its
+final dense params and AUC match the fault-free run.
+
+The quarantined line is appended corruption (so skipping it restores the
+clean stream) and the NaN-skipped batch happens in a pass that is later
+replayed from checkpoint after the simulated crash, so the end state is
+EXACTLY the fault-free one; the stats registry carries the full accounting
+of what was absorbed along the way.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train import AutoCheckpointer, Trainer
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.faults import FaultPlan
+from paddlebox_tpu.utils.fs import publish_checkpoint
+from paddlebox_tpu.utils.monitor import stats
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+S, DENSE, B = 3, 2, 16
+N_PASSES = 3
+
+
+def _trainer(seed=0, nan_policy="raise"):
+    tconf = SparseTableConfig(embedding_dim=4)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(16, 8))
+    table = SparseTable(tconf, seed=seed)
+    trainer = Trainer(
+        model, tconf,
+        TrainerConfig(auc_buckets=1 << 10, nan_policy=nan_policy),
+        seed=seed,
+    )
+    return table, trainer
+
+
+def _dataset(files, malformed_policy="raise"):
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+        max_feasigns_per_ins=8, malformed_policy=malformed_policy,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    return ds
+
+
+def _run_pass(ds, table, trainer):
+    table.begin_pass(ds.unique_keys())
+    m = trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    return m
+
+
+def test_chaos_run_matches_fault_free_run(tmp_path, monkeypatch):
+    monkeypatch.setenv("PBOX_RETRY_BASE_DELAY_S", "0.001")
+    monkeypatch.setenv("PBOX_RETRY_MAX_DELAY_S", "0.002")
+    stats.reset()
+    faults.clear()
+
+    clean_files = write_synth_files(
+        str(tmp_path / "clean"), n_files=2, ins_per_file=64,
+        n_sparse_slots=S, vocab_per_slot=60, dense_dim=DENSE, seed=9,
+    )
+    # the chaos copy of the data carries one malformed trailing line
+    chaos_files = write_synth_files(
+        str(tmp_path / "chaos"), n_files=2, ins_per_file=64,
+        n_sparse_slots=S, vocab_per_slot=60, dense_dim=DENSE, seed=9,
+    )
+    with open(chaos_files[-1], "a") as fh:
+        fh.write("corrupt log line that is not slot format\n")
+
+    # ---- fault-free reference ------------------------------------------- #
+    ds_ref = _dataset(clean_files)
+    table_ref, trainer_ref = _trainer()
+    ref = [_run_pass(ds_ref, table_ref, trainer_ref) for _ in range(N_PASSES)]
+    ref_state = table_ref.state_dict()
+    ds_ref.close()
+
+    # ---- chaos run, part 1 (until the "crash") -------------------------- #
+    faults.install(FaultPlan({
+        "data.read": "first:1",       # transient read failure on load
+        "publish.upload": "first:1",  # transient publish failure
+        "train.nan": "at:10",         # one poisoned batch in pass 1
+    }))
+    ds = _dataset(chaos_files, malformed_policy="skip")
+    # the appended corrupt line was quarantined: clean stream restored
+    assert ds.get_memory_data_size() == 128
+    table, trainer = _trainer(nan_policy="skip_batch")
+    acp = AutoCheckpointer(str(tmp_path / "acp"), job_id="chaos")
+    remote = str(tmp_path / "published")
+    for p in range(2):
+        _run_pass(ds, table, trainer)
+        acp.after_pass(p, table, trainer)
+        publish_checkpoint(acp.ckpt, f"chaos-p{p:06d}", remote)
+    ds.close()
+    # the injected NaN batch in pass 1 was skipped, not fatal
+    assert stats.get("train.nan_skipped_steps") == 1
+    assert stats.get("faults.injected.train.nan") == 1
+    assert stats.get("faults.injected.data.read") == 1
+    assert stats.get("faults.injected.publish.upload") == 1
+    assert stats.get("retry.publish.upload.retries") >= 1
+    assert stats.get("data.quarantined_lines") == 1
+
+    # ---- the crash: newest checkpoint truncated ------------------------- #
+    newest = acp.ckpt.list_checkpoints()[-1]
+    path = os.path.join(newest.dirname, "sparse.npz")
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[: len(data) // 2])
+    faults.clear()
+
+    # ---- restart: fallback resume + clean replay ------------------------ #
+    ds2 = _dataset(chaos_files, malformed_policy="skip")
+    table2, trainer2 = _trainer(nan_policy="skip_batch")
+    acp2 = AutoCheckpointer(str(tmp_path / "acp"), job_id="chaos")
+    status, _ = acp2.resume(table2, trainer2)
+    assert status["fallback"] is True
+    assert status["next_pass"] == 1  # pass 1 (with the skipped batch) replays
+    assert stats.get("ckpt.resume_fallback") == 1
+
+    got = None
+    for p in range(status["next_pass"], N_PASSES):
+        got = _run_pass(ds2, table2, trainer2)
+        acp2.after_pass(p, table2, trainer2)
+    publish_checkpoint(acp2.ckpt, f"chaos-p{N_PASSES - 1:06d}", remote)
+    ds2.close()
+
+    # ---- the whole point: end state matches the fault-free run ---------- #
+    assert got["count"] == ref[-1]["count"]
+    np.testing.assert_allclose(got["auc"], ref[-1]["auc"], atol=1e-6)
+    np.testing.assert_allclose(got["loss"], ref[-1]["loss"], rtol=1e-5)
+    import jax
+
+    for a, b in zip(
+        jax.tree.leaves(trainer_ref.params), jax.tree.leaves(trainer2.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
+    got_state = table2.state_dict()
+    ia = np.argsort(ref_state["keys"])
+    ib = np.argsort(got_state["keys"])
+    np.testing.assert_array_equal(ref_state["keys"][ia], got_state["keys"][ib])
+    np.testing.assert_allclose(
+        ref_state["values"][ia], got_state["values"][ib], rtol=1e-5, atol=1e-6
+    )
+    # the published remote is complete and verifiable
+    from paddlebox_tpu.checkpoint import verify_checkpoint_dir
+
+    assert os.path.exists(os.path.join(remote, "donefile.txt"))
+    verify_checkpoint_dir(
+        os.path.join(remote, f"base-chaos-p{0:06d}")
+    )

@@ -115,21 +115,21 @@ def test_scan_nan_short_circuits_remaining_ticks():
         return [jnp.zeros(()) for _ in range(5)]
 
     feeds = {"ok": jnp.array([1.0, 0.0, 1.0])}  # tick 1 goes non-finite
-    p, _, _, _, _, losses, finite = scan_fn(*zs(), feeds)
+    p, _, _, _, _, losses, finites = scan_fn(*zs(), feeds)
     # tick 0 applies, tick 1 applies (the one corrupted update), tick 2 skips
     assert float(p) == 2.0
-    assert not bool(finite)
-    assert losses.shape == (3,)
+    assert not bool(finites.all())  # per-tick flags (nan_policy accounting)
+    assert losses.shape == (3,) and finites.shape == (3,)
     assert bool(jnp.isnan(losses[2]))  # skipped tick reports nan loss
 
     # all-finite group still applies every tick
     tr2 = Trainer.__new__(Trainer)
     tr2.conf = TrainerConfig(check_nan_inf=True, scan_steps=3)
     tr2._step_body = fake_body
-    p, _, _, _, _, losses, finite = tr2._build_scan_step()(
+    p, _, _, _, _, losses, finites = tr2._build_scan_step()(
         *zs(), {"ok": jnp.ones(3)}
     )
-    assert float(p) == 3.0 and bool(finite)
+    assert float(p) == 3.0 and bool(finites.all())
 
 
 def test_check_nan_inf_catches_poisoned_lr(synth):

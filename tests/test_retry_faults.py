@@ -1,0 +1,173 @@
+"""Unit tests for the fault-tolerance primitives: utils/retry.py (unified
+backoff) and utils/faults.py (deterministic injection registry)."""
+
+import subprocess
+
+import pytest
+
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.faults import FaultInjected, FaultPlan, FaultSpec, fault_plan
+from paddlebox_tpu.utils.monitor import stats
+from paddlebox_tpu.utils.retry import RetryPolicy, default_retryable, retry_call
+
+FAST = RetryPolicy(max_attempts=3, base_delay_s=0.001, max_delay_s=0.002)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    stats.reset()
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return 42
+
+        assert retry_call(flaky, site="t.flaky", policy=FAST) == 42
+        assert len(calls) == 3
+        snap = stats.snapshot()
+        assert snap["retry.t.flaky.calls"] == 1
+        assert snap["retry.t.flaky.attempts"] == 3
+        assert snap["retry.t.flaky.retries"] == 2
+        assert "retry.t.flaky.exhausted" not in snap
+
+    def test_non_retryable_raises_immediately(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("logic error, not transient")
+
+        with pytest.raises(ValueError):
+            retry_call(bad, site="t.bad", policy=FAST)
+        assert len(calls) == 1
+
+    def test_exhausted_reraises_last_and_counts(self):
+        def always():
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            retry_call(always, site="t.down", policy=FAST)
+        snap = stats.snapshot()
+        assert snap["retry.t.down.attempts"] == 3
+        assert snap["retry.t.down.exhausted"] == 1
+
+    def test_deadline_bounds_the_call(self):
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise OSError("down")
+
+        slow = RetryPolicy(
+            max_attempts=100, base_delay_s=0.05, max_delay_s=0.05,
+            deadline_s=0.12,
+        )
+        with pytest.raises(OSError):
+            retry_call(always, site="t.deadline", policy=slow)
+        # ~2-3 attempts fit in 120ms of 50ms sleeps, never all 100
+        assert len(calls) < 10
+
+    def test_default_retryable_classes(self):
+        assert default_retryable(OSError())
+        assert default_retryable(subprocess.SubprocessError())
+        assert default_retryable(FaultInjected("x"))
+        from paddlebox_tpu.utils.fs import FsError
+
+        assert default_retryable(FsError("x"))
+        assert not default_retryable(ValueError())
+        assert not default_retryable(KeyError())
+
+    def test_backoff_is_deterministic_and_capped(self):
+        p = RetryPolicy(base_delay_s=1.0, max_delay_s=5.0, jitter=0.1)
+        d1 = [p.delay(a, "site.x") for a in (1, 2, 3, 4)]
+        d2 = [p.delay(a, "site.x") for a in (1, 2, 3, 4)]
+        assert d1 == d2  # same site+attempt -> same jitter
+        assert d1[0] >= 1.0 and d1[-1] <= 5.0 * 1.1
+        assert p.delay(1, "site.y") != d1[0]  # sites don't sleep in lockstep
+
+
+class TestFaultPlan:
+    def test_spec_parsing(self):
+        assert FaultSpec.parse("first:2") == FaultSpec(fail_first=2)
+        assert FaultSpec.parse("at:3,7") == FaultSpec(at=(3, 7))
+        assert FaultSpec.parse("p:0.5") == FaultSpec(probability=0.5)
+        with pytest.raises(ValueError):
+            FaultSpec.parse("sometimes")
+
+    def test_fail_first_n(self):
+        plan = FaultPlan({"a.b": "first:2"})
+        assert [plan.check("a.b") for _ in range(4)] == [
+            True, True, False, False,
+        ]
+
+    def test_at_indices(self):
+        plan = FaultPlan({"a.b": "at:1,3"})
+        assert [plan.check("a.b") for _ in range(5)] == [
+            False, True, False, True, False,
+        ]
+
+    def test_probability_deterministic_per_seed(self):
+        plan1 = FaultPlan({"a": "p:0.5"}, seed=7)
+        out1 = [plan1.check("a") for _ in range(20)]
+        plan2 = FaultPlan({"a": "p:0.5"}, seed=7)
+        out2 = [plan2.check("a") for _ in range(20)]
+        assert out1 == out2
+        assert any(out1) and not all(out1)
+
+    def test_prefix_wildcard(self):
+        plan = FaultPlan({"fs.*": "first:1"})
+        assert plan.check("fs.upload")
+        # hit counters are per concrete site
+        assert plan.check("fs.download")
+        assert not plan.check("fs.upload")
+
+    def test_unlisted_site_never_fails(self):
+        plan = FaultPlan({"a.b": "first:99"})
+        assert not plan.check("other")
+
+    def test_inject_raises_and_counts(self):
+        with fault_plan({"x.y": "first:1"}):
+            with pytest.raises(FaultInjected):
+                faults.inject("x.y")
+            faults.inject("x.y")  # second hit passes
+        snap = stats.snapshot()
+        assert snap["faults.injected.x.y"] == 1
+        assert snap["faults.checked.x.y"] == 2
+
+    def test_no_plan_is_noop(self):
+        faults.inject("anything")  # must not raise
+        assert not faults.fire("anything")
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv(
+            "PBOX_FAULT_PLAN", "fs.upload=first:2; data.read=p:0.25"
+        )
+        monkeypatch.setenv("PBOX_FAULT_SEED", "3")
+        plan = FaultPlan.from_env()
+        assert plan.seed == 3
+        assert plan.sites["fs.upload"] == FaultSpec(fail_first=2)
+        assert plan.sites["data.read"] == FaultSpec(probability=0.25)
+        monkeypatch.setenv("PBOX_FAULT_PLAN", "")
+        assert FaultPlan.from_env() is None
+
+    def test_retry_absorbs_injected_faults(self):
+        """The integration the whole design hangs off: a fail-first-N plan
+        under a retry loop with > N attempts succeeds."""
+        with fault_plan({"t.site": "first:2"}):
+            def op():
+                faults.inject("t.site")
+                return "ok"
+
+            assert retry_call(op, site="t.site", policy=FAST) == "ok"
+        snap = stats.snapshot()
+        assert snap["faults.injected.t.site"] == 2
+        assert snap["retry.t.site.attempts"] == 3
