@@ -21,6 +21,7 @@ __version__ = "0.1.0"
 from paddlebox_tpu.config import (  # noqa: F401
     SlotConfig,
     DataFeedConfig,
+    LivenessConfig,
     SparseTableConfig,
     TrainerConfig,
     flags,

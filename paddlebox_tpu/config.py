@@ -58,6 +58,22 @@ class _Flags:
         # empty = no injection.  Seed makes probabilistic specs replayable.
         "fault_plan": "",
         "fault_seed": 0,
+        # distributed-liveness defaults (parallel/watchdog.py): the stall
+        # deadline bounds how long ANY stage (feed, step, host-plane
+        # collective, shuffle) may go without progress before the watchdog
+        # declares a stall; heartbeat/poll pace the per-process heartbeat
+        # publisher and the detector loop.  The deadline default matches
+        # the host-plane patience (first XLA compile / capacity-bump
+        # recompile can legitimately stall a process that long).
+        "liveness_deadline_s": 3600.0,
+        "liveness_heartbeat_s": 15.0,
+        "liveness_poll_s": 1.0,
+        # host-plane KV-channel wait bound (KvChannel default timeout);
+        # overrides TrainerConfig.host_plane_timeout_s when a LivenessConfig
+        # is active
+        "hostplane_timeout_s": 3600.0,
+        # shuffle-transport wait bound (TcpShuffler default timeout)
+        "shuffle_timeout_s": 120.0,
     }
 
     def __getattr__(self, name: str):
@@ -368,6 +384,66 @@ class SparseTableConfig:
 
 
 # --------------------------------------------------------------------------- #
+# Distributed liveness — the watchdog/heartbeat/deadline policy
+# (parallel/watchdog.py).  One config object bounds every wait in the
+# system: local stage progress, peer heartbeats, host-plane KV gathers and
+# the shuffle transport.  The reference has no equivalent (its MPI/NCCL
+# collectives hang until an operator kills the job); parameter-server
+# systems treat inter-worker liveness as first-class, and so does this.
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class LivenessConfig:
+    """Deadlines and cadences for the distributed-liveness layer.
+
+    deadline_s: a process (local check) or peer (heartbeat check) with no
+    stage progress for this long is declared stalled.  Must comfortably
+    exceed the longest legitimate stall (first XLA compile, capacity-bump
+    recompile) — the default matches the host-plane patience.
+    """
+
+    enabled: bool = True
+    deadline_s: float = 3600.0
+    heartbeat_interval_s: float = 15.0
+    poll_interval_s: float = 1.0
+    # host-plane KV-channel wait bound (KvChannel default timeout)
+    hostplane_timeout_s: float = 3600.0
+    # shuffle-transport wait bound (TcpShuffler default timeout)
+    shuffle_timeout_s: float = 120.0
+    # on a stall abort, roll the process back to the newest valid
+    # checkpoint (PR 1's find_valid_tag / PassRolledBack machinery) so no
+    # partially-applied pass survives; requires trainer.checkpointer
+    rollback_on_abort: bool = False
+    # multi-process only: a thread blocked INSIDE a device collective
+    # cannot be unwound from Python, so after an abort the watchdog gives
+    # the process this long to exit cleanly and then hard-exits (code
+    # 124) — the fleet converges even when one rank is wedged in XLA.
+    # <= 0 disables (single-process runs never hard-exit).
+    hard_exit_grace_s: float = 60.0
+
+    @staticmethod
+    def from_flags() -> "LivenessConfig":
+        return LivenessConfig(
+            deadline_s=flags.liveness_deadline_s,
+            heartbeat_interval_s=flags.liveness_heartbeat_s,
+            poll_interval_s=flags.liveness_poll_s,
+            hostplane_timeout_s=flags.hostplane_timeout_s,
+            shuffle_timeout_s=flags.shuffle_timeout_s,
+        )
+
+    def __post_init__(self):
+        if self.deadline_s <= 0:
+            raise ValueError("deadline_s must be positive")
+        if self.heartbeat_interval_s <= 0 or self.poll_interval_s <= 0:
+            raise ValueError("heartbeat/poll intervals must be positive")
+        if self.heartbeat_interval_s >= self.deadline_s:
+            raise ValueError(
+                f"heartbeat_interval_s ({self.heartbeat_interval_s}) must be "
+                f"< deadline_s ({self.deadline_s}) or every peer always "
+                "looks stale"
+            )
+
+
+# --------------------------------------------------------------------------- #
 # Trainer config — replaces trainer_desc.proto (reference:
 # trainer_desc.proto:21-66,100-108 BoxPSWorkerParameter).
 # --------------------------------------------------------------------------- #
@@ -444,8 +520,15 @@ class TrainerConfig:
     # multi-host planning-plane patience: how long one host-plane KV
     # gather waits for a straggling peer (covers first-compile and
     # capacity-bump recompile stalls; the device collectives it replaced
-    # waited indefinitely)
+    # waited indefinitely).  Superseded by liveness.hostplane_timeout_s
+    # when a LivenessConfig is attached.
     host_plane_timeout_s: float = 3600.0
+    # distributed-liveness policy (parallel/watchdog.py): None = no
+    # watchdog (every wait still bounded by its own timeout, but no
+    # heartbeats / stall attribution / coordinated abort).  Attach a
+    # LivenessConfig to get per-process heartbeats, local+peer stall
+    # detection naming the culprit, and poison-key coordinated abort.
+    liveness: Optional["LivenessConfig"] = None
     # per-stage host timing (reference: TrainFilesWithProfiler — a slower
     # diagnostic mode: the device step is synchronized every batch)
     profile: bool = False

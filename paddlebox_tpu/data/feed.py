@@ -27,6 +27,25 @@ import numpy as np
 from paddlebox_tpu.config import DataFeedConfig
 from paddlebox_tpu.data.record import RecordBlock
 
+_beat = None  # resolved once: liveness stage beat, or a no-op
+
+
+def _liveness_beat(stage: str) -> None:
+    """Report feed-assembly progress to the active liveness watchdog.
+    Lazy + guarded: the data plane must import (and run) on builds where
+    the parallel package cannot."""
+    global _beat
+    if _beat is None:
+        try:
+            from paddlebox_tpu.parallel.watchdog import beat as b
+        except Exception:
+            import sys
+
+            mod = sys.modules.get("paddlebox_tpu.parallel.watchdog")
+            b = mod.beat if mod is not None else (lambda stage: None)
+        _beat = b
+    _beat(stage)
+
 
 @dataclasses.dataclass
 class HostBatch:
@@ -177,6 +196,7 @@ class BatchBuilder:
         return batch
 
     def build(self, block: RecordBlock, ids: np.ndarray) -> HostBatch:
+        _liveness_beat("feed")
         conf = self.conf
         B = conf.batch_size
         S = block.n_sparse_slots

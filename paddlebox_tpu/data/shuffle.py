@@ -28,12 +28,43 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
 
 from paddlebox_tpu.data.archive import block_from_bytes, block_to_bytes
 from paddlebox_tpu.data.record import RecordBlock
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.retry import retry_call
+
+
+def _watchdog_mod():
+    """The liveness watchdog module, or None on a build where the parallel
+    package cannot import (the data plane must not hard-require it)."""
+    try:
+        from paddlebox_tpu.parallel import watchdog
+
+        return watchdog
+    except Exception:
+        import sys
+
+        return sys.modules.get("paddlebox_tpu.parallel.watchdog")
+
+
+class ShufflePeerError(ConnectionError):
+    """A shuffle peer is unreachable — names the worker and endpoint so a
+    dead listener reads as "worker 3 at 10.0.0.7:6071" instead of a bare
+    ConnectionRefusedError with no cluster coordinates."""
+
+    def __init__(self, worker_id: int, endpoint, cause: Exception):
+        self.worker_id = int(worker_id)
+        self.endpoint = tuple(endpoint)
+        host, port = self.endpoint
+        super().__init__(
+            f"shuffle peer worker {worker_id} at {host}:{port} "
+            f"unreachable: {cause!r}"
+        )
 
 _FNV_OFFSET = np.uint64(14695981039346656037)
 _FNV_PRIME = np.uint64(1099511628211)
@@ -175,20 +206,34 @@ class TcpShuffler:
     exchange round at a time (matching the reference's pass-scoped shuffle).
     """
 
+    # wait-loop slice: how often the exchange wait re-checks the liveness
+    # watchdog's abort latch while blocked on peers
+    POLL_S = 0.2
+
     def __init__(
         self,
         endpoints: Sequence[tuple[str, int]],
         worker_id: int,
         mode: str = "search_id",
         seed: int = 0,
-        timeout: float = 120.0,
+        timeout: Optional[float] = None,
     ):
+        if timeout is None:
+            # explicit arg > active watchdog's LivenessConfig > flag
+            wd_mod = _watchdog_mod()
+            wd = wd_mod.current() if wd_mod is not None else None
+            if wd is not None:
+                timeout = wd.conf.shuffle_timeout_s
+            else:
+                from paddlebox_tpu.config import flags
+
+                timeout = flags.shuffle_timeout_s
         self.endpoints = list(endpoints)
         self.n_workers = len(endpoints)
         self.worker_id = worker_id
         self.mode = mode
         self.seed = seed
-        self.timeout = timeout
+        self.timeout = float(timeout)
         self._server: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         # keyed by (sender, round): a fast peer may deliver round N+1 while
@@ -216,8 +261,11 @@ class TcpShuffler:
 
     def _serve(self) -> None:
         while not self._stop:
+            srv = self._server
+            if srv is None:
+                return
             try:
-                conn, _ = self._server.accept()
+                conn, _ = srv.accept()
             except socket.timeout:
                 continue
             except OSError:
@@ -240,12 +288,49 @@ class TcpShuffler:
             conn.close()
 
     def close(self) -> None:
+        """Stop the listener.  Idempotent: a teardown path that closes on
+        both the normal exit AND the abort path (coordinated aborts do)
+        must never double-fault here."""
+        if self._stop:
+            return
         self._stop = True
         if self._server is not None:
-            self._server.close()
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        t, self._accept_thread = self._accept_thread, None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        self._server = None
 
     # -- exchange ---------------------------------------------------------- #
+    def _send_to_peer(self, peer: int, rnd: int, payload: bytes) -> None:
+        """Connect + frame + send to one peer, retried via utils/retry
+        (site "shuffle.connect": transient connection refusals during a
+        peer's listener (re)start are absorbed; exhaustion names the
+        peer).  Safe to retry whole: delivery is keyed (sender, round) on
+        the receive side, so a duplicate overwrites with identical bytes.
+        """
+
+        def attempt() -> None:
+            with socket.create_connection(
+                self.endpoints[peer], timeout=self.timeout
+            ) as c:
+                c.settimeout(self.timeout)
+                c.sendall(_FRAME.pack(self.worker_id, rnd, len(payload)))
+                c.sendall(payload)
+
+        try:
+            retry_call(attempt, site="shuffle.connect")
+        except OSError as e:
+            raise ShufflePeerError(peer, self.endpoints[peer], e) from e
+
     def exchange(self, block: RecordBlock) -> RecordBlock:
+        wd_mod = _watchdog_mod()
+        if wd_mod is not None:
+            wd_mod.beat("shuffle")
+        faults.inject("shuffle.exchange")  # chaos site: raise or hang
         rnd = self._round
         self._round += 1
         dest = route_ids(block, self.n_workers, self.mode, self.seed)
@@ -254,21 +339,34 @@ class TcpShuffler:
         for peer, part in enumerate(parts):
             if peer == self.worker_id:
                 continue
-            payload = block_to_bytes(part)
-            with socket.create_connection(
-                self.endpoints[peer], timeout=self.timeout
-            ) as c:
-                c.sendall(_FRAME.pack(self.worker_id, rnd, len(payload)))
-                c.sendall(payload)
+            self._send_to_peer(peer, rnd, block_to_bytes(part))
         expected = {(p, rnd) for p in range(self.n_workers)} - {(self.worker_id, rnd)}
+        deadline = time.monotonic() + self.timeout
         with self._recv_cv:
-            ok = self._recv_cv.wait_for(
-                lambda: expected.issubset(self._received), timeout=self.timeout
-            )
-            if not ok:
-                missing = sorted(p for p, r in expected - set(self._received))
-                raise TimeoutError(f"shuffle: no data from workers {missing}")
+            while not expected.issubset(self._received):
+                if wd_mod is not None:
+                    wd_mod.check()  # a coordinated abort interrupts the wait
+                    # an active bounded wait on remote peers counts as
+                    # alive (the wait's own timeout names the laggards;
+                    # each peer's watchdog covers the peer)
+                    wd_mod.beat("shuffle")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    missing = sorted(
+                        p for p, r in expected - set(self._received)
+                    )
+                    where = ", ".join(
+                        f"worker {p} at {self.endpoints[p][0]}:"
+                        f"{self.endpoints[p][1]}" for p in missing
+                    )
+                    raise TimeoutError(
+                        f"shuffle exchange round {rnd} timed out after "
+                        f"{self.timeout:.1f}s: no data from {where}"
+                    )
+                self._recv_cv.wait(timeout=min(self.POLL_S, remaining))
             got = [self._received.pop(k) for k in sorted(expected)]
+        if wd_mod is not None:
+            wd_mod.beat("shuffle")
         return RecordBlock.concat([own, *got])
 
 

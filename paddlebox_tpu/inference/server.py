@@ -24,11 +24,13 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from paddlebox_tpu.config import DataFeedConfig
 from paddlebox_tpu.inference.predictor import Predictor
+from paddlebox_tpu.utils.monitor import stats
 
 
 class ModelEntry:
@@ -59,6 +61,11 @@ class ScoringServer:
         self._meta_lock = threading.Lock()  # registry/stats reads+writes
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # graceful-drain accounting: in-flight scoring requests, guarded by
+        # a condition so stop() can wait for them with a bounded deadline
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._draining = False
 
     # -- registry ---------------------------------------------------------- #
     def register(self, name: str, artifact_dir: str,
@@ -197,6 +204,18 @@ class ScoringServer:
                 else:
                     self._send(404, {"error": "not found"})
                     return
+                if not server._begin_request():
+                    # draining: a rolling deploy already unrouted us, but a
+                    # straggler connection may still arrive — refuse loudly
+                    # instead of racing the close
+                    self._send(503, {"error": "server draining"})
+                    return
+                try:
+                    self._do_score(name)
+                finally:
+                    server._end_request()
+
+            def _do_score(self, name):
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     body = self.rfile.read(n)
@@ -243,11 +262,47 @@ class ScoringServer:
         if t is not None:
             t.join()
 
-    def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-            if self._thread is not None:
-                self._thread.join(timeout=5.0)
-                self._thread = None
+    # -- drain bookkeeping -------------------------------------------------- #
+    def _begin_request(self) -> bool:
+        with self._inflight_cv:
+            if self._draining:
+                return False
+            self._inflight += 1
+            return True
+
+    def _end_request(self) -> None:
+        with self._inflight_cv:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._inflight_cv.notify_all()
+
+    def stop(self, drain_timeout_s: float = 5.0) -> None:
+        """Graceful drain then close: stop accepting (new scoring requests
+        get 503), let in-flight requests finish within ``drain_timeout_s``,
+        then tear the listener down.  A drain that exceeds the deadline is
+        counted (stats ``server.drain_timeout``) and the close proceeds —
+        a stop() must never hang on a stuck request.  Idempotent."""
+        if self._httpd is None:
+            return
+        with self._inflight_cv:
+            self._draining = True
+            deadline = time.monotonic() + max(drain_timeout_s, 0.0)
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    stats.add("server.drain_timeout")
+                    logging.getLogger(__name__).warning(
+                        "server stop: %d request(s) still in flight after "
+                        "%.1fs drain deadline; closing anyway",
+                        self._inflight, drain_timeout_s,
+                    )
+                    break
+                self._inflight_cv.wait(timeout=remaining)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        with self._inflight_cv:
+            self._draining = False  # a re-start()ed server accepts again

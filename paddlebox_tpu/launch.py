@@ -47,12 +47,17 @@ def rank_env(
     coordinator: str,
     devices_per_proc: Optional[int] = None,
     base_env: Optional[dict] = None,
+    liveness_deadline_s: Optional[float] = None,
 ) -> dict:
     """Child environment for one rank (exported for tests/embedders)."""
     env = dict(base_env if base_env is not None else os.environ)
     env["PBOX_COORDINATOR_ADDRESS"] = coordinator
     env["PBOX_NUM_PROCESSES"] = str(nproc)
     env["PBOX_PROCESS_ID"] = str(rank)
+    if liveness_deadline_s is not None:
+        # every rank's watchdog (parallel/watchdog.py) reads this flag:
+        # one launcher knob bounds every stage stall in the fleet
+        env["PBOX_LIVENESS_DEADLINE_S"] = str(liveness_deadline_s)
     if devices_per_proc:
         import re
 
@@ -79,16 +84,29 @@ def launch(
     devices_per_proc: Optional[int] = None,
     log_dir: Optional[str] = None,
     poll_interval: float = 0.2,
+    liveness_deadline_s: Optional[float] = None,
+    job_timeout_s: Optional[float] = None,
 ) -> int:
     """Spawn nproc ranks of ``python script_args...``; return the first
     non-zero exit code (0 if all ranks succeed).  Any rank dying kills the
     rest — a half-alive job would hang in the next collective forever
-    (reference: watch_local_trainers + terminate_local_procs)."""
+    (reference: watch_local_trainers + terminate_local_procs).
+
+    liveness_deadline_s: forwarded to every rank as
+    PBOX_LIVENESS_DEADLINE_S (the per-stage stall bound the in-process
+    watchdogs enforce).  job_timeout_s: the launcher's own last-resort
+    bound — if the whole fleet is still alive past it (e.g. every rank
+    wedged before its watchdog started), SIGTERM everyone and return 124.
+    """
     coordinator = coordinator or f"127.0.0.1:{find_free_port()}"
     procs: list[subprocess.Popen] = []
     logs = []
+    start_t = time.monotonic()
     for rank in range(nproc):
-        env = rank_env(rank, nproc, coordinator, devices_per_proc)
+        env = rank_env(
+            rank, nproc, coordinator, devices_per_proc,
+            liveness_deadline_s=liveness_deadline_s,
+        )
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
             out = open(os.path.join(log_dir, f"rank{rank}.log"), "wb")
@@ -106,6 +124,16 @@ def launch(
     try:
         live = set(range(nproc))
         while live:
+            if (
+                job_timeout_s is not None
+                and time.monotonic() - start_t > job_timeout_s
+                and rc == 0
+            ):
+                # fleet-level liveness backstop: nothing below us freed the
+                # job, so the launcher does (124 = the timeout convention)
+                rc = 124
+                for r in live:
+                    procs[r].send_signal(signal.SIGTERM)
             for r in sorted(live):
                 code = procs[r].poll()
                 if code is None:
@@ -148,6 +176,12 @@ def main(argv: Optional[list[str]] = None) -> int:
                     help="virtual CPU devices per process (test/dev tier)")
     ap.add_argument("--log-dir", default=None,
                     help="write per-rank logs here instead of the console")
+    ap.add_argument("--liveness-deadline", type=float, default=None,
+                    help="per-stage stall bound (s) for every rank's "
+                         "watchdog (PBOX_LIVENESS_DEADLINE_S)")
+    ap.add_argument("--job-timeout", type=float, default=None,
+                    help="kill the whole fleet after this many seconds "
+                         "(last-resort bound; exit code 124)")
     ap.add_argument("script", help="training script to run")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -157,6 +191,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         coordinator=args.coordinator,
         devices_per_proc=args.devices_per_proc,
         log_dir=args.log_dir,
+        liveness_deadline_s=args.liveness_deadline,
+        job_timeout_s=args.job_timeout,
     )
 
 

@@ -34,6 +34,7 @@ from jax.sharding import PartitionSpec as P
 
 from paddlebox_tpu.models.layers import init_mlp, mlp, resolve_compute_dtype
 from paddlebox_tpu.ops import fused_seqpool_cvm, pooled_width
+from paddlebox_tpu.utils.jax_compat import axis_size, shard_map
 from paddlebox_tpu.parallel.sequence import (
     SEQ_AXIS,
     full_attention,
@@ -131,7 +132,7 @@ class LongSeqCtrDnn:
         def body(q, k, v, valid):
             # trace-time shape validation for the "inherit" mode, where no
             # concrete mesh exists at __init__ (axis_size is static here)
-            p = jax.lax.axis_size(SEQ_AXIS)
+            p = axis_size(SEQ_AXIS)
             if T % p:
                 raise ValueError(
                     f"max_seq_len {T} not divisible by the {SEQ_AXIS!r} "
@@ -150,12 +151,12 @@ class LongSeqCtrDnn:
         sspec = P(None, SEQ_AXIS)
         in_specs = (sspec, sspec, sspec, sspec)
         if self.seq_mesh == "inherit":
-            sm = jax.shard_map(
+            sm = shard_map(
                 body, in_specs=in_specs, out_specs=sspec,
                 axis_names={SEQ_AXIS}, check_vma=False,
             )
         else:
-            sm = jax.shard_map(
+            sm = shard_map(
                 body, mesh=self.seq_mesh, in_specs=in_specs, out_specs=sspec,
             )
         return sm(q, k, v, valid)

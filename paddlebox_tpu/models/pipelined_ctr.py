@@ -45,6 +45,7 @@ from paddlebox_tpu.models.layers import (
     resolve_compute_dtype,
 )
 from paddlebox_tpu.ops import fused_seqpool_cvm, pooled_width
+from paddlebox_tpu.utils.jax_compat import axis_size, shard_map
 from paddlebox_tpu.parallel.pipeline import PIPE_AXIS, gpipe_run
 
 
@@ -164,7 +165,7 @@ class PipelinedCtrDnn:
         live = jnp.asarray(self._live)
         head = jnp.asarray(self._head)
         M, mb, A = x_pad.shape
-        p_axis = jax.lax.axis_size(PIPE_AXIS)
+        p_axis = axis_size(PIPE_AXIS)
         idx = jax.lax.axis_index(PIPE_AXIS)
 
         def stage_fn(m_in, act, is_first):
@@ -222,7 +223,7 @@ class PipelinedCtrDnn:
             x_pad = x_pad.astype(self.compute_dtype)
         x_mb = x_pad.reshape(M, B // M, self.A)
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             self._pipeline_logits,
             mesh=self.mesh,
             in_specs=(P(PIPE_AXIS), P()),

@@ -1,3 +1,11 @@
+# watchdog first: it is jax-free, and importing it before anything that
+# touches jax APIs guarantees the liveness layer stays cached in
+# sys.modules even on a build where a later import fails
+from paddlebox_tpu.parallel.watchdog import (
+    DistributedStallError,
+    LivenessConfig,
+    Watchdog,
+)
 from paddlebox_tpu.parallel.mesh import make_mesh, initialize_distributed
 from paddlebox_tpu.parallel.sharded_table import ShardedSparseTable, ShardedBatchPlan
 from paddlebox_tpu.parallel.trainer import MultiChipTrainer
@@ -10,6 +18,9 @@ from paddlebox_tpu.parallel.sequence import (
 )
 
 __all__ = [
+    "DistributedStallError",
+    "LivenessConfig",
+    "Watchdog",
     "full_attention",
     "ring_attention",
     "ulysses_attention",

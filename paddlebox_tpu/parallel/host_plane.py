@@ -27,8 +27,51 @@ reading every key at s, so a two-deep window is always safe.
 from __future__ import annotations
 
 import base64
+import time
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
+
+from paddlebox_tpu.utils import faults
+
+
+class HostPlaneTimeout(TimeoutError):
+    """A KV-channel gather exhausted its deadline waiting on peers.
+
+    Names the exact missing ``(channel, sequence, peer)`` keys so the
+    operator reads WHO stalled straight from the error instead of
+    correlating logs across hosts.  ``missing`` is [(rank, key), ...].
+    """
+
+    def __init__(self, channel: str, seq: int, waited_s: float,
+                 missing: Sequence[Tuple[int, str]]):
+        self.channel = channel
+        self.seq = seq
+        self.waited_s = float(waited_s)
+        self.missing = list(missing)
+        ranks = [r for r, _ in self.missing]
+        keys = ", ".join(k for _, k in self.missing)
+        super().__init__(
+            f"host-plane allgather timed out after {self.waited_s:.1f}s on "
+            f"channel {channel!r} sequence {seq}: no payload from "
+            f"process(es) {ranks} (missing keys: {keys})"
+        )
+
+
+class _PeerWaitTimeout(Exception):
+    """Internal: one peer read exhausted the deadline (aggregated into
+    HostPlaneTimeout by allgather)."""
+
+    def __init__(self, rank: int, key: str):
+        self.rank = rank
+        self.key = key
+
+
+def _looks_like_deadline(exc: Exception) -> bool:
+    """The coordination client signals a blocking-get timeout with a
+    runtime error whose status is DEADLINE_EXCEEDED; anything else is a
+    real transport failure and must propagate."""
+    return "deadline" in str(exc).lower()
 
 
 def _client():
@@ -54,13 +97,31 @@ class KvChannel:
     shared-queue entanglement with other streams.
     """
 
-    def __init__(self, name: str, timeout_s: float = 3600.0):
-        # default 1h: a peer legitimately stalls this long during a first
-        # XLA compile or a capacity-bump recompile with a full prefetch
-        # queue — the device-collective path this replaces would simply
-        # have waited, so the KV plane must not be the stricter one
+    # how long one blocking-get slice lasts before the poll loop re-checks
+    # the watchdog abort latch (coordinated aborts interrupt a gather
+    # within this bound, not the full channel timeout)
+    POLL_S = 1.0
+
+    def __init__(self, name: str, timeout_s: Optional[float] = None):
+        # default 1h (liveness flags): a peer legitimately stalls this long
+        # during a first XLA compile or a capacity-bump recompile with a
+        # full prefetch queue — the device-collective path this replaces
+        # would simply have waited, so the KV plane must not be the
+        # stricter one.  Resolution: explicit arg > the active watchdog's
+        # LivenessConfig > the PBOX_HOSTPLANE_TIMEOUT_S flag.
+        if timeout_s is None:
+            from paddlebox_tpu.parallel import watchdog as _wd
+
+            wd = _wd.current()
+            if wd is not None:
+                timeout_s = wd.conf.hostplane_timeout_s
+            else:
+                from paddlebox_tpu.config import flags
+
+                timeout_s = flags.hostplane_timeout_s
         self.name = name
-        self.timeout_ms = int(timeout_s * 1000)
+        self.timeout_s = float(timeout_s)
+        self.timeout_ms = int(self.timeout_s * 1000)
         self._seq = 0
         import jax
 
@@ -73,7 +134,18 @@ class KvChannel:
 
     def allgather(self, x: np.ndarray) -> np.ndarray:
         """Gather a same-shape/dtype host array from every process ->
-        [P, ...] (matches multiprocess.host_allgather's contract)."""
+        [P, ...] (matches multiprocess.host_allgather's contract).
+
+        The wait is deadline-bounded and watchdog-aware: each peer read
+        polls in ``POLL_S`` slices, re-checking the active liveness
+        watchdog between slices (a coordinated abort interrupts the gather
+        with the structured DistributedStallError within one slice), and a
+        deadline raises :class:`HostPlaneTimeout` listing the exact
+        missing (channel, sequence, peer) keys."""
+        from paddlebox_tpu.parallel import watchdog as _wd
+
+        faults.inject("hostplane.allgather")  # chaos site: raise or hang
+        _wd.beat(f"hostplane:{self.name}")
         x = np.ascontiguousarray(x)
         client = _client()
         s = self._seq
@@ -82,16 +154,36 @@ class KvChannel:
             self._key(s, self._rank),
             base64.b64encode(x.tobytes()).decode("ascii"),
         )
+        deadline = time.monotonic() + self.timeout_s
 
         def read(r: int) -> np.ndarray:
-            raw = client.blocking_key_value_get(
-                self._key(s, r), self.timeout_ms
-            )
-            return np.frombuffer(
-                base64.b64decode(raw), dtype=x.dtype
-            ).reshape(x.shape)
+            key = self._key(s, r)
+            while True:
+                _wd.check()  # pending abort interrupts the wait
+                # an ACTIVE bounded wait on a remote peer counts as alive:
+                # the peer's own watchdog covers the peer, this wait's
+                # deadline covers the channel, and beating here keeps this
+                # process from being misnamed as the culprit while it is
+                # merely the victim of a peer's stall
+                _wd.beat(f"hostplane:{self.name}")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise _PeerWaitTimeout(r, key)
+                slice_ms = max(int(min(self.POLL_S, remaining) * 1000), 1)
+                try:
+                    raw = client.blocking_key_value_get(key, slice_ms)
+                except Exception as e:
+                    if _looks_like_deadline(e):
+                        continue  # slice expired: poll again
+                    raise
+                _wd.beat(f"hostplane:{self.name}")
+                return np.frombuffer(
+                    base64.b64decode(raw), dtype=x.dtype
+                ).reshape(x.shape)
 
         peers = [r for r in range(self._world) if r != self._rank]
+        fetched: dict = {}
+        missing: list = []
         if len(peers) > 1:
             # concurrent reads: sequential blocking gets would serialize
             # (P-1) round-trips to the coordination leader per gather
@@ -102,9 +194,22 @@ class KvChannel:
                     max_workers=min(len(peers), 16),
                     thread_name_prefix=f"kvch-{self.name}",
                 )
-            fetched = dict(zip(peers, self._pool.map(read, peers)))
+            futures = {r: self._pool.submit(read, r) for r in peers}
+            for r, fut in futures.items():
+                try:
+                    fetched[r] = fut.result()
+                except _PeerWaitTimeout as t:
+                    missing.append((t.rank, t.key))
         else:
-            fetched = {r: read(r) for r in peers}
+            for r in peers:
+                try:
+                    fetched[r] = read(r)
+                except _PeerWaitTimeout as t:
+                    missing.append((t.rank, t.key))
+        if missing:
+            raise HostPlaneTimeout(
+                self.name, s, self.timeout_s, sorted(missing)
+            )
         parts = [x if r == self._rank else fetched[r]
                  for r in range(self._world)]
         # windowed GC of our own past key (see module docstring)

@@ -37,8 +37,16 @@ def initialize_distributed(
         # (which forces jax_platforms="axon,cpu" over the env var) BEFORE
         # any backend init
         jax.config.update("jax_platforms", "cpu")
-    if jax.distributed.is_initialized():
-        return
+    if getattr(jax.distributed, "is_initialized", None) is not None:
+        if jax.distributed.is_initialized():
+            return
+    else:
+        # legacy jax (<0.5): no is_initialized — inspect the global state
+        # the client lives on (same source jax itself consults)
+        from jax._src import distributed as _dist
+
+        if _dist.global_state.client is not None:
+            return
     if coordinator_address is None:
         coordinator_address = os.environ.get("PBOX_COORDINATOR_ADDRESS")
     if num_processes is None and "PBOX_NUM_PROCESSES" in os.environ:

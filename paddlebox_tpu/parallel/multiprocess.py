@@ -72,7 +72,12 @@ def host_allgather(x: np.ndarray) -> np.ndarray:
     if not is_multiprocess():
         return np.asarray(x)[None]
     from jax.experimental import multihost_utils
+    from paddlebox_tpu.parallel import watchdog
 
+    # a device collective can't be deadline-bounded from here, but the
+    # stage beat keeps the liveness watchdog's progress counter honest
+    # while a pass-boundary gather is legitimately in flight
+    watchdog.beat("hostplane:process_allgather")
     return np.asarray(multihost_utils.process_allgather(x))
 
 

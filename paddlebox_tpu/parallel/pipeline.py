@@ -39,6 +39,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddlebox_tpu.utils.jax_compat import axis_size, pcast
+
 PIPE_AXIS = "pipe"
 
 
@@ -105,7 +107,7 @@ def gpipe_run(stage_fn, emit_fn, n_microbatches: int, act0: jax.Array):
           is_last & tick within range).
     Returns emissions stacked [T, ...].
     """
-    p_axis = jax.lax.axis_size(PIPE_AXIS)
+    p_axis = axis_size(PIPE_AXIS)
     idx = jax.lax.axis_index(PIPE_AXIS)
     M = n_microbatches
     T = M + p_axis - 1
@@ -128,7 +130,7 @@ def gpipe_run(stage_fn, emit_fn, n_microbatches: int, act0: jax.Array):
 
     # the carry becomes device-varying after the first tick: mark it so up
     # front (shard_map's varying-axes typing requires carry in/out to match)
-    vary = lambda v: jax.lax.pcast(v, (PIPE_AXIS,), to="varying")
+    vary = lambda v: pcast(v, (PIPE_AXIS,), to="varying")
     _, emits = jax.lax.scan(tick, vary(act0), jnp.arange(T))
     return emits
 
@@ -224,7 +226,9 @@ class PipelineTrainer:
 
         spec = P(PIPE_AXIS)
         rep = P()  # microbatches replicated across stages
-        mapped = jax.shard_map(
+        from paddlebox_tpu.utils.jax_compat import shard_map
+
+        mapped = shard_map(
             body,
             mesh=self.mesh,
             in_specs=(spec, spec, rep, rep, rep),

@@ -62,13 +62,14 @@ from paddlebox_tpu.parallel.multiprocess import (
 from paddlebox_tpu.parallel.sharded_table import ShardedBatchPlan, ShardedSparseTable
 from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
 from paddlebox_tpu.sparse.table import gather_rows, scatter_add_rows
+from paddlebox_tpu.utils import faults
 from paddlebox_tpu.train.slot_policy import (
     normalize_slot_mask,
     resolve_slot_lr_vec,
     slot_participation_vec,
 )
 
-shard_map = jax.shard_map
+from paddlebox_tpu.utils.jax_compat import shard_map
 
 # process-wide pass counter for host-plane channel names: advances once per
 # training pass in every process (all processes drive passes in lockstep,
@@ -428,12 +429,16 @@ class MultiChipTrainer:
         ncclAllReduce / reduce-scatter+allgather then scale, boxps_worker.cc:481-521)."""
 
         def body(params, opt_state):
-            pm = jax.tree.map(
-                lambda x: jax.lax.pmean(x[0], DATA_AXIS)[None], params
-            )
-            om = jax.tree.map(
-                lambda x: jax.lax.pmean(x[0], DATA_AXIS)[None], opt_state
-            )
+            def avg(x):
+                # integer leaves (adam's step count) are identical across
+                # replicas by construction and a pmean would promote them
+                # to float — pass them through untouched
+                if not jnp.issubdtype(x.dtype, jnp.floating):
+                    return x
+                return jax.lax.pmean(x[0], DATA_AXIS)[None]
+
+            pm = jax.tree.map(avg, params)
+            om = jax.tree.map(avg, opt_state)
             return pm, om
 
         spec = P(DATA_AXIS)
@@ -575,6 +580,21 @@ class MultiChipTrainer:
         uses_rank = getattr(self.model, "uses_rank_offset", False)
         uses_seq = getattr(self.model, "uses_seq_pos", False)
 
+        # distributed-liveness watchdog: heartbeats through the same KV
+        # store the planning plane rides, local + peer stall detection,
+        # poison-key coordinated abort.  Namespaced per pass (global_step
+        # advances in lockstep across processes) so heartbeat keys from a
+        # previous aborted pass can never poison a fresh one.
+        from paddlebox_tpu.parallel import watchdog as _wd_mod
+
+        wd = None
+        if self.conf.liveness is not None:
+            wd = _wd_mod.for_trainer(
+                self.conf.liveness, namespace=f"train-{self.global_step}"
+            )
+            if wd is not None:
+                wd.start()
+
         # the producer's collectives must be HOST-side: it runs concurrent
         # with the consumer's device step, and two threads racing device
         # collectives onto the queues in different orders across processes
@@ -587,7 +607,11 @@ class MultiChipTrainer:
             _PLAN_CHANNEL_SEQ[0] += 1
             plan_channel = KvChannel(
                 f"plan-{_PLAN_CHANNEL_SEQ[0]}",
-                timeout_s=self.conf.host_plane_timeout_s,
+                timeout_s=(
+                    self.conf.liveness.hostplane_timeout_s
+                    if self.conf.liveness is not None
+                    else self.conf.host_plane_timeout_s
+                ),
             )
             plan_gather = plan_channel.allgather
         else:
@@ -606,6 +630,8 @@ class MultiChipTrainer:
             template = None  # last real batch: shapes for tail-padding
             n_slots = None
             while True:
+                if wd is not None:
+                    wd.report("feed")
                 group = next(groups_it, None)
                 if multiproc:
                     # ragged-tail barrier: a process out of groups must keep
@@ -691,11 +717,16 @@ class MultiChipTrainer:
             feed_iter = prefetcher
         try:
             for feed, dump_group in feed_iter:
+                # chaos site: a hang here simulates a stalled device step
+                # on this process; the watchdog bounds it fleet-wide
+                faults.inject("train.step")
                 out = self._step_fn(
                     self.params, self.opt_state, values, g2sum, mstate, feed
                 )
                 (self.params, self.opt_state, values, g2sum, mstate, loss,
                  cnt, finite) = out[:8]
+                if wd is not None:
+                    wd.report("step")
                 if dumper is not None:
                     # [L, B] local predictions; pad batches dump nothing
                     preds = local_view(out[-1])
@@ -736,10 +767,25 @@ class MultiChipTrainer:
                 pending_grads.clear()
                 self.async_dense.drain()
                 self.params = self._stack_local(self.async_dense.pull())
+        except _wd_mod.DistributedStallError:
+            # coordinated abort: every process converges on the same
+            # structured error (poison key); teardown in the finally below
+            # leaves no dangling producer thread.  Recovery is the
+            # driver's: restart the job and resume from the newest valid
+            # checkpoint (AutoCheckpointer.resume / find_valid_tag) — the
+            # aborted pass never reached after_pass, so nothing partial
+            # survives the replay.
+            from paddlebox_tpu.utils.monitor import stats
+
+            stats.add("train.stall_aborts")
+            raise
         finally:
             # the old table buffers were donated to the jitted step: always
             # hand the live ones back so end_pass() can salvage the pass even
-            # when check_nan_inf raises mid-loop
+            # when check_nan_inf raises mid-loop.  The watchdog retires
+            # FIRST so its abort latch cannot fire into the teardown.
+            if wd is not None:
+                wd.close()
             table.values, table.g2sum = values, g2sum
             if prefetcher is not None:
                 prefetcher.close()

@@ -1,0 +1,593 @@
+"""Distributed liveness: heartbeats, stall detection, coordinated abort.
+
+Multi-host training has a failure mode single-process fault tolerance
+(utils/retry, utils/faults, checkpoint fallback) cannot touch: a HANG.  One
+process stuck in a feed read, a device step, a host-plane gather or a
+shuffle exchange silently stalls the whole fleet — every peer blocks in its
+next collective and the job burns hours producing nothing, with no culprit
+in any log.  Parameter-server systems treat inter-worker liveness as
+first-class (Parameter Box, arxiv 1801.09805; Parallax, arxiv 1808.02621);
+this module is that layer for the KV-coordinated plane here:
+
+  * every process ``report()``s its current *stage* (``feed``, ``step``,
+    ``hostplane:<channel>``, ``shuffle``) with a monotonic progress counter;
+  * a per-process :class:`Watchdog` thread publishes heartbeats carrying
+    (stage, progress) through the coordination-service KV store (the same
+    transport ``KvChannel`` rides) and detects both LOCAL stalls (our own
+    progress counter frozen past the deadline) and PEER stalls (a peer's
+    heartbeat progress frozen — measured by when *we* last saw it change,
+    so host clock skew never matters);
+  * detection converges through a POISON KEY: the first detector writes one
+    key naming the culprit (rank, stage, stall age) and every watchdog
+    polls it, so the whole fleet aborts with the SAME structured
+    :class:`DistributedStallError` instead of each rank timing out
+    separately with a different story;
+  * every bounded wait in the system (``KvChannel`` gathers, ``TcpShuffler``
+    exchanges, prefetch-queue gets, injected-fault hangs) calls
+    :meth:`Watchdog.check` from its poll loop, so an abort interrupts
+    blocked threads within one poll interval.
+
+The module is deliberately jax-free at import time: the same watchdog
+guards single-process ``jax_platforms=cpu`` runs (local stall detection
+only, ``kv=None``) and unit tests drive the detector synchronously through
+:meth:`Watchdog.tick` with an injected clock and an :class:`InMemoryKv`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from paddlebox_tpu.config import LivenessConfig
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.monitor import stats
+
+logger = logging.getLogger(__name__)
+
+
+class DistributedStallError(RuntimeError):
+    """A process stalled past the liveness deadline and the run aborted.
+
+    Structured so drivers/operators get a named culprit instead of a bare
+    timeout: ``culprit`` (process index), ``stage`` (what it was last
+    doing), ``age_s`` (how long its progress counter was frozen),
+    ``progress`` (its last progress count), ``detected_by`` (which rank
+    noticed) and ``kind`` ("local" | "peer" | "poison").
+    """
+
+    def __init__(
+        self,
+        culprit: int,
+        stage: str,
+        kind: str,
+        age_s: float,
+        progress: int,
+        detected_by: int,
+        message: Optional[str] = None,
+    ):
+        self.culprit = int(culprit)
+        self.stage = stage
+        self.kind = kind
+        self.age_s = float(age_s)
+        self.progress = int(progress)
+        self.detected_by = int(detected_by)
+        super().__init__(
+            message
+            or (
+                f"distributed stall: process {self.culprit} stalled in stage "
+                f"{self.stage!r} (no progress for {self.age_s:.1f}s, "
+                f"progress={self.progress}; detected by process "
+                f"{self.detected_by}, {self.kind} check)"
+            )
+        )
+
+    def to_payload(self) -> str:
+        """The poison-key payload: everything a peer needs to rebuild the
+        SAME error locally (no free-text parsing on the read side)."""
+        return json.dumps(
+            {
+                "culprit": self.culprit,
+                "stage": self.stage,
+                "kind": self.kind,
+                "age_s": self.age_s,
+                "progress": self.progress,
+                "detected_by": self.detected_by,
+            }
+        )
+
+    @staticmethod
+    def from_payload(raw: str, reader_rank: int) -> "DistributedStallError":
+        try:
+            d = json.loads(raw)
+            return DistributedStallError(
+                culprit=d["culprit"],
+                stage=d["stage"],
+                kind="poison",
+                age_s=d.get("age_s", 0.0),
+                progress=d.get("progress", -1),
+                detected_by=d.get("detected_by", reader_rank),
+            )
+        except (ValueError, KeyError, TypeError):
+            # a corrupt poison key still means SOMEONE aborted: converge
+            return DistributedStallError(
+                culprit=-1, stage="unknown", kind="poison", age_s=0.0,
+                progress=-1, detected_by=reader_rank,
+                message=f"distributed abort via poison key (payload {raw!r})",
+            )
+
+
+# --------------------------------------------------------------------------- #
+# staleness math (pure, unit-testable)
+# --------------------------------------------------------------------------- #
+class PeerTracker:
+    """Progress-staleness accounting over observed (stage, progress) pairs.
+
+    The tracked age of a peer is measured from when the OBSERVER last saw
+    its progress counter change (or from first tracking, for a peer that
+    never reported) — never from timestamps inside the heartbeat, so host
+    clock skew cannot fake or mask a stall.  Used for peers (fed from KV
+    heartbeats) and for the local process itself (fed from the in-process
+    stage state): one math, two sources.
+    """
+
+    def __init__(self):
+        # rank -> (progress, stage, local time progress last changed)
+        self._seen: Dict[int, Tuple[int, str, float]] = {}
+
+    def observe(self, rank: int, progress: int, stage: str, now: float) -> None:
+        prev = self._seen.get(rank)
+        if prev is None or progress != prev[0]:
+            self._seen[rank] = (progress, stage, now)
+        else:
+            # progress frozen: keep the original change time, refresh stage
+            # (a live heartbeat may still rotate its stage label)
+            self._seen[rank] = (prev[0], stage, prev[2])
+
+    def age(self, rank: int, now: float) -> Optional[float]:
+        """Seconds since ``rank``'s progress last changed (None = never
+        observed)."""
+        prev = self._seen.get(rank)
+        return None if prev is None else now - prev[2]
+
+    def last(self, rank: int) -> Tuple[int, str]:
+        """(progress, stage) last observed for ``rank``."""
+        prev = self._seen.get(rank)
+        return (-1, "unknown") if prev is None else (prev[0], prev[1])
+
+    def stale(self, now: float, deadline_s: float) -> list:
+        """[(rank, age_s, progress, stage)] of every tracked rank whose
+        progress has been frozen longer than ``deadline_s``."""
+        out = []
+        for rank, (progress, stage, t) in sorted(self._seen.items()):
+            age = now - t
+            if age > deadline_s:
+                out.append((rank, age, progress, stage))
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# KV transports
+# --------------------------------------------------------------------------- #
+class InMemoryKv:
+    """Process-local KV store with the coordination-service surface —
+    simulated multi-worker tests share ONE of these across their fake
+    ranks' watchdogs; single-process production runs don't need one at all
+    (``Watchdog(kv=None)`` does local stall detection only)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, str] = {}
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            return self._data.get(key)
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._data.pop(key, None)
+
+
+class CoordKv:
+    """The JAX coordination-service KV store (requires
+    jax.distributed.initialize — the launcher's job), non-blocking reads.
+
+    This is the same leader store ``KvChannel`` rides; watchdog keys live
+    under their own ``pbox_wd/`` prefix so they can never collide with a
+    channel's ``pbox_hp/`` sequence keys.
+    """
+
+    def __init__(self):
+        from paddlebox_tpu.parallel.host_plane import _client
+
+        self._client = _client()
+
+    def set(self, key: str, value: str) -> None:
+        # heartbeat keys are REWRITTEN every interval; the service rejects
+        # plain re-sets (ALREADY_EXISTS), so overwrite explicitly and fall
+        # back to delete+set on runtimes without the kwarg
+        try:
+            self._client.key_value_set(key, value, allow_overwrite=True)
+            return
+        except TypeError:
+            pass
+        try:
+            self._client.key_value_set(key, value)
+        except Exception as e:
+            if "ALREADY_EXISTS" not in str(e):
+                raise
+            self.delete(key)
+            self._client.key_value_set(key, value)
+
+    def get(self, key: str) -> Optional[str]:
+        # the coordination client has no try-get: a ~0 timeout blocking get
+        # is the poll primitive (DEADLINE_EXCEEDED -> absent)
+        try:
+            return self._client.blocking_key_value_get(key, 1)
+        except Exception:
+            return None
+
+    def delete(self, key: str) -> None:
+        try:
+            self._client.key_value_delete(key)
+        except Exception:
+            pass  # older runtimes without delete: key leaks, bounded
+
+
+# --------------------------------------------------------------------------- #
+# the watchdog
+# --------------------------------------------------------------------------- #
+class Watchdog:
+    """Per-process liveness monitor + coordinated-abort participant.
+
+    Lifecycle: construct with the process's (rank, world) and a KV store
+    (None = single-process, local checks only), ``start()`` the monitor
+    thread, ``report(stage)`` from the pipeline's hot points, and wrap
+    every bounded wait's poll loop with ``check()``.  ``close()`` always —
+    it retires the thread, unhooks the fault-injection hang interrupt and
+    deletes this process's heartbeat key.  Context-manager form does
+    start/close.
+    """
+
+    def __init__(
+        self,
+        conf: Optional[LivenessConfig] = None,
+        *,
+        rank: int = 0,
+        world: int = 1,
+        kv=None,
+        namespace: str = "default",
+        clock: Callable[[], float] = time.monotonic,
+        install_current: bool = True,
+        hard_exit_grace_s: Optional[float] = None,
+    ):
+        self.conf = conf or LivenessConfig.from_flags()
+        self.rank = int(rank)
+        self.world = int(world)
+        self.kv = kv
+        self.namespace = namespace
+        self._clock = clock
+        self._install_current = install_current
+        # multi-process escape hatch: a rank wedged inside a device
+        # collective can't unwind via Python, so after the grace the
+        # process hard-exits and the launcher/pod controller reaps the
+        # fleet.  close() cancels it — a cleanly-unwound run never exits.
+        self._hard_exit_grace_s = (
+            hard_exit_grace_s
+            if hard_exit_grace_s is not None and hard_exit_grace_s > 0
+            else None
+        )
+        self._hard_exit_cancel = threading.Event()
+        self._lock = threading.Lock()
+        self._stage = "start"
+        self._progress = 0
+        self._tracker = PeerTracker()
+        self._last_hb = -float("inf")
+        self._aborted = threading.Event()
+        self._error: Optional[DistributedStallError] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._unhook: Optional[Callable[[], None]] = None
+        # the local process starts tracked from construction time: a run
+        # that never reports ANY stage is itself a stall (stage "start")
+        self._tracker.observe(self.rank, 0, "start", self._clock())
+
+    # -- keys --------------------------------------------------------------- #
+    def _hb_key(self, rank: int) -> str:
+        return f"pbox_wd/{self.namespace}/hb/{rank}"
+
+    @property
+    def poison_key(self) -> str:
+        return f"pbox_wd/{self.namespace}/poison"
+
+    # -- stage reporting ---------------------------------------------------- #
+    def report(self, stage: str) -> None:
+        """Record progress: the caller is alive and entering ``stage``.
+        Callable from any thread (feed producer, consumer, shuffler)."""
+        with self._lock:
+            self._stage = stage
+            self._progress += 1
+
+    def state(self) -> Tuple[str, int]:
+        with self._lock:
+            return self._stage, self._progress
+
+    # -- abort plumbing ----------------------------------------------------- #
+    @property
+    def aborted(self) -> bool:
+        return self._aborted.is_set()
+
+    @property
+    def error(self) -> Optional[DistributedStallError]:
+        return self._error
+
+    def check(self) -> None:
+        """Raise the abort error if the run has been poisoned/stalled.
+        Bounded waits call this from their poll loops; injected-fault hang
+        loops call it too (registered via faults.register_hang_interrupt),
+        so even a simulated freeze terminates with the structured error."""
+        if self._aborted.is_set():
+            assert self._error is not None
+            raise self._error
+
+    def abort(self, err: DistributedStallError, poison: bool = True) -> None:
+        """Converge the fleet on ``err``: publish the poison key (unless
+        we're reacting to one) and trip the local abort latch."""
+        if self._aborted.is_set():
+            return
+        if poison and self.kv is not None:
+            try:
+                self.kv.set(self.poison_key, err.to_payload())
+                stats.add("watchdog.poison_set")
+            except Exception:
+                logger.exception("watchdog: failed to publish poison key")
+        self._error = err
+        self._aborted.set()
+        stats.add("watchdog.aborts")
+        logger.error("watchdog abort: %s", err)
+        if self._hard_exit_grace_s is not None:
+            threading.Thread(
+                target=self._hard_exit_reaper,
+                name=f"pbox-watchdog-reaper-r{self.rank}",
+                daemon=True,
+            ).start()
+
+    def _hard_exit_reaper(self) -> None:
+        if self._hard_exit_cancel.wait(self._hard_exit_grace_s):
+            return  # clean unwind won the race
+        import os
+
+        logger.error(
+            "watchdog: process %d did not unwind within %.1fs of abort "
+            "(wedged in a device collective?); hard-exiting 124 — %s",
+            self.rank, self._hard_exit_grace_s, self._error,
+        )
+        # best effort: make the culprit visible on stderr even when
+        # logging isn't configured in this process
+        print(
+            f"[pbox-watchdog] hard exit (rank {self.rank}): {self._error}",
+            flush=True,
+        )
+        os._exit(124)
+
+    # -- detector ----------------------------------------------------------- #
+    def _publish_heartbeat(self, now: float) -> None:
+        if self.kv is None:
+            return
+        if now - self._last_hb < self.conf.heartbeat_interval_s:
+            return
+        try:
+            # chaos site: a hang here freezes THIS watchdog's publisher —
+            # exactly a dead-process signature — and peers must catch it
+            faults.inject("watchdog.heartbeat")
+        except faults.FaultInjected:
+            stats.add("watchdog.heartbeat_faults")
+            return
+        stage, progress = self.state()
+        try:
+            self.kv.set(
+                self._hb_key(self.rank),
+                json.dumps(
+                    {"rank": self.rank, "stage": stage, "progress": progress}
+                ),
+            )
+            self._last_hb = now
+            stats.add("watchdog.heartbeats")
+        except Exception:
+            logger.exception("watchdog: heartbeat publish failed")
+
+    def _check_poison(self, now: float) -> bool:
+        if self.kv is None:
+            return False
+        raw = self.kv.get(self.poison_key)
+        if raw is None:
+            return False
+        self.abort(
+            DistributedStallError.from_payload(raw, self.rank), poison=False
+        )
+        return True
+
+    def _check_local(self, now: float) -> bool:
+        stage, progress = self.state()
+        self._tracker.observe(self.rank, progress, stage, now)
+        age = self._tracker.age(self.rank, now)
+        if age is not None and age > self.conf.deadline_s:
+            self.abort(
+                DistributedStallError(
+                    culprit=self.rank, stage=stage, kind="local", age_s=age,
+                    progress=progress, detected_by=self.rank,
+                )
+            )
+            return True
+        return False
+
+    def _check_peers(self, now: float) -> bool:
+        if self.kv is None:
+            return False
+        for r in range(self.world):
+            if r == self.rank:
+                continue
+            raw = self.kv.get(self._hb_key(r))
+            if raw is None:
+                # never-published peers start their staleness clock at our
+                # first attempt to observe them (observe with progress -1)
+                self._tracker.observe(r, -1, "unstarted", now)
+                continue
+            try:
+                hb = json.loads(raw)
+                self._tracker.observe(
+                    r, int(hb["progress"]), str(hb["stage"]), now
+                )
+            except (ValueError, KeyError, TypeError):
+                logger.warning("watchdog: bad heartbeat from rank %d: %r", r, raw)
+        for rank, age, progress, stage in self._tracker.stale(
+            now, self.conf.deadline_s
+        ):
+            if rank == self.rank:
+                continue  # local check already covers us
+            self.abort(
+                DistributedStallError(
+                    culprit=rank, stage=stage, kind="peer", age_s=age,
+                    progress=progress, detected_by=self.rank,
+                )
+            )
+            return True
+        return False
+
+    def tick(self, now: Optional[float] = None) -> bool:
+        """One detector round (heartbeat + poison + local + peers).
+        Returns True when this tick aborted the run.  The monitor thread
+        calls it on the poll cadence; tests call it directly with a fake
+        clock for deterministic staleness/convergence coverage."""
+        if self._aborted.is_set():
+            return True
+        now = self._clock() if now is None else now
+        self._publish_heartbeat(now)
+        return (
+            self._check_poison(now)
+            or self._check_local(now)
+            or self._check_peers(now)
+        )
+
+    # -- lifecycle ---------------------------------------------------------- #
+    def _run(self) -> None:
+        while not self._stop.wait(self.conf.poll_interval_s):
+            try:
+                if self.tick():
+                    return
+            except Exception:
+                # the monitor must never die silently: a crashed watchdog
+                # is a liveness hole
+                logger.exception("watchdog tick failed")
+
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            return self
+        if self._install_current:
+            _install_current(self)
+        # injected hangs (utils/faults "hang:" specs) poll this check, so a
+        # frozen stage raises the structured stall error at the hang site
+        self._unhook = faults.register_hang_interrupt(self.check)
+        self._thread = threading.Thread(
+            target=self._run, name=f"pbox-watchdog-r{self.rank}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._hard_exit_cancel.set()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self._unhook is not None:
+            self._unhook()
+            self._unhook = None
+        if self._install_current:
+            _uninstall_current(self)
+        if self.kv is not None:
+            try:
+                self.kv.delete(self._hb_key(self.rank))
+            except Exception:
+                pass
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# --------------------------------------------------------------------------- #
+# process-wide current watchdog (stage beats from any layer)
+# --------------------------------------------------------------------------- #
+_current_lock = threading.Lock()
+_current: Optional[Watchdog] = None
+
+
+def _install_current(wd: Watchdog) -> None:
+    global _current
+    with _current_lock:
+        _current = wd
+
+
+def _uninstall_current(wd: Watchdog) -> None:
+    global _current
+    with _current_lock:
+        if _current is wd:
+            _current = None
+
+
+def current() -> Optional[Watchdog]:
+    """The process's active watchdog (None outside a guarded run)."""
+    with _current_lock:
+        return _current
+
+
+def beat(stage: str) -> None:
+    """Report progress to the active watchdog, if any — the no-op-when-idle
+    hook lower layers (feed assembly, host collectives, shuffle) call
+    without holding a watchdog reference."""
+    wd = current()
+    if wd is not None:
+        wd.report(stage)
+
+
+def check() -> None:
+    """Raise the active watchdog's abort error, if an abort is pending —
+    for poll loops in layers that only know the module, not the instance."""
+    wd = current()
+    if wd is not None:
+        wd.check()
+
+
+def for_trainer(conf: Optional[LivenessConfig], namespace: str) -> Optional[Watchdog]:
+    """Build (not start) the watchdog a trainer pass should run under:
+    None when liveness is disabled; KV-backed when the process is part of
+    a multi-process job (coordination service available), local-only
+    otherwise.  jax is imported lazily so this module stays import-light.
+    """
+    if conf is None or not conf.enabled:
+        return None
+    rank, world, kv = 0, 1, None
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            rank, world = jax.process_index(), jax.process_count()
+            kv = CoordKv()
+    except Exception:
+        logger.warning("watchdog: no coordination service; local checks only")
+    return Watchdog(
+        conf, rank=rank, world=world, kv=kv, namespace=namespace,
+        # hard exit is a multi-process convergence tool only: a wedged
+        # single-process run can always be ^C'd, and tests must never be
+        # os._exit()ed from a background thread
+        hard_exit_grace_s=conf.hard_exit_grace_s if kv is not None else None,
+    )

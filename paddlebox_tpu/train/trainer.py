@@ -40,6 +40,20 @@ from paddlebox_tpu.utils import faults
 from paddlebox_tpu.utils.monitor import stats
 
 
+def _watchdog_mod():
+    """The liveness watchdog module (parallel/watchdog.py), or None on a
+    build where the parallel package cannot import — the single-chip
+    trainer must keep working there, just without liveness guarding."""
+    try:
+        from paddlebox_tpu.parallel import watchdog
+
+        return watchdog
+    except Exception:
+        import sys
+
+        return sys.modules.get("paddlebox_tpu.parallel.watchdog")
+
+
 class NonFiniteBatchError(FloatingPointError):
     """A batch produced a non-finite loss/grad and the nan_policy did not
     absorb it (policy "raise", or "rollback" before the restore)."""
@@ -210,9 +224,21 @@ class _FeedPrefetcher:
         return self
 
     def __next__(self):
+        import queue
+
         if self._done:  # keep raising after exhaustion/producer death —
             raise StopIteration  # the producer will never put again
-        item = self._q.get()
+        wd_mod = _watchdog_mod()
+        while True:
+            # bounded get: a coordinated liveness abort must interrupt a
+            # consumer blocked on a stalled producer within one poll slice
+            if wd_mod is not None:
+                wd_mod.check()
+            try:
+                item = self._q.get(timeout=0.2)
+                break
+            except queue.Empty:
+                continue
         if item is self._SENTINEL:
             self._done = True
             raise StopIteration
@@ -618,6 +644,22 @@ class Trainer:
 
         prof = StepProfiler() if self.conf.profile else NullProfiler()
 
+        # distributed-liveness watchdog: stage-reported progress (feed /
+        # step) with a stall deadline; single-process runs get local stall
+        # detection, multi-process runs additionally publish heartbeats
+        # and converge on coordinated abort (parallel/watchdog.py)
+        wd_mod = _watchdog_mod()
+        wd = None
+        stall_exc: tuple = ()
+        if wd_mod is not None:
+            stall_exc = (wd_mod.DistributedStallError,)
+            if self.conf.liveness is not None:
+                wd = wd_mod.for_trainer(
+                    self.conf.liveness, namespace=f"train-{self.global_step}"
+                )
+                if wd is not None:
+                    wd.start()
+
         # scan grouping: k steps per device dispatch (disabled while dumping
         # per-batch fields or profiling per-step)
         scan_k = self.conf.scan_steps
@@ -629,6 +671,8 @@ class Trainer:
         def host_feeds():
             """(batch, host feed dict) stream: validation + host planning."""
             for batch in dataset.batches(drop_last=drop_last):
+                if wd is not None:
+                    wd.report("feed")
                 if uses_rank and batch.rank_offset is None:
                     raise RuntimeError(
                         "model requires PV-merged batches with rank_offset: "
@@ -715,12 +759,17 @@ class Trainer:
           try:
             with device_trace(self.conf.trace_dir or None):
               for kind, batch, dev in feed_iter:
+                # chaos site: a hang here simulates a stalled device step;
+                # the watchdog bounds it and names this process + stage
+                faults.inject("train.step")
                 if kind == "scan":
                     (self.params, self.opt_state, values, g2sum, mstate,
                      loss_k, finites) = (
                         self._scan_fn(self.params, self.opt_state, values,
                                       g2sum, mstate, dev)
                     )
+                    if wd is not None:
+                        wd.report("step")
                     k = int(loss_k.shape[0])
                     fin = np.asarray(finites)
                     if check_nan and not fin.all():
@@ -752,6 +801,8 @@ class Trainer:
                     )
                     if prof.enabled:
                         loss.block_until_ready()  # sync for honest timing
+                if wd is not None:
+                    wd.report("step")
                 prof.step_done()
                 if check_nan and not bool(finite):
                     if skip_batches:
@@ -777,7 +828,11 @@ class Trainer:
                 self.global_step += 1
           finally:
             # old buffers were donated to the jitted step: always hand the
-            # live ones back so end_pass() works even after a NaN raise
+            # live ones back so end_pass() works even after a NaN raise.
+            # The watchdog retires FIRST so its abort latch cannot fire
+            # into the teardown itself.
+            if wd is not None:
+                wd.close()
             table.values, table.g2sum = values, g2sum
             if prefetcher is not None:
                 prefetcher.close()
@@ -785,6 +840,19 @@ class Trainer:
                 dumper.close()
         except NonFiniteBatchError:
             if self.conf.nan_policy == "rollback":
+                self._rollback_to_checkpoint(table)  # raises PassRolledBack
+            raise
+        except stall_exc:
+            # coordinated abort: the pass is torn down (prefetcher closed,
+            # buffers handed back).  With rollback_on_abort + an attached
+            # checkpointer, restore the last completed pass so no
+            # partially-applied pass survives; resumed replay is then
+            # bit-exact (PassRolledBack tells the driver where to re-run).
+            stats.add("train.stall_aborts")
+            if (
+                self.conf.liveness is not None
+                and self.conf.liveness.rollback_on_abort
+            ):
                 self._rollback_to_checkpoint(table)  # raises PassRolledBack
             raise
         if self.conf.need_dump_param and self.conf.dump_fields_path:

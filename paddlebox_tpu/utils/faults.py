@@ -16,6 +16,15 @@ Spec forms (string or FaultSpec):
     "p:0.05"       fail each hit with probability 0.05, drawn from a
                    per-site stream seeded by (plan seed, site) — the same
                    plan + seed always fails the same hits
+    "hang:<sel>"   selected hits HANG instead of raising — the stall shape
+                   the distributed-liveness watchdog must bound.  <sel> is
+                   any selector above ("hang:first:1" freezes the first
+                   hit).  A hung site spins until ``release_hangs()`` (a
+                   new ``install()``/``clear()`` releases implicitly) or
+                   until a registered hang interrupt raises — the watchdog
+                   registers its abort check, so a simulated freeze
+                   terminates with the structured DistributedStallError at
+                   the frozen site
 
 Activation: programmatic (``install(plan)`` / the ``fault_plan`` context
 manager in tests) or environmental — ``PBOX_FAULT_PLAN`` holds a
@@ -54,17 +63,23 @@ class FaultSpec:
     fail_first: int = 0  # fail hits 0..fail_first-1
     at: tuple = ()  # fail exactly these hit indices (0-based)
     probability: float = 0.0  # additionally fail each hit with this p
+    hang: bool = False  # selected hits hang (stall) instead of raising
 
     @staticmethod
     def parse(text: str) -> "FaultSpec":
         kind, _, arg = text.partition(":")
+        if kind == "hang":
+            inner = FaultSpec.parse(arg)
+            return dataclasses.replace(inner, hang=True)
         if kind == "first":
             return FaultSpec(fail_first=int(arg))
         if kind == "at":
             return FaultSpec(at=tuple(int(x) for x in arg.split(",") if x))
         if kind == "p":
             return FaultSpec(probability=float(arg))
-        raise ValueError(f"bad fault spec {text!r} (want first:N|at:I,J|p:F)")
+        raise ValueError(
+            f"bad fault spec {text!r} (want [hang:]first:N|at:I,J|p:F)"
+        )
 
 
 class FaultPlan:
@@ -111,9 +126,14 @@ class FaultPlan:
 
     def check(self, site: str) -> bool:
         """One hit of ``site``; True = this hit must fail."""
+        return self.check_spec(site) is not None
+
+    def check_spec(self, site: str) -> Optional[FaultSpec]:
+        """One hit of ``site``; the matching spec when this hit must fail
+        (the caller dispatches on spec.hang), None when it passes."""
         spec = self._spec_for(site)
         if spec is None:
-            return False
+            return None
         with self._lock:
             hit = self._hits.get(site, 0)
             self._hits[site] = hit + 1
@@ -126,7 +146,7 @@ class FaultPlan:
                     )
                     self._rngs[site] = rng
                 fail = rng.random() < spec.probability
-        return fail
+        return spec if fail else None
 
     def hits(self, site: str) -> int:
         with self._lock:
@@ -137,11 +157,56 @@ _active: Optional[FaultPlan] = None
 _env_checked = False
 _lock = threading.Lock()
 
+# hang machinery: a "hang:" spec spins here until released or interrupted.
+# Interrupt hooks are how the liveness watchdog reaches INTO a simulated
+# freeze — its registered check raises DistributedStallError at the hung
+# site, on the hung thread, exactly like a bounded wait would.
+_hang_release = threading.Event()
+_hang_hooks: list = []
+_hang_lock = threading.Lock()
+
+
+def register_hang_interrupt(fn) -> "callable":
+    """Register ``fn`` to be polled by hung sites; ``fn`` raising ends the
+    hang with that exception.  Returns an unregister callable."""
+    with _hang_lock:
+        _hang_hooks.append(fn)
+
+    def unregister() -> None:
+        with _hang_lock:
+            if fn in _hang_hooks:
+                _hang_hooks.remove(fn)
+
+    return unregister
+
+
+def release_hangs() -> None:
+    """Unstick every currently-hung site (they return as if they ran) and
+    re-arm the latch for future hangs."""
+    global _hang_release
+    with _hang_lock:
+        _hang_release.set()
+        _hang_release = threading.Event()
+
+
+def _hang(site: str) -> None:
+    stats.add(f"faults.hung.{site}")
+    with _hang_lock:
+        release = _hang_release  # the latch armed when the hang began
+    while not release.is_set():
+        with _hang_lock:
+            hooks = list(_hang_hooks)
+        for fn in hooks:
+            fn()  # may raise (watchdog abort)
+        release.wait(0.05)
+
 
 def install(plan: Optional[FaultPlan]) -> None:
-    """Make ``plan`` the process-wide active plan (None deactivates)."""
+    """Make ``plan`` the process-wide active plan (None deactivates).
+    Any sites hung under the PREVIOUS plan are released."""
     global _active, _env_checked
     with _lock:
+        release_hangs()
         _active = plan
         _env_checked = True  # an explicit install outranks the env
 
@@ -173,9 +238,21 @@ def fire(site: str) -> bool:
 
 
 def inject(site: str) -> None:
-    """Raise FaultInjected when the active plan fails this hit of ``site``."""
-    if fire(site):
-        raise FaultInjected(f"injected fault at {site}")
+    """Fail this hit of ``site`` per the active plan: raise FaultInjected,
+    or — for a "hang:" spec — freeze in place until released or until a
+    registered hang interrupt (the liveness watchdog) raises."""
+    plan = active()
+    if plan is None:
+        return
+    stats.add(f"faults.checked.{site}")
+    spec = plan.check_spec(site)
+    if spec is None:
+        return
+    stats.add(f"faults.injected.{site}")
+    if spec.hang:
+        _hang(site)
+        return
+    raise FaultInjected(f"injected fault at {site}")
 
 
 class fault_plan:

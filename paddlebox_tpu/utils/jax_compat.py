@@ -1,0 +1,83 @@
+"""jax API compatibility shims.
+
+The package targets the modern ``jax.shard_map`` surface; older jaxlib
+builds (<= 0.4.x) only ship the legacy ``jax.experimental.shard_map`` API
+(positional mesh, ``auto=``/``check_rep=`` instead of ``axis_names=``/
+``check_vma=``, no context-mesh mode).  Every shard_map call in the
+package routes through this one adapter so a legacy runtime degrades to a
+clear, named error ONLY where a feature genuinely does not exist (the
+context-mesh 'inherit' mode) instead of failing at import and taking the
+whole parallel layer — including the jax-free liveness watchdog — down
+with it.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` (modern) or the legacy static-fold idiom
+    ``psum(1, axis)`` — both return the mapped axis size as a Python int
+    inside a shard_map body."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast(x, axis_names, to="varying"):
+    """``jax.lax.pcast`` (modern varying-axes annotation) — the legacy
+    shard_map has no vma typing, so there it is the identity."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_names, to=to)
+    return x
+
+
+def shard_map(
+    f,
+    *,
+    mesh=None,
+    in_specs=None,
+    out_specs=None,
+    axis_names=None,
+    check_vma=None,
+    **kw,
+):
+    """``jax.shard_map`` when available, else the legacy experimental API.
+
+    Legacy mapping: ``axis_names={...}`` (the manual axes) becomes
+    ``auto = mesh.axis_names - axis_names``; ``check_vma`` maps to
+    ``check_rep``.  The context-mesh mode (``mesh=None``) has no legacy
+    equivalent and raises NotImplementedError naming the jax version.
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs = dict(in_specs=in_specs, out_specs=out_specs, **kw)
+        if mesh is not None:
+            kwargs["mesh"] = mesh
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    if mesh is None:
+        raise NotImplementedError(
+            "context-mesh shard_map (mesh=None / expert_mesh='inherit') "
+            f"requires jax.shard_map; this jax ({jax.__version__}) only has "
+            "the legacy jax.experimental.shard_map API, which needs an "
+            "explicit mesh"
+        )
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    # legacy replication checking predates several collective/autodiff
+    # combinations used here (ring ppermute grads, all_to_all +
+    # segment_sum bodies) and rejects or mis-types them; the permissive
+    # path keeps every parity suite green except one known pipeline-grad
+    # tolerance case, so default to it and let callers opt in via
+    # check_vma=True
+    check_rep = bool(check_vma) if check_vma is not None else False
+    return _legacy(
+        f, mesh, in_specs, out_specs, check_rep=check_rep, auto=auto, **kw
+    )

@@ -46,3 +46,10 @@ def pytest_configure(config):
         "chaos: fault-injection end-to-end test (also marked slow so "
         "tier-1 stays fast; run with -m chaos)",
     )
+    config.addinivalue_line(
+        "markers",
+        "distributed: exercises the multi-process plane (localhost ranks "
+        "via paddlebox_tpu.launch); heavy ones are also marked slow — "
+        "tier-1 (-m 'not slow') still collects everything here without "
+        "needing multi-process JAX",
+    )

@@ -1,0 +1,249 @@
+"""Distributed-liveness end to end: injected hangs at registered sites must
+terminate within 2x the watchdog deadline with a DistributedStallError that
+names the stalled process and stage — and an abort-with-rollback must leave
+no partially-applied pass (resumed replay reproduces the fault-free run,
+PR 1's replay-equality harness).
+
+The single-process tests are tier-1 (fast deadlines, warm compile); the
+frozen-worker fleet test spawns 2 localhost ranks and is chaos/slow.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import (
+    LivenessConfig,
+    SparseTableConfig,
+    TrainerConfig,
+)
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.parallel.watchdog import DistributedStallError, Watchdog
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train import AutoCheckpointer, PassRolledBack, Trainer
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.faults import FaultPlan
+from paddlebox_tpu.utils.monitor import stats
+
+pytestmark = pytest.mark.distributed
+
+S, DENSE, B = 3, 2, 16
+
+FAST = LivenessConfig(
+    deadline_s=1.5, heartbeat_interval_s=0.3, poll_interval_s=0.1
+)
+
+
+def _world(tmp_path, liveness=FAST, seed=0):
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+        max_feasigns_per_ins=8,
+    )
+    files = write_synth_files(
+        str(tmp_path / "data"), n_files=2, ins_per_file=64, n_sparse_slots=S,
+        vocab_per_slot=60, dense_dim=DENSE, seed=9,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    tconf = SparseTableConfig(embedding_dim=4)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(16, 8))
+    table = SparseTable(tconf, seed=seed)
+    trainer = Trainer(
+        model, tconf,
+        TrainerConfig(auc_buckets=1 << 10, liveness=liveness), seed=seed,
+    )
+    return ds, table, trainer
+
+
+def _pass(ds, table, trainer, mstate=None):
+    table.begin_pass(ds.unique_keys())
+    m = trainer.train_from_dataset(ds, table, auc_state=mstate)
+    table.end_pass()
+    return m
+
+
+def test_step_hang_aborts_within_2x_deadline(tmp_path):
+    ds, table, trainer = _world(tmp_path)
+    _pass(ds, table, trainer)  # warm: compile happens outside the clock
+    faults.install(FaultPlan({"train.step": "hang:at:1"}))
+    try:
+        table.begin_pass(ds.unique_keys())
+        t0 = time.monotonic()
+        with pytest.raises(DistributedStallError) as ei:
+            trainer.train_from_dataset(ds, table)
+        dt = time.monotonic() - t0
+        assert dt < 2 * FAST.deadline_s + 1.0, dt
+        err = ei.value
+        assert err.culprit == 0 and err.kind == "local"
+        assert err.stage in ("step", "feed")
+        assert stats.get("train.stall_aborts") >= 1
+        table.end_pass()
+    finally:
+        faults.clear()
+        ds.close()
+
+
+def test_data_read_hang_bounded_by_watchdog(tmp_path):
+    """A hang in the data-read path (the 'stuck storage' shape) is bounded
+    when a watchdog guards the load."""
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+        max_feasigns_per_ins=8,
+    )
+    files = write_synth_files(
+        str(tmp_path / "data"), n_files=1, ins_per_file=32, n_sparse_slots=S,
+        vocab_per_slot=30, dense_dim=DENSE, seed=2,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(files)
+    faults.install(FaultPlan({"data.read": "hang:first:1"}))
+    try:
+        t0 = time.monotonic()
+        with Watchdog(FAST, rank=0, world=1):
+            with pytest.raises(DistributedStallError):
+                ds.load_into_memory()
+        assert time.monotonic() - t0 < 2 * FAST.deadline_s + 1.0
+    finally:
+        faults.clear()
+        ds.close()
+
+
+def test_stall_rollback_leaves_no_partial_pass(tmp_path):
+    """rollback_on_abort: the aborted pass is fully discarded (restore to
+    the last completed pass) and replaying it reproduces the fault-free
+    run — metrics, dense params and table state (PR 1's replay-equality
+    assertions)."""
+    # ---- fault-free reference: 2 passes ---------------------------------- #
+    ds_ref, table_ref, trainer_ref = _world(tmp_path, liveness=None)
+    ref = None
+    for _ in range(2):
+        ref = _pass(ds_ref, table_ref, trainer_ref)
+    ref_state = table_ref.state_dict()
+    ds_ref.close()
+
+    # ---- guarded run: pass 0 ok, pass 1 stalls and rolls back ------------ #
+    liv = LivenessConfig(
+        deadline_s=1.5, heartbeat_interval_s=0.3, poll_interval_s=0.1,
+        rollback_on_abort=True,
+    )
+    ds, table, trainer = _world(tmp_path, liveness=liv)
+    acp = AutoCheckpointer(str(tmp_path / "acp"), job_id="stall")
+    trainer.checkpointer = acp
+    _pass(ds, table, trainer)
+    acp.after_pass(0, table, trainer)
+
+    faults.install(FaultPlan({"train.step": "hang:at:1"}))
+    try:
+        table.begin_pass(ds.unique_keys())
+        with pytest.raises(PassRolledBack) as ei:
+            trainer.train_from_dataset(ds, table)
+        # the rollback chains from the structured stall error
+        assert isinstance(ei.value.__context__, DistributedStallError)
+        assert ei.value.status["next_pass"] == 1
+        assert stats.get("train.nan_rollback") >= 1
+        # NOTE: no end_pass() — the pass was aborted and discarded
+    finally:
+        faults.clear()
+
+    # ---- replay pass 1 cleanly: must equal the fault-free run ------------ #
+    got = _pass(ds, table, trainer)
+    acp.after_pass(1, table, trainer)
+    ds.close()
+
+    assert got["count"] == ref["count"]
+    np.testing.assert_allclose(got["auc"], ref["auc"], atol=1e-6)
+    np.testing.assert_allclose(got["loss"], ref["loss"], rtol=1e-5)
+    import jax
+
+    for a, b in zip(
+        jax.tree.leaves(trainer_ref.params), jax.tree.leaves(trainer.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
+    got_state = table.state_dict()
+    ia = np.argsort(ref_state["keys"])
+    ib = np.argsort(got_state["keys"])
+    np.testing.assert_array_equal(ref_state["keys"][ia], got_state["keys"][ib])
+    np.testing.assert_allclose(
+        ref_state["values"][ia], got_state["values"][ib], rtol=1e-5, atol=1e-6
+    )
+
+
+def test_stall_without_rollback_config_reraises(tmp_path):
+    """Default liveness (no rollback_on_abort): the stall error propagates
+    even with a checkpointer attached — rollback is an opt-in policy."""
+    ds, table, trainer = _world(tmp_path)
+    trainer.checkpointer = AutoCheckpointer(str(tmp_path / "acp2"), job_id="x")
+    _pass(ds, table, trainer)
+    trainer.checkpointer.after_pass(0, table, trainer)
+    faults.install(FaultPlan({"train.step": "hang:at:0"}))
+    try:
+        table.begin_pass(ds.unique_keys())
+        with pytest.raises(DistributedStallError):
+            trainer.train_from_dataset(ds, table)
+        table.end_pass()
+    finally:
+        faults.clear()
+        ds.close()
+
+
+# --------------------------------------------------------------------------- #
+# the real thing: a frozen worker in a 2-rank fleet
+# --------------------------------------------------------------------------- #
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_frozen_worker_aborts_fleet_with_named_culprit(tmp_path):
+    """Freeze rank 1 (PBOX_FAULT_PLAN hang at hostplane.allgather) in a
+    3-process localhost job driving lockstep KV-channel gathers under
+    KV-heartbeat watchdogs: the whole fleet must terminate within the
+    liveness bound, every rank naming rank 1 as the culprit — the frozen
+    rank via its local check, the waiting peers via heartbeat staleness /
+    the poison key (a victim blocked waiting on the frozen peer must NOT
+    be misnamed)."""
+    here = os.path.dirname(__file__)
+    from paddlebox_tpu.launch import launch
+
+    deadline = 5.0
+    log_dir = str(tmp_path / "logs")
+    t0 = time.monotonic()
+    rc = launch(
+        [
+            os.path.join(here, "_stall_child.py"),
+            "50",                         # n_steps (never reached)
+            "1",                          # stall_rank
+            "hostplane.allgather",        # site
+            "hang:at:3",                  # freeze at the 4th gather
+            str(deadline),
+        ],
+        nproc=3,
+        log_dir=log_dir,
+        liveness_deadline_s=deadline,
+        job_timeout_s=180.0,  # launcher backstop, never the expected path
+    )
+    elapsed = time.monotonic() - t0
+    logs = {
+        f: open(os.path.join(log_dir, f), errors="replace").read()
+        for f in sorted(os.listdir(log_dir))
+    }
+    blob = "\n".join(f"--- {f} ---\n{t[-4000:]}" for f, t in logs.items())
+    # the fleet died (stall abort), it did not complete, and it did not
+    # need the launcher's last-resort timeout
+    assert rc not in (0, 3), f"rc={rc}\n{blob}"
+    assert rc != 124, f"launcher backstop fired (no abort)\n{blob}"
+    assert elapsed < 120, f"took {elapsed:.0f}s\n{blob}"
+    assert "COMPLETED-UNEXPECTEDLY" not in blob, blob
+    # the frozen rank detected itself and named the stage
+    assert "STALL-ABORT rank=1" in logs["rank1.log"], blob
+    assert "process 1" in logs["rank1.log"], blob
+    # every healthy rank converged on the same culprit (peer heartbeat /
+    # poison path) and raised out of its blocked gather — nobody named a
+    # mere victim
+    for r in (0, 2):
+        assert f"STALL-ABORT rank={r}" in logs[f"rank{r}.log"], blob
+        assert "process 1 stalled" in logs[f"rank{r}.log"], blob

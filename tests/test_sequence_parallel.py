@@ -15,6 +15,7 @@ from paddlebox_tpu.parallel.sequence import (
     ring_attention,
     ulysses_attention,
 )
+from paddlebox_tpu.utils.jax_compat import shard_map
 
 P_DEV, B, T_LOCAL, H, D = 4, 2, 8, 4, 8
 T = P_DEV * T_LOCAL
@@ -36,7 +37,7 @@ def _sharded(mesh, fn, causal):
     spec = P(None, SEQ_AXIS)  # shard the T axis
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             functools.partial(fn, causal=causal),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -82,7 +83,7 @@ def test_gradients_match_full_attention(fn, causal):
     spec = P(None, SEQ_AXIS)
 
     def loss_sharded(q_, k_, v_):
-        body = jax.shard_map(
+        body = shard_map(
             functools.partial(fn, causal=causal),
             mesh=mesh,
             in_specs=(spec, spec, spec),

@@ -330,3 +330,74 @@ def test_serve_cli_module(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+# --------------------------------------------------------------------------- #
+# graceful drain (no artifact needed: the drain machinery is exercised
+# against a dummy listener, so these stay runnable on builds where
+# export itself cannot)
+# --------------------------------------------------------------------------- #
+class _DummyHttpd:
+    def __init__(self):
+        self.shut = False
+        self.closed = False
+
+    def shutdown(self):
+        self.shut = True
+
+    def server_close(self):
+        self.closed = True
+
+
+def test_stop_waits_for_inflight_then_closes():
+    import threading
+    import time as _time
+
+    from paddlebox_tpu.inference.server import ScoringServer
+
+    srv = ScoringServer()
+    srv._httpd = _DummyHttpd()
+    httpd = srv._httpd
+    assert srv._begin_request()
+
+    def finish_soon():
+        _time.sleep(0.15)
+        srv._end_request()
+
+    threading.Thread(target=finish_soon, daemon=True).start()
+    t0 = _time.monotonic()
+    srv.stop(drain_timeout_s=5.0)
+    dt = _time.monotonic() - t0
+    assert 0.1 < dt < 2.0  # waited for the request, not the full deadline
+    assert httpd.shut and httpd.closed
+    # idempotent
+    srv.stop()
+
+
+def test_stop_drain_deadline_counts_and_closes():
+    from paddlebox_tpu.inference.server import ScoringServer
+    from paddlebox_tpu.utils.monitor import stats
+
+    srv = ScoringServer()
+    srv._httpd = _DummyHttpd()
+    httpd = srv._httpd
+    assert srv._begin_request()  # never finishes
+    base = stats.get("server.drain_timeout")
+    srv.stop(drain_timeout_s=0.2)
+    assert stats.get("server.drain_timeout") == base + 1
+    assert httpd.shut and httpd.closed
+    srv._end_request()  # late finish after close: no crash
+
+
+def test_draining_rejects_new_requests():
+    from paddlebox_tpu.inference.server import ScoringServer
+
+    srv = ScoringServer()
+    srv._httpd = _DummyHttpd()
+    with srv._inflight_cv:
+        srv._draining = True
+    assert not srv._begin_request()
+    with srv._inflight_cv:
+        srv._draining = False
+    assert srv._begin_request()
+    srv._end_request()

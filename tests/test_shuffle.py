@@ -182,3 +182,125 @@ def test_tcp_exchange():
     finally:
         for s in shufflers:
             s.close()
+
+
+# --------------------------------------------------------------------------- #
+# tcp transport robustness (distributed-liveness tier)
+# --------------------------------------------------------------------------- #
+def test_tcp_close_idempotent():
+    s = TcpShuffler([("127.0.0.1", 0)], 0)
+    s.start()
+    s.close()
+    s.close()  # second close must be a no-op, not an OSError
+    # and close() without start() on a fresh instance is safe too
+    TcpShuffler([("127.0.0.1", 0)], 0).close()
+
+
+def test_tcp_connection_refused_names_peer(monkeypatch):
+    from paddlebox_tpu.data.shuffle import ShufflePeerError
+
+    monkeypatch.setenv("PBOX_RETRY_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("PBOX_RETRY_BASE_DELAY_S", "0.01")
+    monkeypatch.setenv("PBOX_RETRY_MAX_DELAY_S", "0.02")
+    # worker 0 up, worker 1's endpoint is a dead port (bind + close)
+    import socket as _socket
+
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    s = TcpShuffler(
+        [("127.0.0.1", 0), ("127.0.0.1", dead_port)], 0, mode="random",
+        timeout=1.0,
+    )
+    s.start()
+    try:
+        with pytest.raises(ShufflePeerError) as ei:
+            s.exchange(_block(n_ins=30, seed=5))
+        msg = str(ei.value)
+        assert "worker 1" in msg and f"127.0.0.1:{dead_port}" in msg
+        assert ei.value.worker_id == 1
+        assert ei.value.endpoint == ("127.0.0.1", dead_port)
+    finally:
+        s.close()
+
+
+def test_tcp_exchange_timeout_names_missing_workers():
+    # both listeners up, but worker 1 never exchanges: worker 0's wait must
+    # time out naming worker 1 and its endpoint, not hang
+    shufflers = [TcpShuffler([("127.0.0.1", 0)] * 2, i, timeout=0.6)
+                 for i in range(2)]
+    for s in shufflers:
+        s.start()
+    endpoints = [("127.0.0.1", s.bound_port()) for s in shufflers]
+    for s in shufflers:
+        s.endpoints = endpoints
+    try:
+        with pytest.raises(TimeoutError) as ei:
+            shufflers[0].exchange(_block(seed=3))
+        msg = str(ei.value)
+        assert "worker 1" in msg and str(endpoints[1][1]) in msg
+        assert "round 0" in msg
+    finally:
+        for s in shufflers:
+            s.close()
+
+
+def test_tcp_exchange_fault_site():
+    from paddlebox_tpu.utils import faults
+
+    s = TcpShuffler([("127.0.0.1", 0)], 0)
+    s.start()
+    try:
+        with faults.fault_plan({"shuffle.exchange": "first:1"}):
+            with pytest.raises(faults.FaultInjected):
+                s.exchange(_block(seed=1))
+        # next exchange (single worker: no peers) succeeds
+        out = s.exchange(_block(seed=1, n_ins=4))
+        assert out.n_ins == 4
+    finally:
+        s.close()
+
+
+def test_tcp_connect_retry_absorbs_transient_refusal(monkeypatch):
+    """A peer listener that comes up a moment late is absorbed by the
+    shuffle.connect retry loop instead of failing the exchange."""
+    monkeypatch.setenv("PBOX_RETRY_MAX_ATTEMPTS", "5")
+    monkeypatch.setenv("PBOX_RETRY_BASE_DELAY_S", "0.05")
+    monkeypatch.setenv("PBOX_RETRY_MAX_DELAY_S", "0.1")
+    from paddlebox_tpu.utils.monitor import stats
+
+    a = TcpShuffler([("127.0.0.1", 0)] * 2, 0, mode="random", timeout=5.0)
+    a.start()
+    # reserve b's port without listening yet
+    import socket as _socket
+
+    placeholder = _socket.socket()
+    placeholder.bind(("127.0.0.1", 0))
+    b_port = placeholder.getsockname()[1]
+    placeholder.close()
+    endpoints = [("127.0.0.1", a.bound_port()), ("127.0.0.1", b_port)]
+    a.endpoints = endpoints
+    b = TcpShuffler(endpoints, 1, mode="random", timeout=5.0)
+
+    base_retries = stats.get("retry.shuffle.connect.retries")
+
+    def late_start_and_exchange():
+        import time as _t
+
+        _t.sleep(0.3)  # a's first connect attempts hit a dead port
+        b.start()
+        return b.exchange(_block(seed=21, n_ins=16))
+
+    try:
+        res = _run_workers(
+            2,
+            lambda i: a.exchange(_block(seed=20, n_ins=16))
+            if i == 0
+            else late_start_and_exchange(),
+        )
+        assert sum(r.n_ins for r in res) == 32
+        assert stats.get("retry.shuffle.connect.retries") > base_retries
+    finally:
+        a.close()
+        b.close()
