@@ -46,6 +46,38 @@ def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
+def telemetry_summary(max_counters: int = 40) -> dict:
+    """Compact registry snapshot for the emitted BENCH_*.json rows: the
+    non-zero counters plus per-stage latency DISTRIBUTIONS (p50/p99 ms),
+    so the perf trajectory carries tails, not just means.  Bounded size —
+    a bench artifact is a JSON line, not a dump."""
+    from paddlebox_tpu.telemetry import registry
+    from paddlebox_tpu.telemetry.metrics import Histogram
+
+    snap = registry.snapshot()
+    counters = {
+        k: v for k, v in sorted(snap["counters"].items()) if v
+    }
+    if len(counters) > max_counters:
+        counters = dict(list(counters.items())[:max_counters])
+    stages: dict = {}
+    m = registry.get("trainer.stage_seconds")
+    if isinstance(m, Histogram):
+        seen = {
+            dict(key).get("stage") for key in m.series()
+        }
+        for stage in sorted(s for s in seen if s):
+            s = m.summary(stage=stage)
+            if s["count"]:
+                stages[stage] = {
+                    "count": s["count"],
+                    "mean_ms": round((s["mean"] or 0) * 1e3, 3),
+                    "p50_ms": round((s["p50"] or 0) * 1e3, 3),
+                    "p99_ms": round((s["p99"] or 0) * 1e3, 3),
+                }
+    return {"counters": counters, "stage_ms": stages}
+
+
 def emit_unavailable(error: str, metric: str, unit: str) -> None:
     """The backend-failure diagnostic line: value null can never pass as a
     measurement, but the artifact's last JSON line explains itself (and
@@ -1094,7 +1126,8 @@ def stage_headline(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
         emit({"metric": f"{model_name}_samples_per_sec",
               "value": round(ours, 1), "unit": "samples/sec",
               "vs_baseline": vs, "backend": backend, "path": path,
-              **util_fields(cost, ours, bsz)})
+              **util_fields(cost, ours, bsz),
+              "telemetry": telemetry_summary()})
 
 
 def stage_device_profile(backend, args, tconf, trconf, n_slots, dense, bsz,
@@ -1261,7 +1294,8 @@ def run_all(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
         row = {"metric": "ctr_dnn_sustained_northstar_samples_per_sec",
                "value": round(sps, 1), "unit": "samples/sec",
                "vs_baseline": None, "backend": backend,
-               "shape": "26 slots, emb 16, vocab 1e6, 4 passes"}
+               "shape": "26 slots, emb 16, vocab 1e6, 4 passes",
+               "telemetry": telemetry_summary()}
         # partial emit FIRST: the cost-analysis compile below can die to
         # an uncatchable OOM/tunnel drop — never lose the measured number
         emit(row)
@@ -1401,6 +1435,7 @@ def main() -> None:
             "unit": "samples/sec",
             "vs_baseline": None,
             "backend": backend,
+            "telemetry": telemetry_summary(),
         }
         # partial emit FIRST (see run_all's sustained stage)
         emit(row)

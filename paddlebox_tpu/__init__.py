@@ -23,6 +23,7 @@ from paddlebox_tpu.config import (  # noqa: F401
     DataFeedConfig,
     LivenessConfig,
     SparseTableConfig,
+    TelemetryConfig,
     TrainerConfig,
     flags,
 )

@@ -201,6 +201,25 @@ class CheckpointManager:
         opt_state: Any = None,
         meta: Optional[dict] = None,
     ) -> str:
+        from paddlebox_tpu import telemetry
+
+        with telemetry.span(f"ckpt.save.{kind}", tag=tag), \
+             telemetry.histogram(
+                 "ckpt.save_seconds",
+                 help="checkpoint write wall time (s) by kind",
+             ).time(kind=kind):
+            return self._write_timed(kind, tag, sparse_state, params,
+                                     opt_state, meta)
+
+    def _write_timed(
+        self,
+        kind: str,
+        tag: str,
+        sparse_state: dict,
+        params: Any = None,
+        opt_state: Any = None,
+        meta: Optional[dict] = None,
+    ) -> str:
         faults.inject("ckpt.save")
         dirname = os.path.join(self.root, f"{kind}-{tag}")
         tmp = dirname + f".tmp-{os.getpid()}-{self.shard}"
@@ -363,6 +382,16 @@ class CheckpointManager:
         file raises CheckpointCorrupt here, not a cryptic npz error mid-
         restore).  Reference: InitializeGPUAndLoadModel
         (box_wrapper.cc:1329)."""
+        from paddlebox_tpu import telemetry
+
+        with telemetry.span("ckpt.load", upto=upto or ""), \
+             telemetry.histogram(
+                 "ckpt.load_seconds", help="checkpoint restore wall time (s)"
+             ).time():
+            return self._load_timed(table, params_template, opt_template, upto)
+
+    def _load_timed(self, table, params_template=None, opt_template=None,
+                    upto: Optional[str] = None):
         faults.inject("ckpt.load")
         ckpts = self.list_checkpoints()
         if upto is not None:

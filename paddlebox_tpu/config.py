@@ -74,6 +74,15 @@ class _Flags:
         "hostplane_timeout_s": 3600.0,
         # shuffle-transport wait bound (TcpShuffler default timeout)
         "shuffle_timeout_s": 120.0,
+        # telemetry defaults (telemetry/): a non-zero metrics port starts
+        # the per-process Prometheus /metrics listener (launch.py offsets
+        # it per rank); trace_dir enables host span tracing (Chrome-trace
+        # JSON per pass, Perfetto-viewable) on top of the jax device
+        # trace; events_path appends a rank-tagged JSONL metrics/event
+        # record per pass.
+        "metrics_port": 0,
+        "trace_dir": "",
+        "events_path": "",
     }
 
     def __getattr__(self, name: str):
@@ -444,6 +453,51 @@ class LivenessConfig:
 
 
 # --------------------------------------------------------------------------- #
+# Telemetry — the observability policy (telemetry/): where metrics are
+# served, where span traces and JSONL event records land, whether pass
+# boundaries gather a merged cross-rank fleet view.  The reference spreads
+# this across gflags (FLAGS_enable_binding_train_cpu etc.), monitor.h and
+# per-worker profiler switches; here it is one attachable config with env
+# flags (PBOX_METRICS_PORT / PBOX_TRACE_DIR / PBOX_EVENTS_PATH) so the
+# launcher can switch a whole fleet on without code changes.
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Observability knobs for one process.
+
+    metrics_port: serve Prometheus text exposition on
+    ``127.0.0.1:<port>/metrics`` (0 = off).  Multi-process launches offset
+    the port per rank (launch.py ``--metrics-port``).
+    trace_dir: write per-pass host span traces (Chrome trace JSON) here
+    ("" = off).  The trainers also point the jax device trace at their own
+    ``TrainerConfig.trace_dir``; the two are separate captures.
+    events_path: append rank-tagged JSONL event/metrics records here
+    ("" = off).
+    fleet_snapshot: multi-process only — gather every rank's metric
+    snapshot at pass boundaries and log ONE merged fleet view on rank 0.
+    """
+
+    metrics_port: int = 0
+    trace_dir: str = ""
+    events_path: str = ""
+    fleet_snapshot: bool = True
+
+    @staticmethod
+    def from_flags() -> "TelemetryConfig":
+        return TelemetryConfig(
+            metrics_port=flags.metrics_port,
+            trace_dir=flags.trace_dir,
+            events_path=flags.events_path,
+        )
+
+    def __post_init__(self):
+        if self.metrics_port < 0 or self.metrics_port > 65535:
+            raise ValueError(
+                f"metrics_port must be in [0, 65535], got {self.metrics_port}"
+            )
+
+
+# --------------------------------------------------------------------------- #
 # Trainer config — replaces trainer_desc.proto (reference:
 # trainer_desc.proto:21-66,100-108 BoxPSWorkerParameter).
 # --------------------------------------------------------------------------- #
@@ -529,8 +583,14 @@ class TrainerConfig:
     # LivenessConfig to get per-process heartbeats, local+peer stall
     # detection naming the culprit, and poison-key coordinated abort.
     liveness: Optional["LivenessConfig"] = None
+    # telemetry policy (telemetry/): None = flags only (PBOX_METRICS_PORT /
+    # PBOX_TRACE_DIR / PBOX_EVENTS_PATH still apply through
+    # TelemetryConfig.from_flags()); attach one to pin it in code.
+    telemetry: Optional["TelemetryConfig"] = None
     # per-stage host timing (reference: TrainFilesWithProfiler — a slower
     # diagnostic mode: the device step is synchronized every batch)
     profile: bool = False
-    # jax.profiler trace dir for one-pass device timeline capture ("" = off)
+    # jax.profiler trace dir for one-pass device timeline capture ("" = off).
+    # Also enables the HOST span trace: each pass additionally writes a
+    # Chrome-trace JSON of nested plan/feed/step/dump spans here.
     trace_dir: str = ""

@@ -327,6 +327,17 @@ class TcpShuffler:
             raise ShufflePeerError(peer, self.endpoints[peer], e) from e
 
     def exchange(self, block: RecordBlock) -> RecordBlock:
+        from paddlebox_tpu import telemetry
+
+        with telemetry.span("shuffle.exchange", round=self._round,
+                            worker=self.worker_id), \
+             telemetry.histogram(
+                 "shuffle.exchange_seconds",
+                 help="TcpShuffler exchange wall time (s)",
+             ).time(worker=str(self.worker_id)):
+            return self._exchange(block)
+
+    def _exchange(self, block: RecordBlock) -> RecordBlock:
         wd_mod = _watchdog_mod()
         if wd_mod is not None:
             wd_mod.beat("shuffle")

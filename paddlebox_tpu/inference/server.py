@@ -13,6 +13,9 @@ Endpoints:
   POST /score/<name>        — scores a named model
   GET  /healthz             — liveness + per-model metadata
   GET  /models              — registered model names + meta
+  GET  /metrics             — Prometheus text exposition (request counts
+                              by status class, request-latency histograms
+                              by model, every process metric)
 
 A serving host needs JAX (any StableHLO runtime) but none of this
 framework's training machinery beyond the feed parser; clients need only
@@ -28,9 +31,27 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from paddlebox_tpu import telemetry
 from paddlebox_tpu.config import DataFeedConfig
 from paddlebox_tpu.inference.predictor import Predictor
 from paddlebox_tpu.utils.monitor import stats
+
+# per-request serving telemetry: counts split by HTTP status class and
+# latency histograms split by (model, status class) — recorded on EVERY
+# path including errors, so a 5xx storm is visible as a latency series,
+# not just a count (the per-shape-bucket p50/p99 bench.py measures
+# offline, live).
+_REQUESTS = telemetry.counter(
+    "server.requests", help="scoring requests by model + status class"
+)
+_REQUEST_SECONDS = telemetry.histogram(
+    "server.request_seconds",
+    help="scoring request latency (s) by model + status class",
+)
+
+
+def _status_class(code: int) -> str:
+    return f"{code // 100}xx"
 
 
 class ModelEntry:
@@ -146,7 +167,9 @@ class ScoringServer:
             batch = builder.build(block, ids)
             return [float(s) for s in entry.predictor.predict(batch)]
 
-        with self._lock:  # scoring only: /healthz never waits on this
+        with self._lock, telemetry.span(
+            "server.score", model=entry.name, n_ins=block.n_ins
+        ):  # scoring only: /healthz never waits on this
             for lo in range(0, block.n_ins, B):
                 ids = np.arange(lo, min(lo + B, block.n_ins))
                 scores.extend(score_ids(ids))
@@ -160,8 +183,11 @@ class ScoringServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            _status = 0  # last code sent (per-request telemetry label)
+
             def _send(self, code: int, payload: dict) -> None:
                 body = json.dumps(payload).encode()
+                self._status = code
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -169,7 +195,20 @@ class ScoringServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
+                if self.path == "/metrics":
+                    # Prometheus text exposition of the process registry
+                    # (request histograms, drain counters, and every
+                    # legacy stats.* counter) — the scrape surface a
+                    # deployed scorer is monitored through
+                    body = telemetry.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", telemetry.PROMETHEUS_CONTENT_TYPE
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/healthz":
                     # liveness + readiness: 200 only when at least one
                     # model is registered and scorable — a rolling deploy
                     # probes this before routing traffic
@@ -193,27 +232,35 @@ class ScoringServer:
                     self._send(404, {"error": "not found"})
 
             def do_POST(self):
-                # strict routing: exactly /score or /score/<name>
+                # strict routing: exactly /score or /score/<name>.  Every
+                # outcome — routing 404, drain 503, parse 400, scoring 200,
+                # internal 500 — lands in the request counter/latency
+                # histogram split by status class
+                t0 = time.perf_counter()
                 if self.path == "/score":
                     name = None
                 elif self.path.startswith("/score/"):
                     name = self.path[len("/score/"):]
                     if not name or "/" in name or "?" in name:
                         self._send(404, {"error": "not found"})
+                        server._record_request(name, self._status, t0)
                         return
                 else:
                     self._send(404, {"error": "not found"})
+                    server._record_request(None, self._status, t0)
                     return
                 if not server._begin_request():
                     # draining: a rolling deploy already unrouted us, but a
                     # straggler connection may still arrive — refuse loudly
                     # instead of racing the close
                     self._send(503, {"error": "server draining"})
+                    server._record_request(name, self._status, t0)
                     return
                 try:
                     self._do_score(name)
                 finally:
                     server._end_request()
+                    server._record_request(name, self._status, t0)
 
             def _do_score(self, name):
                 try:
@@ -261,6 +308,18 @@ class ScoringServer:
         t = self._thread
         if t is not None:
             t.join()
+
+    # -- request telemetry -------------------------------------------------- #
+    def _record_request(self, model: Optional[str], code: int,
+                        t0: float) -> None:
+        """Count + time one request.  The model label is the requested
+        name (resolved to the default for bare /score) so per-model p99s
+        split cleanly; unroutable requests label as "-"."""
+        label = model or self._default or "-"
+        cls = _status_class(code or 500)
+        dt = time.perf_counter() - t0
+        _REQUESTS.inc(model=label, status=cls)
+        _REQUEST_SECONDS.observe(dt, model=label, status=cls)
 
     # -- drain bookkeeping -------------------------------------------------- #
     def _begin_request(self) -> bool:

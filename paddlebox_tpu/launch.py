@@ -48,6 +48,8 @@ def rank_env(
     devices_per_proc: Optional[int] = None,
     base_env: Optional[dict] = None,
     liveness_deadline_s: Optional[float] = None,
+    metrics_port: Optional[int] = None,
+    trace_dir: Optional[str] = None,
 ) -> dict:
     """Child environment for one rank (exported for tests/embedders)."""
     env = dict(base_env if base_env is not None else os.environ)
@@ -58,6 +60,14 @@ def rank_env(
         # every rank's watchdog (parallel/watchdog.py) reads this flag:
         # one launcher knob bounds every stage stall in the fleet
         env["PBOX_LIVENESS_DEADLINE_S"] = str(liveness_deadline_s)
+    if metrics_port is not None and metrics_port > 0:
+        # one Prometheus /metrics listener per rank: base port + rank
+        # (rank N scrapes at :base+N), consumed by telemetry.ensure_exporter
+        env["PBOX_METRICS_PORT"] = str(metrics_port + rank)
+    if trace_dir is not None and trace_dir:
+        # per-pass host span traces (Chrome trace JSON, Perfetto-viewable);
+        # file names carry the rank, so one shared dir works for the fleet
+        env["PBOX_TRACE_DIR"] = trace_dir
     if devices_per_proc:
         import re
 
@@ -86,6 +96,8 @@ def launch(
     poll_interval: float = 0.2,
     liveness_deadline_s: Optional[float] = None,
     job_timeout_s: Optional[float] = None,
+    metrics_port: Optional[int] = None,
+    trace_dir: Optional[str] = None,
 ) -> int:
     """Spawn nproc ranks of ``python script_args...``; return the first
     non-zero exit code (0 if all ranks succeed).  Any rank dying kills the
@@ -106,6 +118,7 @@ def launch(
         env = rank_env(
             rank, nproc, coordinator, devices_per_proc,
             liveness_deadline_s=liveness_deadline_s,
+            metrics_port=metrics_port, trace_dir=trace_dir,
         )
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
@@ -182,6 +195,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("--job-timeout", type=float, default=None,
                     help="kill the whole fleet after this many seconds "
                          "(last-resort bound; exit code 124)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics on this base port, "
+                         "offset per rank (rank N at base+N; "
+                         "PBOX_METRICS_PORT)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="write per-pass host span traces (Chrome trace "
+                         "JSON, Perfetto-viewable) here (PBOX_TRACE_DIR)")
     ap.add_argument("script", help="training script to run")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -193,6 +213,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         log_dir=args.log_dir,
         liveness_deadline_s=args.liveness_deadline,
         job_timeout_s=args.job_timeout,
+        metrics_port=args.metrics_port,
+        trace_dir=args.trace_dir,
     )
 
 

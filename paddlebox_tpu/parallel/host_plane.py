@@ -27,12 +27,27 @@ reading every key at s, so a two-deep window is always safe.
 from __future__ import annotations
 
 import base64
+import re
 import time
 from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddlebox_tpu import telemetry
 from paddlebox_tpu.utils import faults
+
+# gather latency distribution, labeled by the channel's BASE name (the
+# per-pass "-<n>" suffix stripped, so series cardinality stays bounded
+# over a day-scale job) — the number that shows which planning stream's
+# tail gates the feed producer
+_GATHER_SECONDS = telemetry.histogram(
+    "hostplane.gather_seconds",
+    help="host-plane allgather wall time (s) by channel",
+)
+
+
+def _channel_base(name: str) -> str:
+    return re.sub(r"-\d+$", "", name)
 
 
 class HostPlaneTimeout(TimeoutError):
@@ -146,6 +161,7 @@ class KvChannel:
 
         faults.inject("hostplane.allgather")  # chaos site: raise or hang
         _wd.beat(f"hostplane:{self.name}")
+        t_start = time.perf_counter()
         x = np.ascontiguousarray(x)
         client = _client()
         s = self._seq
@@ -215,6 +231,13 @@ class KvChannel:
         # windowed GC of our own past key (see module docstring)
         if s >= 2:
             self._delete(s - 2)
+        dt = time.perf_counter() - t_start
+        _GATHER_SECONDS.observe(dt, channel=_channel_base(self.name))
+        tr = telemetry.get_tracer()
+        if tr is not None:
+            end = tr.now_us()
+            tr.add_span("hostplane.allgather", end - dt * 1e6, dt * 1e6,
+                        channel=self.name, seq=s)
         return np.stack(parts)
 
     def _delete(self, seq: int) -> None:

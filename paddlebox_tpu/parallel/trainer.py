@@ -77,6 +77,11 @@ from paddlebox_tpu.utils.jax_compat import shard_map
 # even across multiple MultiChipTrainer instances
 _PLAN_CHANNEL_SEQ = [0]
 
+# pass-boundary fleet-snapshot sequence (same lockstep argument): every
+# process gathers its metric snapshot under this seq so rank 0 can log ONE
+# merged fleet view per pass
+_FLEET_SNAP_SEQ = [0]
+
 
 def _stack_group(
     batches: Sequence[HostBatch],
@@ -572,6 +577,19 @@ class MultiChipTrainer:
             self.async_dense = AsyncDenseTable(
                 p0, optimizer=self.conf.dense_optimizer, lr=self.conf.dense_lr,
             )
+        # telemetry: exporter/event log are process singletons (first pass
+        # starts them); host stage timing always feeds the per-stage
+        # latency histograms (plan/feed here run on the producer thread —
+        # the device step is async and is NOT wall-timed per batch)
+        from paddlebox_tpu import telemetry
+        from paddlebox_tpu.config import TelemetryConfig
+        from paddlebox_tpu.utils.profiler import StatsProfiler
+
+        tele = self.conf.telemetry or TelemetryConfig.from_flags()
+        telemetry.ensure_exporter(tele.metrics_port or None)
+        event_log = telemetry.ensure_event_log(tele.events_path or None)
+        sprof = StatsProfiler()
+
         pending_grads: list = []  # device grads fetched one step behind
         pull_every = max(self.conf.sync_weight_step, 1)
         mstate = self._init_mstate(auc_state)
@@ -680,11 +698,15 @@ class MultiChipTrainer:
                         "DataFeedConfig.task_label_slots with "
                         f"{self.n_tasks - 1} slots (task 0 is the primary label)"
                     )
-                plan = table.plan_group(
-                    group, gather=plan_gather,
-                    slot_lr_vec=self._slot_lr_vec, n_slots=n_slots,
-                )
-                feed = _stack_group(group, plan, n_slots, self.metric_group)
+                with sprof.stage("plan"):
+                    plan = table.plan_group(
+                        group, gather=plan_gather,
+                        slot_lr_vec=self._slot_lr_vec, n_slots=n_slots,
+                    )
+                with sprof.stage("feed"):
+                    feed = _stack_group(
+                        group, plan, n_slots, self.metric_group
+                    )
                 yield (
                     global_from_local(self._sharding, feed),
                     group if dumper is not None else None,
@@ -836,6 +858,36 @@ class MultiChipTrainer:
         metrics["capacity_bumps"] = table.capacity_bumps
         self.last_auc_state = mstate["auc"]
         self.last_metric_state = mstate
+        # pass-boundary fleet view: allgather every rank's metric snapshot
+        # over the coordination-service KV and log ONE merged view on rank
+        # 0 (per-rank stage p99s, counters) — the PrintSyncTimer analog.
+        # Telemetry must never kill a healthy pass: failures log and move
+        # on.  Every rank participates (lockstep, like the collectives).
+        if multiproc and tele.fleet_snapshot:
+            _FLEET_SNAP_SEQ[0] += 1
+            try:
+                from paddlebox_tpu.parallel.watchdog import CoordKv
+
+                merged = telemetry.gather_fleet_snapshot(
+                    CoordKv(), rank=jax.process_index(),
+                    world=jax.process_count(), seq=_FLEET_SNAP_SEQ[0],
+                    namespace="pass", timeout_s=60.0,
+                )
+                if jax.process_index() == 0:
+                    # print, not logger: the per-pass fleet line is the
+                    # PrintSyncTimer/log_for_profile analog and must land
+                    # in the rank-0 log without logging configuration
+                    print(telemetry.format_fleet_view(
+                        merged, prefix=f"fleet pass step={self.global_step}",
+                    ), flush=True)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "fleet snapshot gather failed", exc_info=True
+                )
+        if event_log is not None:
+            event_log.log_pass(metrics, global_step=self.global_step)
         if plan_channel is not None:
             # every peer has joined the metric collectives above, which it
             # can only do after its producer read ALL of this channel's

@@ -41,11 +41,28 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from paddlebox_tpu import telemetry
 from paddlebox_tpu.config import LivenessConfig
 from paddlebox_tpu.utils import faults
 from paddlebox_tpu.utils.monitor import stats
 
 logger = logging.getLogger(__name__)
+
+# liveness gauges: the watchdog's view of every rank, refreshed each tick.
+# A slow-but-not-stalled straggler shows up HERE (staleness climbing,
+# progress rate flat) passes before the deadline would ever fire — scrape
+# /metrics or read the fleet snapshot instead of waiting for the abort.
+_STALENESS = telemetry.gauge(
+    "watchdog.staleness_s",
+    help="seconds since each rank's progress counter last changed",
+)
+_PROGRESS = telemetry.gauge(
+    "watchdog.progress", help="each rank's monotonic stage-progress counter"
+)
+_STAGE = telemetry.gauge(
+    "watchdog.stage",
+    help="1 for each rank's current stage (label churn pruned per tick)",
+)
 
 
 class DistributedStallError(RuntimeError):
@@ -297,6 +314,9 @@ class Watchdog:
         # the local process starts tracked from construction time: a run
         # that never reports ANY stage is itself a stall (stage "start")
         self._tracker.observe(self.rank, 0, "start", self._clock())
+        # rank -> last exported stage label (so the stage gauge's old
+        # series is removed when a rank's stage rotates)
+        self._exported_stage: Dict[int, str] = {}
 
     # -- keys --------------------------------------------------------------- #
     def _hb_key(self, rank: int) -> str:
@@ -460,6 +480,22 @@ class Watchdog:
             return True
         return False
 
+    def _export_gauges(self, now: float) -> None:
+        """Refresh the liveness gauges from the tracker: per-rank
+        staleness + progress, and a 1-valued stage gauge whose stale
+        series are pruned as stages rotate."""
+        for rank in sorted(self._tracker._seen):
+            age = self._tracker.age(rank, now)
+            progress, stage = self._tracker.last(rank)
+            if age is not None:
+                _STALENESS.set(age, rank=str(rank))
+            _PROGRESS.set(progress, rank=str(rank))
+            prev = self._exported_stage.get(rank)
+            if prev is not None and prev != stage:
+                _STAGE.remove(rank=str(rank), stage=prev)
+            self._exported_stage[rank] = stage
+            _STAGE.set(1, rank=str(rank), stage=stage)
+
     def tick(self, now: Optional[float] = None) -> bool:
         """One detector round (heartbeat + poison + local + peers).
         Returns True when this tick aborted the run.  The monitor thread
@@ -469,11 +505,13 @@ class Watchdog:
             return True
         now = self._clock() if now is None else now
         self._publish_heartbeat(now)
-        return (
+        out = (
             self._check_poison(now)
             or self._check_local(now)
             or self._check_peers(now)
         )
+        self._export_gauges(now)
+        return out
 
     # -- lifecycle ---------------------------------------------------------- #
     def _run(self) -> None:

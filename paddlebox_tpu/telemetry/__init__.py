@@ -1,0 +1,59 @@
+"""Telemetry: typed metrics, Prometheus/JSONL export, span tracing,
+cross-rank aggregation.
+
+The observability layer the ROADMAP's "production-scale, heavy traffic"
+north star requires (the reference's monitor.h StatRegistry +
+PrintSyncTimer + log_for_profile + CUPTI timeline, rebuilt TPU-native):
+
+  * :mod:`metrics` — Counter / Gauge / Histogram with labels, p50/p95/p99
+    estimation, delta snapshots, one process-global :data:`registry`
+    (``utils/monitor.stats`` forwards here unchanged);
+  * :mod:`export` — Prometheus text exposition (``render_prometheus``),
+    the standalone :class:`MetricsExporter` ``/metrics`` listener;
+  * :mod:`events` — rank-tagged JSONL event/metrics log;
+  * :mod:`trace` — ``span("name")`` -> Chrome-trace JSON (Perfetto);
+  * :mod:`fleet` — pass-boundary cross-rank snapshot gather + merge.
+"""
+
+from paddlebox_tpu.telemetry.metrics import (  # noqa: F401
+    Counter,
+    DEFAULT_LATENCY_BUCKETS,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    Snapshot,
+    counter,
+    gauge,
+    histogram,
+    quantile_from_buckets,
+    registry,
+)
+from paddlebox_tpu.telemetry.export import (  # noqa: F401
+    MetricsExporter,
+    PROMETHEUS_CONTENT_TYPE,
+    ensure_exporter,
+    render_prometheus,
+    stop_exporter,
+)
+from paddlebox_tpu.telemetry.events import (  # noqa: F401
+    EventLog,
+    close_event_log,
+    emit_event,
+    ensure_event_log,
+)
+from paddlebox_tpu.telemetry.trace import (  # noqa: F401
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    flush_trace,
+    get_tracer,
+    instant,
+    span,
+)
+from paddlebox_tpu.telemetry.fleet import (  # noqa: F401
+    FleetGatherTimeout,
+    format_fleet_view,
+    gather_fleet_snapshot,
+    log_fleet_view,
+    merge_snapshots,
+)

@@ -1,0 +1,118 @@
+"""Rank-tagged JSONL event/metrics log.
+
+A headless run (bench, a cron-driven day loop, a pod rank with its stdout
+tee'd away) must leave an ANALYZABLE artifact, not just log lines: one
+JSON object per line, each tagged with wall time and rank, so a pass's
+counters/latency distributions can be joined across ranks and plotted
+after the fact (the reference's ``log_for_profile`` lines, made
+machine-readable).  Schema:
+
+    {"t": <unix seconds>, "rank": <int>, "event": "<name>", ...fields}
+
+The per-pass record the trainers emit is ``event="pass_end"`` carrying the
+pass metrics plus the registry's DELTA snapshot (this pass's counts, not
+job-cumulative ones).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from paddlebox_tpu.telemetry.metrics import registry
+
+
+def _default_rank() -> int:
+    """The launcher's rank env (PBOX_PROCESS_ID) without importing jax —
+    events must work in processes that never initialize a backend."""
+    try:
+        return int(os.environ.get("PBOX_PROCESS_ID", "0"))
+    except ValueError:
+        return 0
+
+
+class EventLog:
+    """Append-only JSONL writer; every ``log`` line is flushed (a killed
+    rank's artifact stays readable up to its last event)."""
+
+    def __init__(self, path: str, rank: Optional[int] = None):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self.path = path
+        self.rank = _default_rank() if rank is None else int(rank)
+        self._lock = threading.Lock()
+        self._f = open(path, "a")
+
+    def log(self, event: str, **fields) -> None:
+        rec = {"t": time.time(), "rank": self.rank, "event": event, **fields}
+        line = json.dumps(rec, default=_json_default)
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def log_pass(self, pass_metrics: dict, **fields) -> None:
+        """The per-pass record: pass metrics + this pass's metric deltas."""
+        self.log(
+            "pass_end",
+            metrics=pass_metrics,
+            telemetry=registry.delta_snapshot(),
+            **fields,
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+def _json_default(o):
+    """Numpy scalars and other non-JSON leaves degrade to floats/strings
+    instead of killing the event write."""
+    try:
+        return float(o)
+    except (TypeError, ValueError):
+        return repr(o)
+
+
+# --------------------------------------------------------------------------- #
+# per-process singleton (PBOX_EVENTS_PATH / TelemetryConfig.events_path)
+# --------------------------------------------------------------------------- #
+_lock = threading.Lock()
+_event_log: Optional[EventLog] = None
+
+
+def ensure_event_log(path: Optional[str] = None) -> Optional[EventLog]:
+    """Open the process's event log once (None = read the flag; "" = off)."""
+    global _event_log
+    with _lock:
+        if _event_log is not None:
+            return _event_log
+        if path is None:
+            from paddlebox_tpu.config import flags
+
+            path = flags.events_path
+        if not path:
+            return None
+        _event_log = EventLog(path)
+        return _event_log
+
+
+def close_event_log() -> None:
+    global _event_log
+    with _lock:
+        if _event_log is not None:
+            _event_log.close()
+            _event_log = None
+
+
+def emit_event(event: str, **fields) -> None:
+    """Log to the process event log if one is open (no-op otherwise)."""
+    el = _event_log
+    if el is not None:
+        el.log(event, **fields)

@@ -1,0 +1,207 @@
+"""Prometheus text exposition + the standalone ``/metrics`` listener.
+
+``render_prometheus`` turns the typed registry into the text exposition
+format (version 0.0.4) a Prometheus/VictoriaMetrics scraper ingests:
+counters as ``<name>_total``, histograms as cumulative ``_bucket{le=}``
+series plus ``_sum``/``_count``.  Metric names are sanitized
+(``train.nan_rollback`` -> ``train_nan_rollback``) and label values
+escaped per the spec.
+
+``MetricsExporter`` is the trainer-process listener: ``ScoringServer``
+already has an HTTP surface and grows ``GET /metrics`` in place, but a
+headless trainer has none — this serves exactly ``/metrics`` (plus
+``/healthz``) on a daemon thread.  ``ensure_exporter()`` starts one per
+process from ``TelemetryConfig`` / ``PBOX_METRICS_PORT`` and is the hook
+both train loops call at pass start.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from paddlebox_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    registry as _global_registry,
+)
+
+logger = logging.getLogger(__name__)
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _name(name: str) -> str:
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def _labels_str(items, extra: str = "") -> str:
+    parts = [
+        f'{_LABEL_RE.sub("_", k)}="{_escape(v)}"' for k, v in items
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_prometheus(reg: Optional[MetricRegistry] = None) -> str:
+    """The registry as Prometheus text exposition (one trailing newline)."""
+    reg = reg or _global_registry
+    lines: list = []
+    for m in reg.metrics():
+        pname = _name(m.name)
+        if isinstance(m, Counter):
+            pname += "_total"
+        if m.help:
+            lines.append(f"# HELP {pname} {_escape(m.help)}")
+        lines.append(f"# TYPE {pname} "
+                     f"{'untyped' if m.kind == 'untyped' else m.kind}")
+        series = m.series()
+        if isinstance(m, (Counter, Gauge)):
+            for key, cell in sorted(series.items()):
+                lines.append(
+                    f"{pname}{_labels_str(key)} {_fmt_value(cell[0])}"
+                )
+        elif isinstance(m, Histogram):
+            for key, s in sorted(series.items()):
+                cum = 0
+                for i, edge in enumerate(m.boundaries):
+                    cum += s.counts[i]
+                    le = _labels_str(key, f'le="{_fmt_value(edge)}"')
+                    lines.append(f"{pname}_bucket{le} {cum}")
+                cum += s.counts[len(m.boundaries)]
+                le = _labels_str(key, 'le="+Inf"')
+                lines.append(f"{pname}_bucket{le} {cum}")
+                lines.append(f"{pname}_sum{_labels_str(key)} "
+                             f"{_fmt_value(s.sum)}")
+                lines.append(f"{pname}_count{_labels_str(key)} {s.count}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsExporter:
+    """Minimal threaded HTTP listener serving ``GET /metrics``.
+
+    For processes with no HTTP surface of their own (trainers, the
+    launcher's ranks).  ``start(port)`` returns the bound port (0 picks a
+    free one); ``stop()`` tears the listener down.
+    """
+
+    def __init__(self, reg: Optional[MetricRegistry] = None):
+        self._registry = reg or _global_registry
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _handler(self):
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = render_prometheus(exporter._registry).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                elif self.path == "/healthz":
+                    body = b'{"ok": true}'
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes are periodic: stay quiet
+                pass
+
+        return Handler
+
+    def start(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        if self._httpd is not None:
+            raise RuntimeError("exporter already started")
+        self._httpd = ThreadingHTTPServer((host, port), self._handler())
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def port(self) -> Optional[int]:
+        return None if self._httpd is None else self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# --------------------------------------------------------------------------- #
+# per-process singleton (PBOX_METRICS_PORT / TelemetryConfig.metrics_port)
+# --------------------------------------------------------------------------- #
+_exporter_lock = threading.Lock()
+_exporter: Optional[MetricsExporter] = None
+
+
+def ensure_exporter(port: Optional[int] = None) -> Optional[MetricsExporter]:
+    """Start the process's exporter once (None = read the flag).  A port of
+    0/None-with-no-flag means "no exporter" and returns None; a bind
+    failure logs and returns None rather than killing a training pass."""
+    global _exporter
+    with _exporter_lock:
+        if _exporter is not None:
+            return _exporter
+        if port is None:
+            from paddlebox_tpu.config import flags
+
+            port = flags.metrics_port
+        if not port or port <= 0:
+            return None
+        exp = MetricsExporter()
+        try:
+            bound = exp.start(port=port)
+        except OSError as e:
+            logger.warning("metrics exporter: bind to %d failed: %r", port, e)
+            return None
+        logger.info("metrics exporter listening on :%d/metrics", bound)
+        _exporter = exp
+        return _exporter
+
+
+def stop_exporter() -> None:
+    global _exporter
+    with _exporter_lock:
+        if _exporter is not None:
+            _exporter.stop()
+            _exporter = None

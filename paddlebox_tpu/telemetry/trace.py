@@ -1,0 +1,177 @@
+"""Span tracing: ``span("name")`` -> Chrome-trace-format JSON.
+
+The host-side counterpart of the jax.profiler device timeline
+(utils/profiler.device_trace): where the XLA trace shows per-fusion device
+time, these spans show where a PASS spent its host wall clock — plan vs
+feed assembly vs device step vs dump, host-plane gathers, shuffle
+exchanges, checkpoint saves — with parent/child nesting.  The output is
+the Chrome trace event format ("traceEvents" with complete "X" events),
+which Perfetto / chrome://tracing open directly; the reference's CUPTI
+timeline (platform/device_tracer.cc) served the same role for its CUDA
+stack.
+
+Tracing is off by default and a disabled ``span()`` costs one global read,
+so call-sites stay unconditionally instrumented.  Nesting is tracked with
+a per-thread span stack: children carry their parent's name in ``args``
+and Perfetto nests same-tid "X" events by time containment.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+
+class Tracer:
+    """Collects span events; ``write(path)`` emits one Chrome-trace JSON."""
+
+    def __init__(self, process_name: str = "pbox", pid: int = 0):
+        self._lock = threading.Lock()
+        self._events: list = []
+        self._t0 = time.perf_counter()
+        self._tls = threading.local()
+        self.pid = int(pid)  # rank, so multi-rank traces merge cleanly
+        self.process_name = process_name
+
+    # -- recording ---------------------------------------------------------- #
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, **meta) -> Iterator[None]:
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        stack.append(name)
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            dur = self._now_us() - start
+            stack.pop()
+            args = {k: v for k, v in meta.items()}
+            if parent is not None:
+                args["parent"] = parent
+            ev = {
+                "name": name,
+                "ph": "X",
+                "ts": start,
+                "dur": dur,
+                "pid": self.pid,
+                "tid": threading.get_ident() % 2**31,
+            }
+            if args:
+                ev["args"] = args
+            with self._lock:
+                self._events.append(ev)
+
+    def now_us(self) -> float:
+        """The tracer clock (µs since tracer start) — pair with
+        :meth:`add_span` for retroactive spans."""
+        return self._now_us()
+
+    def add_span(self, name: str, start_us: float, dur_us: float,
+                 **meta) -> None:
+        """Record a span measured externally (e.g. around a blocking wait
+        instrumented with its own timer)."""
+        ev = {
+            "name": name, "ph": "X", "ts": start_us, "dur": dur_us,
+            "pid": self.pid, "tid": threading.get_ident() % 2**31,
+        }
+        if meta:
+            ev["args"] = dict(meta)
+        with self._lock:
+            self._events.append(ev)
+
+    def instant(self, name: str, **meta) -> None:
+        """A zero-duration marker (pass boundaries, aborts)."""
+        ev = {
+            "name": name, "ph": "i", "s": "t",
+            "ts": self._now_us(), "pid": self.pid,
+            "tid": threading.get_ident() % 2**31,
+        }
+        if meta:
+            ev["args"] = dict(meta)
+        with self._lock:
+            self._events.append(ev)
+
+    # -- output ------------------------------------------------------------- #
+    def drain(self) -> list:
+        with self._lock:
+            evs, self._events = self._events, []
+            return evs
+
+    def to_dict(self, events: Optional[list] = None) -> dict:
+        evs = self.drain() if events is None else events
+        meta = [{
+            "name": "process_name", "ph": "M", "pid": self.pid, "tid": 0,
+            "args": {"name": f"{self.process_name}-r{self.pid}"},
+        }]
+        return {"traceEvents": meta + evs, "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> str:
+        """Flush collected spans to ``path`` (Perfetto-loadable) and clear
+        the buffer; returns the path."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f)
+        return path
+
+
+# --------------------------------------------------------------------------- #
+# process-global tracer (None = tracing off; span() is then a no-op)
+# --------------------------------------------------------------------------- #
+_lock = threading.Lock()
+_tracer: Optional[Tracer] = None
+
+
+def enable_tracing(pid: int = 0, process_name: str = "pbox") -> Tracer:
+    """Install (or return) the process tracer; idempotent."""
+    global _tracer
+    with _lock:
+        if _tracer is None:
+            _tracer = Tracer(process_name=process_name, pid=pid)
+        return _tracer
+
+
+def disable_tracing() -> None:
+    global _tracer
+    with _lock:
+        _tracer = None
+
+
+def get_tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def span(name: str, **meta):
+    """Record a span on the active tracer (no-op context when disabled)."""
+    t = _tracer
+    if t is None:
+        return contextlib.nullcontext()
+    return t.span(name, **meta)
+
+
+def instant(name: str, **meta) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, **meta)
+
+
+def flush_trace(path: str) -> Optional[str]:
+    """Write and clear the active tracer's spans (None when disabled)."""
+    t = _tracer
+    if t is None:
+        return None
+    return t.write(path)

@@ -332,6 +332,7 @@ class Trainer:
         self._scan_fn = None
         self._eval_fn = None
         self.global_step = 0
+        self._pass_idx = 0
         self.last_metric_state = None
 
     def close(self) -> None:
@@ -637,12 +638,38 @@ class Trainer:
                 self.conf.dump_fields,
             )
         from paddlebox_tpu.utils.profiler import (
-            NullProfiler,
+            StatsProfiler,
             StepProfiler,
             device_trace,
         )
+        from paddlebox_tpu import telemetry
 
-        prof = StepProfiler() if self.conf.profile else NullProfiler()
+        # telemetry policy: explicit config wins, env flags otherwise
+        # (PBOX_METRICS_PORT / PBOX_TRACE_DIR / PBOX_EVENTS_PATH — the
+        # launcher's per-rank knobs).  The exporter/event log are
+        # per-process singletons: first pass starts them, later passes
+        # are no-ops.
+        from paddlebox_tpu.config import TelemetryConfig
+
+        tele = self.conf.telemetry or TelemetryConfig.from_flags()
+        telemetry.ensure_exporter(tele.metrics_port or None)
+        event_log = telemetry.ensure_event_log(tele.events_path or None)
+        # host span tracing: TrainerConfig.trace_dir (which also drives the
+        # jax device trace) or the telemetry trace dir alone
+        host_trace_dir = self.conf.trace_dir or tele.trace_dir
+        if host_trace_dir:
+            from paddlebox_tpu.telemetry.events import _default_rank
+
+            telemetry.enable_tracing(pid=_default_rank())
+
+        # full profiler under profile/tracing (serial feed, synced steps:
+        # honest splits + spans); otherwise histogram-only stage timing so
+        # every run still carries per-stage p50/p99 in its metrics
+        prof = (
+            StepProfiler()
+            if (self.conf.profile or host_trace_dir)
+            else StatsProfiler()
+        )
 
         # distributed-liveness watchdog: stage-reported progress (feed /
         # step) with a stall deadline; single-process runs get local stall
@@ -743,7 +770,7 @@ class Trainer:
         if (
             self.conf.prefetch_batches > 0
             and not prof.enabled
-            and not self.conf.trace_dir
+            and not host_trace_dir
         ):
             # queue slots hold scan GROUPS in scan mode: shrink the depth so
             # staged device memory stays ~prefetch_batches batches either way
@@ -757,7 +784,9 @@ class Trainer:
         skip_batches = check_nan and self.conf.nan_policy == "skip_batch"
         try:
           try:
-            with device_trace(self.conf.trace_dir or None):
+            with telemetry.span("pass", pass_idx=self._pass_idx,
+                                global_step=self.global_step), \
+                 device_trace(self.conf.trace_dir or None):
               for kind, batch, dev in feed_iter:
                 # chaos site: a hang here simulates a stalled device step;
                 # the watchdog bounds it and names this process + stage
@@ -885,7 +914,21 @@ class Trainer:
         metrics["steps"] = n_steps
         if prof.enabled:
             metrics["profile"] = prof.report()
-            print("[profile]", prof.log_line())
+            stage_q = prof.quantiles()
+            if stage_q:
+                metrics["profile"]["stage_quantiles"] = stage_q
+            if self.conf.profile:
+                print("[profile]", prof.log_line())
+        if host_trace_dir:
+            from paddlebox_tpu.telemetry.events import _default_rank
+
+            telemetry.flush_trace(os.path.join(
+                host_trace_dir,
+                f"host-trace-r{_default_rank()}-pass{self._pass_idx}.json",
+            ))
+        if event_log is not None:
+            event_log.log_pass(metrics, pass_idx=self._pass_idx)
+        self._pass_idx += 1
         self.last_auc_state = mstate["auc"]
         self.last_metric_state = mstate
         return metrics

@@ -234,8 +234,20 @@ def publish_checkpoint(
     is re-read through the remote fs and checked against its integrity
     manifest BEFORE the donefile lands — a consumer following the donefile
     never sees a tag whose remote bytes are wrong."""
+    from paddlebox_tpu import telemetry
     from paddlebox_tpu.checkpoint import verify_checkpoint_dir
 
+    with telemetry.span("ckpt.publish", tag=tag), \
+         telemetry.histogram(
+             "ckpt.publish_seconds",
+             help="checkpoint publish wall time (s)",
+         ).time():
+        _publish_checkpoint_timed(manager, tag, remote_root, fs, verify,
+                                  verify_checkpoint_dir)
+
+
+def _publish_checkpoint_timed(manager, tag, remote_root, fs, verify,
+                              verify_checkpoint_dir) -> None:
     fs = fs or resolve_fs(remote_root)
     entries = [e for e in manager.list_checkpoints() if e.tag == tag]
     if not entries:

@@ -1,39 +1,51 @@
-"""Named stat registry for counters/gauges.
+"""Named stat registry for counters/gauges — legacy facade.
 
 Reference: paddle/fluid/platform/monitor.{h,cc} — lock-free StatRegistry<T>
-with STAT_INT_ADD macros (monitor.h:76,133). Python GIL makes a plain dict
-with a lock sufficient here; hot-path counters live in C++ (_native)."""
+with STAT_INT_ADD macros (monitor.h:76,133).  Since the telemetry layer
+landed this is a thin compatibility surface over the typed process
+registry (:mod:`paddlebox_tpu.telemetry.metrics`): ``stats.add`` feeds a
+typed Counter, ``stats.set`` a Gauge, so every legacy call-site shows up
+in ``/metrics`` and the fleet snapshot with no changes — new code should
+use ``telemetry.counter/gauge/histogram`` directly for labels and
+distributions.
+"""
 
 from __future__ import annotations
 
-import threading
 from typing import Dict
+
+from paddlebox_tpu.telemetry import metrics as _tm
 
 
 class StatRegistry:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._stats: Dict[str, float] = {}
+    """The legacy flat add/set/get surface, backed by a typed registry."""
+
+    def __init__(self, registry: _tm.MetricRegistry = None):
+        self._registry = registry if registry is not None else _tm.MetricRegistry()
 
     def add(self, name: str, value: float = 1) -> None:
-        with self._lock:
-            self._stats[name] = self._stats.get(name, 0) + value
+        self._registry.counter(name).inc(value)
 
     def set(self, name: str, value: float) -> None:
-        with self._lock:
-            self._stats[name] = value
+        self._registry.gauge(name).set(value)
 
     def get(self, name: str) -> float:
-        with self._lock:
-            return self._stats.get(name, 0)
+        m = self._registry.get(name)
+        if m is None or not isinstance(m, (_tm.Counter, _tm.Gauge)):
+            return 0
+        return m.value()
 
     def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            return dict(self._stats)
+        """Flat name->value dict (histograms excluded); the returned
+        :class:`~paddlebox_tpu.telemetry.metrics.Snapshot` carries the
+        monotonic instant it was taken at (``.monotonic_ts``), read under
+        the registry lock, so two snapshots can be turned into rates."""
+        return self._registry.flat_values()
 
     def reset(self) -> None:
-        with self._lock:
-            self._stats.clear()
+        self._registry.reset()
 
 
-stats = StatRegistry()
+# the process-global instance: shares the telemetry registry, so legacy
+# counters and typed metrics are ONE catalog
+stats = StatRegistry(_tm.registry)

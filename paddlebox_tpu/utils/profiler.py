@@ -11,17 +11,40 @@ TPU-native replacement for the reference's two profiling surfaces
     ``StepProfiler`` accumulates per pass and reports like the reference's
     ``log_for_profile`` lines.
   * the framework profiler / CUPTI timeline (platform/profiler.cc,
-    device_tracer.cc) — subsumed by ``jax.profiler``: ``device_trace``
-    wraps a pass in a trace whose xplane dump is viewable in TensorBoard /
-    Perfetto, giving per-fusion device timing XLA-side.
+    device_tracer.cc) — split between ``jax.profiler`` (``device_trace``
+    wraps a pass in an XLA trace viewable in TensorBoard/Perfetto) and the
+    telemetry layer's host span tracer (telemetry/trace.py), which the
+    profiled stages feed.
+
+Every stage observation also lands in the telemetry registry's
+``trainer.stage_seconds`` histogram (labeled by stage), so /metrics and
+the fleet snapshot carry per-stage latency DISTRIBUTIONS — the p99 that
+means hide — even for runs that never enable the full profiler
+(:class:`StatsProfiler`, the trainers' default).
 """
 
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Iterator, Optional
 
+from paddlebox_tpu.telemetry import metrics as _tm
+from paddlebox_tpu.telemetry import trace as _trace
 from paddlebox_tpu.utils.timer import Timer
+
+# host stages are sub-ms to seconds: tighter boundaries than the default
+# latency ladder so per-stage quantiles don't collapse into one bucket
+STAGE_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0,
+)
+
+
+def stage_histogram(metric: str = "trainer.stage_seconds") -> _tm.Histogram:
+    return _tm.histogram(
+        metric, help="host pipeline stage latency (s)", buckets=STAGE_BUCKETS
+    )
 
 
 class NullProfiler:
@@ -37,42 +60,98 @@ class NullProfiler:
         pass
 
 
+class StatsProfiler(NullProfiler):
+    """Histogram-only stage timing: observes each stage's wall seconds into
+    the telemetry registry but keeps ``enabled = False`` — no per-step
+    device sync, no serial-feed forcing, so the trainers run it ALWAYS
+    (per-stage p50/p99 in every run at the cost of two perf_counter calls
+    per stage)."""
+
+    def __init__(self, metric: str = "trainer.stage_seconds"):
+        self._hist = stage_histogram(metric)
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._hist.observe(time.perf_counter() - t0, stage=name)
+
+
 class StepProfiler:
-    """Named stage timers + step counter (TrainFilesWithProfiler analog)."""
+    """Named stage timers + step counter (TrainFilesWithProfiler analog).
+
+    Stages auto-create on first use — callers add stages freely (the
+    hardcoded 4-stage tuple remains only as the canonical report order).
+    Each stage body is also observed into the ``trainer.stage_seconds``
+    histogram and emitted as a span to the active trace (nested
+    plan/feed/step/dump spans in the pass's Chrome-trace dump).
+    """
 
     STAGES = ("plan", "feed", "step", "dump")
     enabled = True
 
-    def __init__(self):
+    def __init__(self, metric: str = "trainer.stage_seconds"):
         self.timers = {s: Timer() for s in self.STAGES}
         self.n_steps = 0
+        self._hist = stage_histogram(metric)
+
+    def _timer(self, name: str) -> Timer:
+        t = self.timers.get(name)
+        if t is None:
+            t = self.timers[name] = Timer()
+        return t
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
-        t = self.timers[name]
+        t = self._timer(name)
         t.resume()
+        t0 = time.perf_counter()
         try:
-            yield
+            with _trace.span(name):
+                yield
         finally:
             t.pause()
+            self._hist.observe(time.perf_counter() - t0, stage=name)
 
     def step_done(self) -> None:
         self.n_steps += 1
 
+    def _ordered_stages(self) -> list:
+        extra = sorted(s for s in self.timers if s not in self.STAGES)
+        return [s for s in self.STAGES if s in self.timers] + extra
+
     def report(self) -> dict:
-        """Per-stage totals and means (seconds)."""
+        """Per-stage totals, resume/pause cycle counts, and means (s)."""
         out = {"steps": self.n_steps}
-        for name, t in self.timers.items():
+        for name in self._ordered_stages():
+            t = self.timers[name]
             out[f"{name}_sec"] = t.elapsed_sec()
+            out[f"{name}_count"] = t.count()
             if self.n_steps:
                 out[f"{name}_ms_per_step"] = 1e3 * t.elapsed_sec() / self.n_steps
+        return out
+
+    def quantiles(self) -> dict:
+        """Per-stage p50/p99 ms from the registry histogram — the
+        distribution companion to report()'s means."""
+        out = {}
+        for name in self._ordered_stages():
+            s = self._hist.summary(stage=name)
+            if s["count"]:
+                out[name] = {
+                    "p50_ms": round(s["p50"] * 1e3, 3),
+                    "p99_ms": round(s["p99"] * 1e3, 3),
+                    "count": s["count"],
+                }
         return out
 
     def log_line(self) -> str:
         """One-line summary (the reference's log_for_profile format spirit)."""
         r = self.report()
         parts = [f"steps={r['steps']}"]
-        for s in self.STAGES:
+        for s in self._ordered_stages():
             if f"{s}_ms_per_step" in r:
                 parts.append(f"{s}={r[f'{s}_ms_per_step']:.2f}ms")
         return " ".join(parts)

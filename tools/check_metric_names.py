@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Metric-name drift check: every metric created in code must be in the
+ARCHITECTURE.md catalog.
+
+Greps the package (plus bench.py) for metric-creating call-sites —
+``stats.add(`` / ``stats.set(`` / ``counter(`` / ``gauge(`` /
+``histogram(`` with a literal first argument — and fails if any metric
+name is missing from the "Observability" section's catalog table.  This
+keeps the catalog honest as the codebase grows: a new counter lands, the
+tier-1 suite fails until the table row does too.
+
+Name matching: f-string placeholders in code (``f"retry.{site}.calls"``)
+and ``<site>``-style placeholders in the table both normalize to ``*``
+segments, so dynamic families stay one catalog row.
+
+Usage:
+    python tools/check_metric_names.py            # check, exit 1 on drift
+    python tools/check_metric_names.py --list     # dump what was found
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARCH = os.path.join(REPO, "ARCHITECTURE.md")
+
+# metric-creating call with a (possibly f-) string literal first argument;
+# DOTALL so names split across the open-paren's line break still match
+_CALL_RE = re.compile(
+    r"""\b(?:stats\.(?:add|set)|counter|gauge|histogram)\(\s*
+        (f?)(["'])([^"']+)\2""",
+    re.VERBOSE | re.DOTALL,
+)
+# backticked names in the catalog table's first column
+_TABLE_ROW_RE = re.compile(r"^\|\s*`([^`]+)`")
+
+
+def scan_sources() -> dict:
+    """{normalized metric name pattern: first 'file:line' seen}."""
+    roots = [os.path.join(REPO, "paddlebox_tpu"), os.path.join(REPO, "bench.py")]
+    found: dict = {}
+    for root in roots:
+        files = [root] if root.endswith(".py") else [
+            os.path.join(d, f)
+            for d, _, fs in os.walk(root)
+            for f in fs
+            if f.endswith(".py")
+        ]
+        for path in sorted(files):
+            with open(path) as fh:
+                text = fh.read()
+            for m in _CALL_RE.finditer(text):
+                is_f, name = m.group(1), m.group(3)
+                if is_f:
+                    name = re.sub(r"\{[^}]*\}", "*", name)
+                if not re.search(r"[a-zA-Z]", name):
+                    continue
+                line = text.count("\n", 0, m.start()) + 1
+                rel = os.path.relpath(path, REPO)
+                found.setdefault(name, f"{rel}:{line}")
+    return found
+
+
+def catalog_patterns() -> list:
+    """Glob patterns from the ARCHITECTURE.md metric catalog (``<x>`` and
+    ``*`` both mean "any segment text")."""
+    pats: list = []
+    in_obs = False
+    with open(ARCH) as fh:
+        for line in fh:
+            if line.startswith("## "):
+                in_obs = line.strip().lower().startswith("## observability")
+                continue
+            if not in_obs:
+                continue
+            m = _TABLE_ROW_RE.match(line.strip())
+            if m:
+                pats.append(re.sub(r"<[^>]*>", "*", m.group(1)))
+    return pats
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--list", action="store_true",
+                    help="print every discovered metric name and exit 0")
+    args = ap.parse_args(argv)
+    found = scan_sources()
+    if args.list:
+        for name, where in sorted(found.items()):
+            print(f"{name:45s} {where}")
+        return 0
+    pats = catalog_patterns()
+    if not pats:
+        print("ERROR: no metric catalog table found in ARCHITECTURE.md "
+              "('## Observability' section)", file=sys.stderr)
+        return 2
+    missing = []
+    for name, where in sorted(found.items()):
+        # placeholders in the code name become a concrete dummy segment so
+        # glob matching runs pattern-vs-string, not pattern-vs-pattern
+        concrete = name.replace("*", "ANY")
+        if not any(fnmatch.fnmatchcase(concrete, p) for p in pats):
+            missing.append((name, where))
+    if missing:
+        print("metric names missing from the ARCHITECTURE.md catalog "
+              "(## Observability):", file=sys.stderr)
+        for name, where in missing:
+            print(f"  {name}  ({where})", file=sys.stderr)
+        print(f"{len(missing)} missing; add catalog rows or rename.",
+              file=sys.stderr)
+        return 1
+    print(f"metric catalog OK: {len(found)} call-site name(s) covered by "
+          f"{len(pats)} catalog row(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
