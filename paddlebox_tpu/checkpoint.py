@@ -58,12 +58,25 @@ class CheckpointCorrupt(RuntimeError):
 # after publish.  The reference relies on HDFS block checksums for this;
 # local disk and `hadoop fs -put` round-trips get no such guarantee.
 # --------------------------------------------------------------------------- #
-def write_manifest(dirname: str, manifest_name: str) -> None:
+def write_manifest(dirname: str, manifest_name: str,
+                   recursive: bool = False) -> None:
     """Hash every regular file in ``dirname`` (except manifests) into
-    ``dirname/manifest_name``."""
+    ``dirname/manifest_name``.  ``recursive`` walks subdirectories too
+    (slash-separated relative paths as keys) — serving artifacts keep
+    their sparse snapshot under ``sparse/`` and must hash it, while
+    checkpoint dirs stay flat and keep the historical behavior."""
+    if recursive:
+        names = []
+        for base, _, fs in os.walk(dirname):
+            rel = os.path.relpath(base, dirname)
+            for f in fs:
+                names.append(f if rel == "." else f"{rel}/{f}".replace(os.sep, "/"))
+        names.sort()
+    else:
+        names = sorted(os.listdir(dirname))
     files = {}
-    for name in sorted(os.listdir(dirname)):
-        if name.startswith("manifest"):
+    for name in names:
+        if os.path.basename(name).startswith("manifest"):
             continue
         path = os.path.join(dirname, name)
         if not os.path.isfile(path):

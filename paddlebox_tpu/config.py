@@ -83,6 +83,13 @@ class _Flags:
         "metrics_port": 0,
         "trace_dir": "",
         "events_path": "",
+        # online model delivery (serving_sync/): the publish root a
+        # trainer ships base/delta model units to (""= publishing off;
+        # launch.py --publish-root sets it fleet-wide), and the serving-
+        # side sync agent's donefile poll cadence / artifact cache dir
+        "publish_root": "",
+        "sync_interval_s": 10.0,
+        "sync_cache_dir": "",
     }
 
     def __getattr__(self, name: str):

@@ -1,5 +1,13 @@
-from paddlebox_tpu.inference.export import export_model
+from paddlebox_tpu.inference.export import (
+    export_model,
+    export_serving_programs,
+)
 from paddlebox_tpu.inference.predictor import Predictor
 from paddlebox_tpu.inference.server import ScoringServer
 
-__all__ = ["export_model", "Predictor", "ScoringServer"]
+__all__ = [
+    "export_model",
+    "export_serving_programs",
+    "Predictor",
+    "ScoringServer",
+]

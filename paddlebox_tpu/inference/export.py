@@ -29,10 +29,112 @@ import json
 import os
 
 import jax
+import jax.export  # noqa: F401  -- on jax 0.4.x the submodule is not an
+# attribute of the bare `jax` import; accessing jax.export.export without
+# this raises AttributeError
 import jax.numpy as jnp
 import numpy as np
 
 FORMAT_VERSION = 1
+
+
+def export_serving_programs(
+    model,
+    params,
+    out_dir: str,
+    *,
+    batch_size: int,
+    key_capacity: int,
+    dense_dim: int,
+    row_width: int,
+    rank_offset_cols: int = 0,
+    batch_buckets=None,
+    feed_conf=None,
+) -> list:
+    """Lower + serialize the serving program ladder for ``model`` with
+    ``params`` frozen in, writing ``serving*.stablehlo`` files into
+    ``out_dir``.  Returns the bucket metadata list
+    (``[{"batch_size", "key_capacity", "file"}, ...]``).
+
+    Split out of :func:`export_model` so the online delivery plane
+    (serving_sync.Publisher) can re-freeze the DENSE side per pass —
+    programs are small (dense params + lowered HLO) while the sparse
+    snapshot is the multi-GB part, so a per-pass delta publish ships
+    fresh programs + touched sparse rows and never the whole table.
+    """
+    uses_rank = getattr(model, "uses_rank_offset", False)
+    uses_seq = getattr(model, "uses_seq_pos", False)
+    seq_len = int(getattr(model, "max_seq_len", 0)) if uses_seq else 0
+    if uses_rank and rank_offset_cols <= 0:
+        raise ValueError(
+            "model consumes rank_offset: pass rank_offset_cols "
+            "(DataFeedConfig.rank_offset_cols) so the serving program can "
+            "take the PV-merged rank matrix as input"
+        )
+    os.makedirs(out_dir, exist_ok=True)
+    frozen = jax.tree.map(jnp.asarray, params)
+    buckets = [(int(batch_size), int(key_capacity))]
+    for bb, bk in batch_buckets or ():
+        if (int(bb), int(bk)) not in buckets:
+            buckets.append((int(bb), int(bk)))
+    if feed_conf is not None and not any(
+        feed_conf.batch_size <= bb for bb, _ in buckets
+    ):
+        # fail BEFORE the expensive lowering loop: the server chunks
+        # requests by feed_conf.batch_size, so some bucket must fit a full
+        # chunk or the artifact is inherently un-servable
+        raise ValueError(
+            f"feed_conf.batch_size={feed_conf.batch_size} fits no "
+            f"exported bucket (batch sizes {[b for b, _ in buckets]}): "
+            "add a bucket via batch_buckets or lower the feed batch"
+        )
+    bucket_meta = []
+    for B, K in buckets:
+        # extras ride in a fixed order after the three core inputs:
+        # rank_offset (when used), then seq_pos (when used) — the
+        # Predictor assembles args in the same order
+        def serve(rows, key_segments, dense, *extras, B=B):
+            kw = {}
+            i = 0
+            if uses_rank:
+                kw["rank_offset"] = extras[i]
+                i += 1
+            if uses_seq:
+                kw["seq_pos"] = extras[i]
+            logits = model.apply(frozen, rows, key_segments, dense, B, **kw)
+            return jax.nn.sigmoid(logits)
+
+        # lower for both serving platforms: a TPU-trained artifact must run
+        # on a CPU-only serving host too
+        in_shapes = [
+            jax.ShapeDtypeStruct((K, row_width), jnp.float32),
+            jax.ShapeDtypeStruct((K,), jnp.int32),
+            jax.ShapeDtypeStruct((B, dense_dim), jnp.float32),
+        ]
+        if uses_rank:
+            in_shapes.append(
+                jax.ShapeDtypeStruct((B, rank_offset_cols), jnp.int32)
+            )
+        if uses_seq:
+            in_shapes.append(
+                jax.ShapeDtypeStruct((B, seq_len), jnp.int32)
+            )
+        exp = jax.export.export(jax.jit(serve), platforms=("cpu", "tpu"))(
+            *in_shapes
+        )
+        # the primary bucket keeps the legacy filename so pre-bucket
+        # artifacts and loaders stay interchangeable
+        fname = (
+            "serving.stablehlo"
+            if (B, K) == buckets[0]
+            else f"serving-b{B}-k{K}.stablehlo"
+        )
+        with open(os.path.join(out_dir, fname), "wb") as f:
+            f.write(exp.serialize())
+        bucket_meta.append(
+            {"batch_size": B, "key_capacity": K, "file": fname}
+        )
+    return bucket_meta
 
 
 def export_model(
@@ -124,70 +226,16 @@ def export_model(
         # every rank contributed its sparse shard above; the program and
         # meta are identical everywhere — same convention as checkpoint.py)
 
-    frozen = jax.tree.map(jnp.asarray, params)
-    buckets = [(int(batch_size), int(key_capacity))]
-    for bb, bk in batch_buckets or ():
-        if (int(bb), int(bk)) not in buckets:
-            buckets.append((int(bb), int(bk)))
-    if feed_conf is not None and not any(
-        feed_conf.batch_size <= bb for bb, _ in buckets
-    ):
-        # fail BEFORE the expensive lowering loop: the server chunks
-        # requests by feed_conf.batch_size, so some bucket must fit a full
-        # chunk or the artifact is inherently un-servable
-        raise ValueError(
-            f"feed_conf.batch_size={feed_conf.batch_size} fits no "
-            f"exported bucket (batch sizes {[b for b, _ in buckets]}): "
-            "add a bucket via batch_buckets or lower the feed batch"
-        )
-    bucket_meta = []
-    for B, K in buckets:
-        # extras ride in a fixed order after the three core inputs:
-        # rank_offset (when used), then seq_pos (when used) — the
-        # Predictor assembles args in the same order
-        def serve(rows, key_segments, dense, *extras, B=B):
-            kw = {}
-            i = 0
-            if uses_rank:
-                kw["rank_offset"] = extras[i]
-                i += 1
-            if uses_seq:
-                kw["seq_pos"] = extras[i]
-            logits = model.apply(frozen, rows, key_segments, dense, B, **kw)
-            return jax.nn.sigmoid(logits)
+    bucket_meta = export_serving_programs(
+        model, params, out_dir,
+        batch_size=batch_size, key_capacity=key_capacity,
+        dense_dim=dense_dim, row_width=w,
+        rank_offset_cols=rank_offset_cols, batch_buckets=batch_buckets,
+        feed_conf=feed_conf,
+    )
 
-        # lower for both serving platforms: a TPU-trained artifact must run
-        # on a CPU-only serving host too
-        in_shapes = [
-            jax.ShapeDtypeStruct((K, w), jnp.float32),
-            jax.ShapeDtypeStruct((K,), jnp.int32),
-            jax.ShapeDtypeStruct((B, dense_dim), jnp.float32),
-        ]
-        if uses_rank:
-            in_shapes.append(
-                jax.ShapeDtypeStruct((B, rank_offset_cols), jnp.int32)
-            )
-        if uses_seq:
-            in_shapes.append(
-                jax.ShapeDtypeStruct((B, seq_len), jnp.int32)
-            )
-        exp = jax.export.export(jax.jit(serve), platforms=("cpu", "tpu"))(
-            *in_shapes
-        )
-        # the primary bucket keeps the legacy filename so pre-bucket
-        # artifacts and loaders stay interchangeable
-        fname = (
-            "serving.stablehlo"
-            if (B, K) == buckets[0]
-            else f"serving-b{B}-k{K}.stablehlo"
-        )
-        with open(os.path.join(out_dir, fname), "wb") as f:
-            f.write(exp.serialize())
-        bucket_meta.append(
-            {"batch_size": B, "key_capacity": K, "file": fname}
-        )
-
-    B, K = buckets[0]
+    B = bucket_meta[0]["batch_size"]
+    K = bucket_meta[0]["key_capacity"]
     n_tasks = int(getattr(model, "n_tasks", 1))
     meta = {
         "format_version": FORMAT_VERSION,

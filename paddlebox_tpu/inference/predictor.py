@@ -58,6 +58,8 @@ class Predictor:
 
     def _program(self, fname: str):
         import jax
+        import jax.export  # noqa: F401  -- explicit: not reachable via the
+        # bare `jax` import on 0.4.x (AttributeError without it)
 
         if fname not in self._programs:
             with open(os.path.join(self._dir, fname), "rb") as f:
@@ -103,6 +105,77 @@ class Predictor:
             for bm in bucket_meta
         ]
         return cls(meta, keys, values, artifact_dir, bucket_files)
+
+    # -- delta hot-apply (build-aside) -------------------------------------- #
+    def with_delta(self, keys: np.ndarray, values: np.ndarray,
+                   program_dir: str = None,
+                   bucket_meta: list = None) -> "Predictor":
+        """A NEW Predictor with delta rows merged in; ``self`` is never
+        mutated, so in-flight predict() calls keep a consistent snapshot
+        and the caller swaps the returned object in atomically (the
+        serving_sync syncer's hot-apply path).
+
+        keys: uint64 delta keys (need not be sorted; deduped by last
+        occurrence order after sort).  values: [n, row_width] f32 rows —
+        existing keys are REPLACED (delta rows carry the full current
+        row, not an increment, matching SparseTable.pop_delta), genuinely
+        new keys are inserted preserving the sorted-keys invariant the
+        searchsorted resolve depends on.
+
+        program_dir/bucket_meta: when the delta shipped re-frozen serving
+        programs (publisher publish_delta with model+params), point the
+        new predictor at them; otherwise the existing programs (and their
+        deserialization cache) are shared — sparse-only freshness.
+        """
+        dk = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        dv = np.asarray(values, dtype=np.float32)
+        w = int(self.meta["row_width"])
+        if dv.ndim != 2 or dv.shape[1] < w:
+            raise ValueError(
+                f"delta values are {dv.shape}, artifact row_width is {w}"
+            )
+        dv = dv[:, :w]
+        if dk.shape[0] != dv.shape[0]:
+            raise ValueError(
+                f"delta keys/values disagree: {dk.shape[0]} vs {dv.shape[0]}"
+            )
+        order = np.argsort(dk, kind="stable")
+        dk, dv = dk[order], dv[order]
+        if dk.shape[0] and np.any(dk[1:] == dk[:-1]):
+            # keep the LAST row per duplicate key (newest write wins)
+            last = np.ones(dk.shape[0], bool)
+            last[:-1] = dk[1:] != dk[:-1]
+            dk, dv = dk[last], dv[last]
+        n = self._keys.shape[0]
+        if n and dk.shape[0]:
+            pos = np.searchsorted(self._keys, dk)
+            pos_c = np.minimum(pos, n - 1)
+            found = self._keys[pos_c] == dk
+        else:
+            pos = np.zeros(dk.shape[0], np.int64)
+            found = np.zeros(dk.shape[0], bool)
+        new_vals = self._values.copy()
+        if found.any():
+            new_vals[pos[found]] = dv[found]
+        if (~found).any():
+            ins_at = pos[~found]  # insertion points keep the sort order
+            new_keys = np.insert(self._keys, ins_at, dk[~found])
+            new_vals = np.insert(new_vals, ins_at, dv[~found], axis=0)
+        else:
+            new_keys = self._keys
+        if program_dir is not None:
+            bm = bucket_meta or self.meta.get("buckets") or []
+            buckets = [
+                (int(b["batch_size"]), int(b["key_capacity"]), b["file"])
+                for b in bm
+            ] or list(self._buckets)
+            out = Predictor(self.meta, new_keys, new_vals, program_dir,
+                            buckets)
+        else:
+            out = Predictor(self.meta, new_keys, new_vals, self._dir,
+                            list(self._buckets))
+            out._programs = self._programs  # share the deserialized cache
+        return out
 
     # -- feature resolve (host) -------------------------------------------- #
     def _resolve_rows(self, batch_keys: np.ndarray, n_keys: int,

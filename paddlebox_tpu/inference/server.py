@@ -48,6 +48,12 @@ _REQUEST_SECONDS = telemetry.histogram(
     "server.request_seconds",
     help="scoring request latency (s) by model + status class",
 )
+# freshness: seconds since the live version of each model was published
+# (set on every /models read and by the serving_sync syncer's poll tick)
+_MODEL_AGE = telemetry.gauge(
+    "serve.model_age_seconds",
+    help="seconds since the serving model's current version was published",
+)
 
 
 def _status_class(code: int) -> str:
@@ -56,7 +62,7 @@ def _status_class(code: int) -> str:
 
 class ModelEntry:
     def __init__(self, name: str, predictor: Predictor,
-                 feed_conf: DataFeedConfig):
+                 feed_conf: DataFeedConfig, version: Optional[dict] = None):
         self.name = name
         self.predictor = predictor
         self.feed_conf = feed_conf
@@ -67,6 +73,16 @@ class ModelEntry:
         self.parser = SlotParser(feed_conf)
         self.requests = 0
         self.instances = 0
+        # delivery lineage (serving_sync registry: base tag + applied
+        # delta chain + publish time); None for directly-registered models
+        self.version: Optional[dict] = dict(version) if version else None
+        self.loaded_at = time.time()
+
+    def age_seconds(self) -> float:
+        """Freshness: seconds since this model's live version was
+        published (falls back to load time for direct registrations)."""
+        ref = (self.version or {}).get("published_at") or self.loaded_at
+        return max(0.0, time.time() - float(ref))
 
 
 class ScoringServer:
@@ -90,12 +106,19 @@ class ScoringServer:
 
     # -- registry ---------------------------------------------------------- #
     def register(self, name: str, artifact_dir: str,
-                 feed_conf: Optional[DataFeedConfig] = None) -> None:
+                 feed_conf: Optional[DataFeedConfig] = None,
+                 version: Optional[dict] = None) -> None:
         """Load an artifact under ``name`` (first registered = default).
 
         feed_conf: None reads the artifact's own feed.json (written by
         export_model(feed_conf=...)) — a self-contained artifact needs no
-        Python-side config at all."""
+        Python-side config at all.
+
+        Re-registering an existing name is a hot swap: the fully-built
+        replacement entry is installed under the registry lock in one
+        assignment (request/instance counters carry over), so an in-flight
+        ``score_lines`` either sees the old model or the new one, never a
+        half-registered mix."""
         if feed_conf is None:
             import os
 
@@ -108,7 +131,18 @@ class ScoringServer:
                 )
             with open(path) as f:
                 feed_conf = DataFeedConfig.from_dict(json.load(f))
-        entry = ModelEntry(name, Predictor.load(artifact_dir), feed_conf)
+        self.register_predictor(name, Predictor.load(artifact_dir),
+                                feed_conf, version=version)
+
+    def register_predictor(self, name: str, predictor: Predictor,
+                           feed_conf: DataFeedConfig,
+                           version: Optional[dict] = None) -> None:
+        """Register an already-loaded Predictor (the serving_sync syncer's
+        entry point: it builds predictors from publish-root artifacts and
+        delta merges, then installs them here).  Same hot-swap semantics
+        as register(): everything slow/fallible happens BEFORE the lock,
+        the install is one guarded assignment."""
+        entry = ModelEntry(name, predictor, feed_conf, version=version)
         if entry.predictor.meta.get("n_tasks", 1) > 1:
             raise ValueError(
                 "multi-task artifacts are not servable over the slot-text "
@@ -116,13 +150,42 @@ class ScoringServer:
                 "via Predictor.predict directly"
             )
         with self._meta_lock:
+            prev = self._models.get(name)
+            if prev is not None:
+                # a replacement keeps the name's serving history: the
+                # counters describe the NAME clients score against, not
+                # one loaded artifact
+                entry.requests = prev.requests
+                entry.instances = prev.instances
             self._models[name] = entry
             if self._default is None:
                 self._default = name
 
+    def swap_model(self, name: str, predictor: Predictor,
+                   version: Optional[dict] = None) -> None:
+        """Atomically replace ONLY the predictor (and version lineage) of
+        a registered model — the delta hot-apply path: parser, feed
+        config and counters stay, so the swap costs one pointer write
+        under the lock.  In-flight requests pinned the old predictor at
+        entry and finish on it; no request ever mixes the two.  KeyError
+        when ``name`` was never registered (a delta cannot create a
+        model; the syncer full-reloads through register_predictor)."""
+        with self._meta_lock:
+            entry = self._models[name]
+            entry.predictor = predictor
+            entry.version = dict(version) if version else None
+            entry.loaded_at = time.time()
+
     def model_names(self) -> list:
         with self._meta_lock:
             return list(self._models)
+
+    def model_version(self, name: Optional[str] = None) -> Optional[dict]:
+        """The lineage dict of a registered model (None when registered
+        directly from an artifact, without delivery metadata)."""
+        with self._meta_lock:
+            entry = self._models[name or self._default]
+            return dict(entry.version) if entry.version else None
 
     # -- scoring ------------------------------------------------------------ #
     def score_lines(self, text: bytes, name: Optional[str] = None) -> list:
@@ -136,6 +199,12 @@ class ScoringServer:
         analysis_predictor.cc, by decomposition instead of recompilation)."""
         with self._meta_lock:
             entry = self._models[name or self._default]
+            # pin ONE predictor snapshot for the whole request: a
+            # concurrent swap_model/register must never let a request mix
+            # the old predictor's bucket ladder with the new one's
+            # programs (every chunk of this request scores on the same
+            # model version)
+            predictor = entry.predictor
         from paddlebox_tpu.data.feed import BatchBuilder
 
         lines = [ln for ln in text.decode().splitlines() if ln.strip()]
@@ -152,7 +221,7 @@ class ScoringServer:
         # and schema/config errors from predict() propagate immediately
         # instead of surviving a split
         lens = np.diff(block.key_offsets[:: block.n_sparse_slots])
-        buckets = entry.predictor.bucket_shapes
+        buckets = predictor.bucket_shapes
 
         def score_ids(ids) -> list:
             nk = int(lens[ids].sum())
@@ -165,7 +234,7 @@ class ScoringServer:
             # a SINGLE instance beyond key capacity serves clipped — exactly
             # what training would have done with it (dropped_keys counts it)
             batch = builder.build(block, ids)
-            return [float(s) for s in entry.predictor.predict(batch)]
+            return [float(s) for s in predictor.predict(batch)]
 
         with self._lock, telemetry.span(
             "server.score", model=entry.name, n_ins=block.n_ins
@@ -226,7 +295,28 @@ class ScoringServer:
                         {"ok": ready, "ready": ready, "models": models},
                     )
                 elif self.path == "/models":
-                    self._send(200, {"models": server.model_names(),
+                    # per-model version lineage + freshness: base tag,
+                    # applied delta chain length, publish time and age —
+                    # the operator view of the delivery plane (and the
+                    # serve.model_age_seconds gauge refresh point)
+                    with server._meta_lock:
+                        entries = list(server._models.items())
+                    models = {}
+                    for n, e in entries:
+                        age = e.age_seconds()
+                        _MODEL_AGE.set(age, model=n)
+                        v = e.version or {}
+                        models[n] = {
+                            "requests": e.requests,
+                            "instances": e.instances,
+                            "base_tag": v.get("base_tag"),
+                            "tag": v.get("tag"),
+                            "deltas_applied": v.get("deltas_applied", 0),
+                            "seq": v.get("seq"),
+                            "published_at": v.get("published_at"),
+                            "age_seconds": age,
+                        }
+                    self._send(200, {"models": models,
                                      "default": server._default})
                 else:
                     self._send(404, {"error": "not found"})

@@ -50,6 +50,7 @@ def rank_env(
     liveness_deadline_s: Optional[float] = None,
     metrics_port: Optional[int] = None,
     trace_dir: Optional[str] = None,
+    publish_root: Optional[str] = None,
 ) -> dict:
     """Child environment for one rank (exported for tests/embedders)."""
     env = dict(base_env if base_env is not None else os.environ)
@@ -68,6 +69,11 @@ def rank_env(
         # per-pass host span traces (Chrome trace JSON, Perfetto-viewable);
         # file names carry the rank, so one shared dir works for the fleet
         env["PBOX_TRACE_DIR"] = trace_dir
+    if publish_root:
+        # online model delivery (serving_sync): the training script's
+        # Publisher ships base/delta model units here each pass — one
+        # launcher knob points the whole fleet at the serving plane
+        env["PBOX_PUBLISH_ROOT"] = publish_root
     if devices_per_proc:
         import re
 
@@ -98,6 +104,7 @@ def launch(
     job_timeout_s: Optional[float] = None,
     metrics_port: Optional[int] = None,
     trace_dir: Optional[str] = None,
+    publish_root: Optional[str] = None,
 ) -> int:
     """Spawn nproc ranks of ``python script_args...``; return the first
     non-zero exit code (0 if all ranks succeed).  Any rank dying kills the
@@ -119,6 +126,7 @@ def launch(
             rank, nproc, coordinator, devices_per_proc,
             liveness_deadline_s=liveness_deadline_s,
             metrics_port=metrics_port, trace_dir=trace_dir,
+            publish_root=publish_root,
         )
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
@@ -202,6 +210,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("--trace-dir", default=None,
                     help="write per-pass host span traces (Chrome trace "
                          "JSON, Perfetto-viewable) here (PBOX_TRACE_DIR)")
+    ap.add_argument("--publish-root", default=None,
+                    help="online model delivery publish root for the "
+                         "fleet's serving_sync Publisher "
+                         "(PBOX_PUBLISH_ROOT)")
     ap.add_argument("script", help="training script to run")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -215,6 +227,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         job_timeout_s=args.job_timeout,
         metrics_port=args.metrics_port,
         trace_dir=args.trace_dir,
+        publish_root=args.publish_root,
     )
 
 

@@ -2,11 +2,20 @@
 
     python -m paddlebox_tpu.serve --artifact /path/to/art [...more] \\
         [--port 8080] [--host 0.0.0.0] [--cpu]
+    python -m paddlebox_tpu.serve --sync-root /publish/root \\
+        [--sync-model live] [--sync-interval 10] [--cpu]
 
 Each --artifact may be DIR or NAME=DIR (NAME defaults to the directory
 basename; the first one registered is the default model).  Artifacts must
 carry their feed schema (export_model(feed_conf=...)); endpoints are
 POST /score[/NAME], GET /healthz, GET /models (inference/server.py).
+
+--sync-root attaches the online delivery plane (serving_sync/): the
+server follows the publish root's donefile, hot-applies sparse deltas
+into the live model between requests, and falls back to full reloads on
+any verification failure — the trainer keeps it minutes-fresh with no
+restart.  GET /models reports each model's version lineage (base tag,
+applied delta count, publish time) and freshness age.
 
 The reference's serving story is the C++ AnalysisPredictor stack plus
 demo servers (/root/reference/paddle/fluid/inference/); this is the
@@ -24,14 +33,30 @@ def main(argv=None) -> None:
         prog="python -m paddlebox_tpu.serve", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("--artifact", action="append", required=True,
+    ap.add_argument("--artifact", action="append", default=[],
                     metavar="[NAME=]DIR",
                     help="artifact directory (repeatable); first = default")
     ap.add_argument("--port", type=int, default=8080)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU backend before any device init")
+    ap.add_argument("--sync-root", default=None,
+                    help="publish root to keep a model synced from "
+                         "(serving_sync delivery plane)")
+    ap.add_argument("--sync-model", default="live",
+                    help="model name the synced root serves under "
+                         "(default: live)")
+    ap.add_argument("--sync-interval", type=float, default=None,
+                    help="donefile poll interval seconds "
+                         "(default: PBOX_SYNC_INTERVAL_S)")
+    ap.add_argument("--sync-cache", default=None,
+                    help="local cache dir for fetched model units")
+    ap.add_argument("--sync-timeout", type=float, default=300.0,
+                    help="max seconds to wait for the first synced model "
+                         "at startup")
     args = ap.parse_args(argv)
+    if not args.artifact and not args.sync_root:
+        ap.error("pass at least one --artifact or a --sync-root")
 
     if args.cpu:
         import jax
@@ -52,12 +77,37 @@ def main(argv=None) -> None:
             )
         server.register(name, path)
         print(f"registered {name!r} <- {path}")
+
+    syncer = None
+    if args.sync_root:
+        from paddlebox_tpu.serving_sync import Syncer
+
+        syncer = Syncer(
+            args.sync_root, server, args.sync_model,
+            cache_dir=args.sync_cache,
+            poll_interval_s=args.sync_interval,
+        )
+        print(f"syncing {args.sync_model!r} <- {args.sync_root}")
+        if not args.artifact:
+            # the HTTP server refuses to start with zero models: block
+            # until the publish root delivers the first one
+            if not syncer.wait_fresh(timeout_s=args.sync_timeout):
+                ap.error(
+                    f"no model appeared under {args.sync_root} within "
+                    f"{args.sync_timeout:.0f}s"
+                )
+        else:
+            syncer.poll_once()
+        syncer.start()
+
     port = server.start(port=args.port, host=args.host)
     print(f"serving on http://{args.host}:{port}/score "
           f"(models: {', '.join(server.model_names())})")
     try:
         server.wait()
     except KeyboardInterrupt:
+        if syncer is not None:
+            syncer.stop()
         server.stop()
 
 

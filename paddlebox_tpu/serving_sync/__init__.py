@@ -1,0 +1,44 @@
+"""Online model delivery plane: trainer→serving publisher, delta
+hot-apply, versioned registry.
+
+The subsystem that connects the trainer's base/delta persistence
+(checkpoint.py / SparseTable.pop_delta) to the live scoring surface
+(inference/server.py), keeping online CTR servers minutes-fresh without
+ever re-shipping the full embedding table (reference: the xbox base/delta
+model chain + fleet_util donefile bookkeeping + the serving-side PS that
+consumes it):
+
+  * :mod:`publisher` — trainer-side per-pass publishing: full artifacts
+    (``publish_base``) and sparse row deltas with re-frozen dense
+    programs (``publish_delta``), staged, manifest-verified through the
+    remote fs, donefile-LAST, sequence-numbered, health-gated;
+  * :mod:`syncer` — server-side polling agent: discovers new donefile
+    entries, verifies manifests, hot-applies delta rows into a
+    build-aside copy of the live Predictor's sorted key/value arrays and
+    swaps atomically; falls back to a full base reload on any chain gap
+    or verification failure, and to the last-good registry version when
+    even that fails;
+  * :mod:`registry` — the donefile wire format plus the versioned model
+    registry (base tag + applied delta chain lineage, bounded last-good
+    history, rollback).
+
+Freshness is first-class telemetry: ``serve.model_age_seconds``,
+``sync.lag_passes``, ``sync.apply_seconds`` and counters for every
+fallback/corruption path (see ARCHITECTURE.md "Model delivery").
+"""
+
+from paddlebox_tpu.serving_sync.publisher import (  # noqa: F401
+    DELTA_META_NAME,
+    DELTA_ROWS_NAME,
+    PublishError,
+    Publisher,
+)
+from paddlebox_tpu.serving_sync.registry import (  # noqa: F401
+    DONEFILE_NAME,
+    DeliveryChainError,
+    ModelRegistry,
+    ModelVersion,
+    PublishEntry,
+    parse_donefile,
+)
+from paddlebox_tpu.serving_sync.syncer import Syncer  # noqa: F401

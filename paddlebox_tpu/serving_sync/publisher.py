@@ -1,0 +1,324 @@
+"""Trainer-side model publisher: per-pass base/delta publishing to a root.
+
+The write half of the delivery plane (reference: SaveBase/SaveDelta's xbox
+model dirs + fleet_util's write_model_donefile + "checks before pushing to
+serving").  A :class:`Publisher` owns one publish root (local path or
+``hdfs://``/``afs://`` via :func:`utils.fs.resolve_fs`) and ships:
+
+  * ``publish_base(tag, ...)`` — a full serving artifact
+    (:func:`inference.export.export_model` output: program ladder + sparse
+    snapshot + meta + feed schema), manifest-verified through the remote
+    fs before its donefile line lands;
+  * ``publish_delta(tag, table, model, params)`` — only the sparse rows
+    touched since the last publish (``SparseTable.delta_state_dict``)
+    plus, when model+params are given, RE-FROZEN serving programs (dense
+    params are small; the sparse table is the multi-TB part — per-pass
+    freshness ships KBs of rows + MBs of programs, never the table).
+
+Discipline, in order, for every publish: stage locally -> write a
+recursive integrity manifest -> upload (retried, fault-injectable) ->
+re-read the REMOTE copy and verify it against the manifest -> append the
+donefile line and upload the donefile LAST.  A consumer that follows the
+donefile therefore never sees an entry whose remote bytes are missing,
+torn, or wrong; and the table's delta tracker is only cleared after the
+upload verified, so a failed publish re-ships the same rows next time.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import tempfile
+import time
+from typing import Optional
+
+import numpy as np
+
+from paddlebox_tpu import telemetry
+from paddlebox_tpu.checkpoint import verify_checkpoint_dir, write_manifest
+from paddlebox_tpu.serving_sync.registry import (
+    DONEFILE_NAME,
+    PublishEntry,
+    parse_donefile,
+)
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.fs import resolve_fs
+from paddlebox_tpu.utils.retry import retry_call
+
+logger = logging.getLogger(__name__)
+
+DELTA_META_NAME = "delta.json"
+DELTA_ROWS_NAME = "sparse_delta.npz"
+
+_PUBLISH_SECONDS = telemetry.histogram(
+    "publish.publish_seconds",
+    help="model publish wall time (s) by kind (base/delta)",
+)
+_PUBLISHED = telemetry.counter(
+    "publish.published", help="published model units by kind"
+)
+_GATED = telemetry.counter(
+    "publish.gated", help="publishes held back by the health gate"
+)
+
+
+class PublishError(RuntimeError):
+    pass
+
+
+class Publisher:
+    def __init__(
+        self,
+        publish_root: str,
+        *,
+        staging_dir: Optional[str] = None,
+        fs=None,
+        verify: bool = True,
+        monitor=None,
+    ):
+        """monitor: an optional ``utils.fleet_util.ModelMonitor`` — when
+        set and a publish passes ``metrics=...``, the publish is gated on
+        ``monitor.should_publish(metrics)`` (the reference's
+        check-before-push-to-serving discipline)."""
+        self.root = publish_root
+        self.fs = fs or resolve_fs(publish_root)
+        self.verify = verify
+        self.monitor = monitor
+        self.staging = staging_dir or os.path.join(
+            tempfile.gettempdir(), f"pbox-publish-{os.getpid()}"
+        )
+        os.makedirs(self.staging, exist_ok=True)
+        self._donefile_local = os.path.join(self.staging, DONEFILE_NAME)
+        self._export_kw: Optional[dict] = None  # remembered at publish_base
+        self._entries = self._resume()
+
+    # -- state -------------------------------------------------------------- #
+    def _resume(self) -> list:
+        """Adopt an existing publish root's donefile (restart safety: the
+        sequence numbering and chain linkage continue, never restart)."""
+        remote = os.path.join(self.root, DONEFILE_NAME)
+        entries: list = []
+        try:
+            if self.fs.exists(remote):
+                entries = parse_donefile(self.fs.cat(remote))
+        except Exception as e:  # a fresh root is the common case
+            logger.warning("publish root donefile unreadable (%s); "
+                           "starting fresh", e)
+        with open(self._donefile_local, "w") as fh:
+            for e in entries:
+                fh.write(e.to_json() + "\n")
+        return entries
+
+    @property
+    def next_seq(self) -> int:
+        return self._entries[-1].seq + 1 if self._entries else 0
+
+    @property
+    def last_tag(self) -> Optional[str]:
+        return self._entries[-1].tag if self._entries else None
+
+    @property
+    def base_tag(self) -> Optional[str]:
+        for e in reversed(self._entries):
+            if e.kind == "base":
+                return e.tag
+        return None
+
+    def entries(self) -> list:
+        return list(self._entries)
+
+    # -- gate --------------------------------------------------------------- #
+    def _gated(self, metrics: Optional[dict]) -> bool:
+        if metrics is None or self.monitor is None:
+            return False
+        if self.monitor.should_publish(metrics):
+            return False
+        _GATED.inc()
+        logger.warning("publish gate held the model back")
+        return True
+
+    # -- publish ------------------------------------------------------------ #
+    def publish_base(
+        self,
+        tag: str,
+        model,
+        params,
+        table,
+        *,
+        batch_size: int,
+        key_capacity: int,
+        dense_dim: int,
+        feed_conf=None,
+        quantize: bool = False,
+        rank_offset_cols: int = 0,
+        batch_buckets=None,
+        metrics: Optional[dict] = None,
+        meta: Optional[dict] = None,
+    ) -> Optional[PublishEntry]:
+        """Export + publish a full serving artifact; restarts the delta
+        chain.  Returns the donefile entry, or None when the health gate
+        held it back."""
+        if self._gated(metrics):
+            return None
+        from paddlebox_tpu.inference.export import export_model
+
+        with telemetry.span("publish.base", tag=tag), \
+                _PUBLISH_SECONDS.time(kind="base"):
+            local = os.path.join(self.staging, f"base-{tag}")
+            if os.path.exists(local):
+                shutil.rmtree(local)
+            export_model(
+                model, params, table, local,
+                batch_size=batch_size, key_capacity=key_capacity,
+                dense_dim=dense_dim, quantize=quantize,
+                rank_offset_cols=rank_offset_cols,
+                batch_buckets=batch_buckets, feed_conf=feed_conf,
+            )
+            write_manifest(local, "manifest.json", recursive=True)
+            self._upload(local, f"base-{tag}", site="publish.upload")
+            self._export_kw = {
+                "batch_size": batch_size, "key_capacity": key_capacity,
+                "dense_dim": dense_dim, "row_width": table.conf.row_width,
+                "rank_offset_cols": rank_offset_cols,
+                "batch_buckets": batch_buckets, "feed_conf": feed_conf,
+            }
+            entry = PublishEntry(
+                seq=self.next_seq, kind="base", tag=tag, dir=f"base-{tag}",
+                base_tag=tag, prev_tag=self.last_tag,
+                published_at=time.time(), n_rows=int(table.n_features),
+                has_programs=True, meta=dict(meta or {}),
+            )
+            self._append_donefile(entry)
+            # a new base anchors a fresh chain: rows tracked so far are
+            # inside the full snapshot — clear only once the entry is
+            # VISIBLE (donefile landed); any earlier and a failed publish
+            # would drop rows from the chain
+            table.clear_delta()
+            _PUBLISHED.inc(kind="base")
+            return entry
+
+    def publish_delta(
+        self,
+        tag: str,
+        table,
+        model=None,
+        params=None,
+        *,
+        metrics: Optional[dict] = None,
+        meta: Optional[dict] = None,
+        **export_overrides,
+    ) -> Optional[PublishEntry]:
+        """Publish the rows touched since the last publish, plus (with
+        model+params) re-frozen serving programs so dense updates ship
+        too.  The export shapes default to the ones remembered from this
+        publisher's publish_base; pass overrides to change them.
+
+        The delta tracker is only cleared after the verified upload and
+        donefile append — a failed publish leaves the rows tracked, and
+        the next publish ships them again (at-least-once delivery of
+        every touched row)."""
+        if self._gated(metrics):
+            return None
+        if self.base_tag is None:
+            raise PublishError(
+                "publish_base first: a delta chain needs a base anchor"
+            )
+        with_programs = model is not None and params is not None
+        if with_programs:
+            if self._export_kw is None and not export_overrides:
+                raise PublishError(
+                    "no export shapes on record (publisher resumed without "
+                    "a publish_base): pass batch_size/key_capacity/"
+                    "dense_dim explicitly"
+                )
+            kw = {**(self._export_kw or {}), **export_overrides}
+        with telemetry.span("publish.delta", tag=tag), \
+                _PUBLISH_SECONDS.time(kind="delta"):
+            from paddlebox_tpu.inference.export import (
+                export_serving_programs,
+            )
+
+            state = table.delta_state_dict()
+            w = table.conf.row_width
+            keys = np.asarray(state["keys"], dtype=np.uint64)
+            values = np.asarray(state["values"], dtype=np.float32)[:, :w]
+            local = os.path.join(self.staging, f"delta-{tag}")
+            if os.path.exists(local):
+                shutil.rmtree(local)
+            os.makedirs(local)
+            np.savez(os.path.join(local, DELTA_ROWS_NAME),
+                     keys=keys, values=values)
+            buckets = []
+            if with_programs:
+                buckets = export_serving_programs(
+                    model, params, local,
+                    batch_size=kw["batch_size"],
+                    key_capacity=kw["key_capacity"],
+                    dense_dim=kw["dense_dim"],
+                    row_width=kw.get("row_width", w),
+                    rank_offset_cols=kw.get("rank_offset_cols", 0),
+                    batch_buckets=kw.get("batch_buckets"),
+                    feed_conf=kw.get("feed_conf"),
+                )
+            entry = PublishEntry(
+                seq=self.next_seq, kind="delta", tag=tag,
+                dir=f"delta-{tag}", base_tag=self.base_tag,
+                prev_tag=self.last_tag, published_at=time.time(),
+                n_rows=int(keys.shape[0]), has_programs=bool(buckets),
+                meta=dict(meta or {}),
+            )
+            with open(os.path.join(local, DELTA_META_NAME), "w") as fh:
+                json.dump({
+                    "kind": "delta", "tag": tag, "seq": entry.seq,
+                    "base_tag": entry.base_tag, "prev_tag": entry.prev_tag,
+                    "row_width": w, "n_rows": entry.n_rows,
+                    "buckets": buckets, "published_at": entry.published_at,
+                }, fh)
+            write_manifest(local, "manifest.json", recursive=True)
+            self._upload(local, f"delta-{tag}", site="publish.delta")
+            self._append_donefile(entry)
+            table.clear_delta()  # only once the entry is visible
+            _PUBLISHED.inc(kind="delta")
+            return entry
+
+    # -- transport ---------------------------------------------------------- #
+    def _upload(self, local: str, basename: str, site: str) -> None:
+        dest = os.path.join(self.root, basename)
+        retry_call(self.fs.mkdir, self.root, site="publish.mkdir")
+
+        def upload_once():
+            faults.inject(site)
+            self.fs.upload(local, dest)
+            if self.verify:
+                # re-read THROUGH the remote fs: a partial/corrupt upload
+                # fails this attempt and the retry re-uploads
+                verify_checkpoint_dir(dest, fs=self.fs)
+
+        retry_call(upload_once, site=site)
+
+    def _append_donefile(self, entry: PublishEntry) -> None:
+        """Append locally, then upload the whole donefile — LAST, after
+        the entry's data landed and verified (fleet_util's
+        write_model_donefile discipline)."""
+        with open(self._donefile_local, "a") as fh:
+            fh.write(entry.to_json() + "\n")
+
+        def upload_donefile():
+            faults.inject("publish.donefile")
+            self.fs.upload(
+                self._donefile_local, os.path.join(self.root, DONEFILE_NAME)
+            )
+
+        try:
+            retry_call(upload_donefile, site="publish.donefile")
+        except BaseException:
+            # the donefile never landed: un-append so local state mirrors
+            # what consumers can actually see
+            with open(self._donefile_local) as fh:
+                lines = fh.readlines()
+            with open(self._donefile_local, "w") as fh:
+                fh.writelines(lines[:-1])
+            raise
+        self._entries.append(entry)

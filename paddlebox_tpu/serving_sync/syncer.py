@@ -1,0 +1,405 @@
+"""Server-side sync agent: donefile polling, delta hot-apply, fallback.
+
+The read half of the delivery plane.  A :class:`Syncer` watches one
+publish root (written by :class:`~paddlebox_tpu.serving_sync.publisher.
+Publisher`) on behalf of one model name in a live
+:class:`~paddlebox_tpu.inference.server.ScoringServer` and keeps it
+minutes-fresh:
+
+  * **poll** — read the donefile (retried, fault site ``sync.poll``),
+    parse entries, pick up everything newer than the last applied
+    sequence number;
+  * **apply** — fetch the entry dir into a local cache (site
+    ``sync.fetch``), verify its integrity manifest (REQUIRED here: a
+    delivery artifact without a manifest is refused, unlike legacy
+    checkpoints' fail-open), then hot-apply: a base becomes a fresh
+    ``Predictor``; a delta merges its rows into a build-aside COPY of the
+    live predictor's sorted key/value arrays
+    (``Predictor.with_delta`` — existing rows replaced, genuinely-new
+    keys inserted, sort invariant preserved) and the finished object
+    swaps in atomically (``server.swap_model``).  In-flight scores
+    pinned the old predictor and finish on it — no request is ever
+    blocked or served a half-applied model;
+  * **fall back** — a delta that fails verification/apply, or whose
+    chain linkage does not extend the live version (wrong base, wrong
+    predecessor, sequence gap), triggers a FULL RELOAD from the newest
+    base that works (``sync.full_reload_fallback``).  If no base can be
+    loaded either, the last-good version keeps serving and the next poll
+    retries.  ``rollback()`` restores the previous registry version on
+    demand (the operator rung of the ladder).
+
+Freshness is exported continuously: ``serve.model_age_seconds`` (gauge),
+``sync.lag_passes`` (donefile entries not yet applied),
+``sync.apply_seconds`` (histogram by kind) and counters for every
+fallback/corruption path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from paddlebox_tpu import telemetry
+from paddlebox_tpu.checkpoint import CheckpointCorrupt, verify_checkpoint_dir
+from paddlebox_tpu.config import flags
+from paddlebox_tpu.serving_sync.publisher import (
+    DELTA_META_NAME,
+    DELTA_ROWS_NAME,
+)
+from paddlebox_tpu.serving_sync.registry import (
+    DONEFILE_NAME,
+    DeliveryChainError,
+    ModelRegistry,
+    ModelVersion,
+    PublishEntry,
+    parse_donefile,
+)
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.fs import resolve_fs
+from paddlebox_tpu.utils.retry import retry_call
+
+logger = logging.getLogger(__name__)
+
+_APPLY_SECONDS = telemetry.histogram(
+    "sync.apply_seconds",
+    help="syncer apply wall time (s) by kind (base/delta)",
+)
+_APPLIED = telemetry.counter(
+    "sync.applied", help="model versions applied by the syncer, by kind"
+)
+_LAG = telemetry.gauge(
+    "sync.lag_passes",
+    help="published donefile entries not yet applied to the live model",
+)
+_MODEL_AGE = telemetry.gauge(
+    "serve.model_age_seconds",
+    help="seconds since the serving model's current version was published",
+)
+_FULL_RELOAD = telemetry.counter(
+    "sync.full_reload_fallback",
+    help="delta-chain failures that fell back to a full base reload",
+)
+_APPLY_FAILURES = telemetry.counter(
+    "sync.apply_failures", help="entry applies that raised, by kind"
+)
+_CHAIN_GAP = telemetry.counter(
+    "sync.chain_gap",
+    help="delta entries rejected for not extending the live chain",
+)
+_RELOAD_FAILED = telemetry.counter(
+    "sync.reload_failed",
+    help="full reloads that could not produce any model (last-good kept)",
+)
+_POLL_ERRORS = telemetry.counter(
+    "sync.poll_errors", help="syncer poll loops that raised"
+)
+
+
+class Syncer:
+    def __init__(
+        self,
+        publish_root: str,
+        server,
+        model_name: str = "live",
+        *,
+        fs=None,
+        cache_dir: Optional[str] = None,
+        feed_conf=None,
+        poll_interval_s: Optional[float] = None,
+        registry: Optional[ModelRegistry] = None,
+        keep_versions: int = 3,
+    ):
+        """feed_conf: parser config for the served model; None reads the
+        base artifact's own feed.json (export_model(feed_conf=...))."""
+        self.root = publish_root
+        self.fs = fs or resolve_fs(publish_root)
+        self.server = server
+        self.name = model_name
+        self.feed_conf = feed_conf
+        self.poll_interval_s = (
+            poll_interval_s
+            if poll_interval_s is not None
+            else flags.sync_interval_s
+        )
+        self.cache = cache_dir or os.path.join(
+            tempfile.gettempdir(), f"pbox-sync-{os.getpid()}-{model_name}"
+        )
+        os.makedirs(self.cache, exist_ok=True)
+        self.registry = registry or ModelRegistry(keep_versions=keep_versions)
+        self._applied_seq = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- poll --------------------------------------------------------------- #
+    def _read_entries(self) -> List[PublishEntry]:
+        donefile = os.path.join(self.root, DONEFILE_NAME)
+
+        def cat():
+            faults.inject("sync.poll")
+            if not self.fs.exists(donefile):
+                return b""
+            return self.fs.cat(donefile)
+
+        return parse_donefile(retry_call(cat, site="sync.poll"))
+
+    def poll_once(self) -> int:
+        """One discovery+apply tick; returns how many donefile entries
+        the live model advanced by (0 = already fresh)."""
+        entries = self._read_entries()
+        before = self._applied_seq
+        pending = [e for e in entries if e.seq > self._applied_seq]
+        for entry in pending:
+            if entry.seq <= self._applied_seq:
+                continue  # a full reload already advanced past it
+            try:
+                with telemetry.span(f"sync.apply.{entry.kind}",
+                                    tag=entry.tag):
+                    self._apply_entry(entry)
+            except DeliveryChainError as e:
+                logger.warning("sync chain break at seq %d (%s): %s",
+                               entry.seq, entry.tag, e)
+                _CHAIN_GAP.inc()
+                self._full_reload(entries)
+                break
+            except Exception as e:
+                logger.warning("sync apply failed at seq %d (%s): %r",
+                               entry.seq, entry.tag, e)
+                _APPLY_FAILURES.inc(kind=entry.kind)
+                self._full_reload(entries)
+                break
+        self._update_gauges(entries)
+        return self._applied_seq - before
+
+    def _update_gauges(self, entries: List[PublishEntry]) -> None:
+        newest = entries[-1].seq if entries else self._applied_seq
+        _LAG.set(max(0, newest - self._applied_seq), model=self.name)
+        version = self.registry.current_version(self.name)
+        if version is not None:
+            _MODEL_AGE.set(
+                max(0.0, time.time() - version.published_at),
+                model=self.name,
+            )
+
+    # -- apply -------------------------------------------------------------- #
+    def _apply_entry(self, entry: PublishEntry) -> None:
+        faults.inject("sync.apply")
+        with _APPLY_SECONDS.time(kind=entry.kind):
+            if entry.kind == "base":
+                self._apply_base(entry)
+            else:
+                self._check_chain(entry)
+                self._apply_delta(entry)
+
+    def _check_chain(self, entry: PublishEntry) -> None:
+        current = self.registry.current_version(self.name)
+        if current is None:
+            raise DeliveryChainError(
+                f"delta {entry.tag} arrived before any base"
+            )
+        if entry.base_tag != current.base_tag:
+            raise DeliveryChainError(
+                f"delta {entry.tag} anchors base {entry.base_tag!r}, "
+                f"live chain stands on {current.base_tag!r}"
+            )
+        if entry.prev_tag != current.tag:
+            raise DeliveryChainError(
+                f"delta {entry.tag} follows {entry.prev_tag!r}, live chain "
+                f"head is {current.tag!r}"
+            )
+        if entry.seq != current.seq + 1:
+            raise DeliveryChainError(
+                f"sequence gap: delta {entry.tag} is seq {entry.seq}, live "
+                f"chain head is seq {current.seq}"
+            )
+
+    def _apply_base(self, entry: PublishEntry) -> None:
+        from paddlebox_tpu.inference.predictor import Predictor
+
+        local = self._fetch(entry)
+        predictor = Predictor.load(local)
+        feed_conf = self.feed_conf
+        if feed_conf is None:
+            path = os.path.join(local, "feed.json")
+            if os.path.exists(path):
+                from paddlebox_tpu.config import DataFeedConfig
+
+                with open(path) as fh:
+                    feed_conf = DataFeedConfig.from_dict(json.load(fh))
+            else:
+                raise CheckpointCorrupt(
+                    f"base {entry.tag}: no feed.json in the artifact and "
+                    "no feed_conf configured on the syncer"
+                )
+        version = ModelVersion(
+            name=self.name, base_tag=entry.tag, seq=entry.seq,
+            published_at=entry.published_at, applied_at=time.time(),
+        )
+        self._install(version, predictor, feed_conf=feed_conf)
+        _APPLIED.inc(kind="base")
+
+    def _apply_delta(self, entry: PublishEntry) -> None:
+        current = self.registry.current(self.name)
+        assert current is not None  # _check_chain guaranteed it
+        version, predictor = current
+        local = self._fetch(entry)
+        with open(os.path.join(local, DELTA_META_NAME)) as fh:
+            dmeta = json.load(fh)
+        w = int(predictor.meta["row_width"])
+        if int(dmeta.get("row_width", w)) != w:
+            raise CheckpointCorrupt(
+                f"delta {entry.tag}: row_width {dmeta.get('row_width')} != "
+                f"live artifact {w}"
+            )
+        with np.load(os.path.join(local, DELTA_ROWS_NAME)) as d:
+            keys, values = d["keys"], d["values"]
+        buckets = dmeta.get("buckets") or []
+        new_predictor = predictor.with_delta(
+            keys, values,
+            program_dir=local if buckets else None,
+            bucket_meta=buckets or None,
+        )
+        self._install(version.extend(entry), new_predictor)
+        _APPLIED.inc(kind="delta")
+
+    def _install(self, version: ModelVersion, predictor,
+                 feed_conf=None) -> None:
+        """Commit to the registry, then swap into the live server — both
+        atomic; the server-side swap is one pointer write under its
+        registry lock (in-flight scores keep their pinned predictor)."""
+        self.registry.commit(self.name, version, predictor)
+        lineage = version.lineage()
+        if self.name in self.server.model_names():
+            self.server.swap_model(self.name, predictor, version=lineage)
+        else:
+            if feed_conf is None:
+                raise CheckpointCorrupt(
+                    f"model {self.name!r} not registered and no feed "
+                    "schema available to register it"
+                )
+            self.server.register_predictor(
+                self.name, predictor, feed_conf, version=lineage
+            )
+        self._applied_seq = version.seq
+
+    # -- fetch -------------------------------------------------------------- #
+    def _fetch(self, entry: PublishEntry) -> str:
+        """Download an entry dir into the local cache and verify its
+        integrity manifest — which must EXIST: delivery artifacts are
+        always published with one, so its absence is corruption here,
+        not legacy."""
+        dest = os.path.join(self.cache, entry.dir)
+
+        def fetch_once():
+            faults.inject("sync.fetch")
+            if os.path.exists(dest):
+                shutil.rmtree(dest)  # stale/partial cache: refetch whole
+            self.fs.download(os.path.join(self.root, entry.dir), dest)
+            if not os.path.exists(os.path.join(dest, "manifest.json")):
+                raise CheckpointCorrupt(
+                    f"{entry.dir}: published without an integrity manifest"
+                )
+            verify_checkpoint_dir(dest)
+
+        retry_call(fetch_once, site="sync.fetch")
+        return dest
+
+    # -- fallback ladder ---------------------------------------------------- #
+    def _full_reload(self, entries: List[PublishEntry]) -> None:
+        """Rebuild from scratch: newest base that loads, plus every delta
+        that chains onto it.  Applies as far as the chain verifies and
+        keeps the result even when partial (still at least as fresh as
+        before); when NO base loads, the last-good version keeps serving
+        and the next poll retries."""
+        _FULL_RELOAD.inc()
+        bases = [e for e in entries if e.kind == "base"]
+        for base in reversed(bases):
+            try:
+                with _APPLY_SECONDS.time(kind="base"):
+                    self._apply_base(base)
+            except Exception as e:
+                logger.warning("full reload: base %s unusable: %r",
+                               base.tag, e)
+                continue
+            prev = base.tag
+            seq = base.seq
+            for d in entries:
+                if d.seq <= base.seq or d.kind != "delta":
+                    continue
+                if d.base_tag != base.tag or d.prev_tag != prev \
+                        or d.seq != seq + 1:
+                    break  # chain ends here; anything later is unreachable
+                try:
+                    with _APPLY_SECONDS.time(kind="delta"):
+                        self._apply_delta(d)
+                except Exception as e:
+                    logger.warning(
+                        "full reload: delta %s unusable (%r); serving "
+                        "chain up to %s", d.tag, e, prev,
+                    )
+                    break
+                prev, seq = d.tag, d.seq
+            return
+        logger.error(
+            "full reload found no loadable base under %s; keeping the "
+            "last-good model", self.root,
+        )
+        _RELOAD_FAILED.inc()
+
+    def rollback(self) -> ModelVersion:
+        """Swap the previous registry version back into the live server
+        (the operator rung of the fallback ladder).  Returns the restored
+        version; LookupError when there is no previous version."""
+        version, predictor = self.registry.rollback(self.name)
+        self.server.swap_model(self.name, predictor,
+                               version=version.lineage())
+        self._applied_seq = version.seq
+        return version
+
+    # -- background agent ---------------------------------------------------- #
+    def start(self) -> None:
+        """Run the poll loop on a daemon thread until stop()."""
+        if self._thread is not None:
+            raise RuntimeError("syncer already started")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:
+                    _POLL_ERRORS.inc()
+                    logger.exception("sync poll failed; retrying")
+                self._stop.wait(self.poll_interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name=f"model-syncer-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+
+    def wait_fresh(self, timeout_s: float = 60.0) -> bool:
+        """Block until at least one version is live (serve.py's startup
+        gate: the HTTP server cannot start with zero models)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.registry.current(self.name) is not None:
+                return True
+            if self._thread is None:
+                self.poll_once()
+                if self.registry.current(self.name) is not None:
+                    return True
+            time.sleep(min(1.0, self.poll_interval_s))
+        return self.registry.current(self.name) is not None

@@ -40,6 +40,7 @@ reached.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import random
 import threading
 import zlib
@@ -51,6 +52,40 @@ from paddlebox_tpu.utils.retry import register_retryable
 
 class FaultInjected(RuntimeError):
     """Raised by an injection site the active plan told to fail."""
+
+
+# --------------------------------------------------------------------------- #
+# Canonical site catalog.  Every inject()/fire() call-site registers its
+# name here (module import time, via register_site, or in this seed list)
+# so chaos plans can be sanity-checked against a typo-proof list: a plan
+# naming an unknown non-wildcard site logs a warning instead of silently
+# never firing.  Wildcard specs ("fs.*") are matched by prefix as before
+# and need no registration.
+# --------------------------------------------------------------------------- #
+KNOWN_SITES = {
+    # filesystem surface (LocalFS per-op + HadoopFS per-command)
+    "fs.ls", "fs.exists", "fs.mkdir", "fs.upload", "fs.download", "fs.rm",
+    "fs.touch", "fs.cat", "fs.put", "fs.get", "fs.test", "fs.touchz",
+    # data + checkpoint paths
+    "data.read", "ckpt.save", "ckpt.load",
+    # checkpoint/model publishing (utils/fs + serving_sync/publisher)
+    "publish.mkdir", "publish.upload", "publish.donefile", "publish.delta",
+    # training + distributed plane
+    "train.nan", "train.step", "hostplane.allgather", "shuffle.exchange",
+    "shuffle.connect", "watchdog.heartbeat",
+    # online model delivery (serving_sync/syncer)
+    "sync.poll", "sync.fetch", "sync.apply",
+}
+
+
+def register_site(name: str) -> None:
+    """Add a site name to the catalog (for sites defined outside this
+    package, e.g. embedder code instrumenting its own paths)."""
+    KNOWN_SITES.add(name)
+
+
+def known_sites() -> frozenset:
+    return frozenset(KNOWN_SITES)
 
 
 # injected faults model transient infrastructure failures: retry loops
@@ -95,6 +130,13 @@ class FaultPlan:
             name: spec if isinstance(spec, FaultSpec) else FaultSpec.parse(spec)
             for name, spec in sites.items()
         }
+        for name in self.sites:
+            if not name.endswith("*") and name not in KNOWN_SITES:
+                logging.getLogger(__name__).warning(
+                    "fault plan names unknown site %r (known sites: "
+                    "utils.faults.KNOWN_SITES) — it will never fire unless "
+                    "some inject() call uses that name", name,
+                )
         self._lock = threading.Lock()
         self._hits: Dict[str, int] = {}
         self._rngs: Dict[str, random.Random] = {}

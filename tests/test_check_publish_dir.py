@@ -1,0 +1,130 @@
+"""tools/check_publish_dir.py: publish-root donefile/manifest lint."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_publish_dir import check_publish_root, main  # noqa: E402
+
+from paddlebox_tpu.serving_sync import DONEFILE_NAME, PublishEntry  # noqa: E402
+
+
+def _write_unit(root, entry, payload=b"payload"):
+    """A minimal publish unit: one data file + a valid recursive manifest."""
+    from paddlebox_tpu.checkpoint import write_manifest
+
+    d = os.path.join(root, entry.dir)
+    os.makedirs(os.path.join(d, "sparse"), exist_ok=True)
+    with open(os.path.join(d, "sparse", "rows.npy"), "wb") as fh:
+        fh.write(payload)
+    write_manifest(d, "manifest.json", recursive=True)
+
+
+def _write_root(tmp_path, entries):
+    root = str(tmp_path / "pub")
+    os.makedirs(root, exist_ok=True)
+    for e in entries:
+        _write_unit(root, e)
+    with open(os.path.join(root, DONEFILE_NAME), "w") as fh:
+        for e in entries:
+            fh.write(e.to_json() + "\n")
+    return root
+
+
+def _entries():
+    return [
+        PublishEntry(seq=0, kind="base", tag="t0", dir="base-t0",
+                     base_tag="t0", prev_tag=None, published_at=1.0),
+        PublishEntry(seq=1, kind="delta", tag="t1", dir="delta-t1",
+                     base_tag="t0", prev_tag="t0", published_at=2.0),
+        PublishEntry(seq=2, kind="delta", tag="t2", dir="delta-t2",
+                     base_tag="t0", prev_tag="t1", published_at=3.0),
+    ]
+
+
+def test_clean_root_passes(tmp_path, capsys):
+    root = _write_root(tmp_path, _entries())
+    errors, warnings = check_publish_root(root)
+    assert errors == [] and warnings == []
+    assert main([root]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_missing_manifest_and_dir(tmp_path):
+    root = _write_root(tmp_path, _entries())
+    os.remove(os.path.join(root, "delta-t1", "manifest.json"))
+    import shutil
+
+    shutil.rmtree(os.path.join(root, "delta-t2"))
+    errors, _ = check_publish_root(root)
+    assert any("no integrity manifest" in e for e in errors)
+    assert any("missing from the root" in e for e in errors)
+    assert main([root]) == 1
+
+
+def test_corrupt_payload_fails_manifest(tmp_path):
+    root = _write_root(tmp_path, _entries())
+    with open(os.path.join(root, "delta-t1", "sparse", "rows.npy"),
+              "wb") as fh:
+        fh.write(b"corrupted!!")
+    errors, _ = check_publish_root(root)
+    assert any("delta-t1" in e for e in errors)
+
+
+def test_out_of_order_seq_and_broken_chain(tmp_path):
+    e0, e1, e2 = _entries()
+    import dataclasses
+
+    # seq jumps 0 -> 2 and t2 claims prev t1 which is absent
+    root = _write_root(tmp_path, [e0, dataclasses.replace(e2, seq=2)])
+    errors, _ = check_publish_root(root)
+    assert any("out-of-order sequence" in e for e in errors)
+    assert any("broken chain" in e for e in errors)
+
+
+def test_delta_anchoring_unknown_base(tmp_path):
+    e0, e1, _ = _entries()
+    import dataclasses
+
+    bad = dataclasses.replace(e1, base_tag="never-published")
+    root = _write_root(tmp_path, [e0, bad])
+    errors, _ = check_publish_root(root)
+    assert any("no earlier donefile entry published" in e for e in errors)
+
+
+def test_orphan_dir_warns_and_strict_fails(tmp_path):
+    root = _write_root(tmp_path, _entries())
+    _write_unit(root, PublishEntry(
+        seq=9, kind="delta", tag="t9", dir="delta-t9", base_tag="t0",
+        prev_tag="t2", published_at=9.0))  # uploaded, never donefiled
+    errors, warnings = check_publish_root(root)
+    assert errors == []
+    assert any("orphan" in w for w in warnings)
+    assert main([root]) == 0
+    assert main([root, "--strict"]) == 1
+
+
+def test_torn_tail_warns_corruption_fails(tmp_path):
+    root = _write_root(tmp_path, _entries())
+    done = os.path.join(root, DONEFILE_NAME)
+    with open(done, "a") as fh:
+        fh.write('{"seq": 3, "kind": "del')  # torn append
+    errors, warnings = check_publish_root(root)
+    assert errors == [] and any("torn" in w for w in warnings)
+    # garbage mid-file is corruption
+    with open(done) as fh:
+        lines = fh.read().splitlines()
+    lines[1] = "garbage line"
+    with open(done, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    errors, _ = check_publish_root(root)
+    assert errors and "unparsable" in errors[0]
+
+
+def test_no_donefile_is_an_error(tmp_path):
+    root = str(tmp_path / "empty")
+    os.makedirs(root)
+    errors, _ = check_publish_root(root)
+    assert errors and DONEFILE_NAME in errors[0]

@@ -389,6 +389,94 @@ def test_stop_drain_deadline_counts_and_closes():
     srv._end_request()  # late finish after close: no crash
 
 
+# --------------------------------------------------------------------------- #
+# hot swap: replacing a live model must be atomic w.r.t. in-flight scoring
+# --------------------------------------------------------------------------- #
+def test_register_replace_hot_swap_atomic_under_load(tmp_path):
+    """Re-registering a name (and swap_model) while requests are in
+    flight: every response must be EXACTLY the old model's scores or the
+    new model's — a request that mixed the two predictors (e.g. old
+    bucket ladder + new programs) would produce a third sequence."""
+    import threading
+
+    conf_a, art_a = _train_and_export(tmp_path, "a", seed=1)
+    conf_b, art_b = _train_and_export(tmp_path, "b", seed=2)
+    from paddlebox_tpu.inference.predictor import Predictor
+
+    pred_a, pred_b = Predictor.load(art_a), Predictor.load(art_b)
+    srv = ScoringServer()
+    srv.register("m", art_a, conf_a)
+    body = _lines(23)  # several chunks: exercises the per-request pinning
+    want_a = srv.score_lines(body, "m")
+    srv.swap_model("m", pred_b)
+    want_b = srv.score_lines(body, "m")
+    assert want_a != want_b
+    srv.swap_model("m", pred_a)
+
+    bad, stop = [], threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            got = srv.score_lines(body, "m")
+            if got != want_a and got != want_b:
+                bad.append(got)
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(30):
+            srv.swap_model("m", pred_b if i % 2 == 0 else pred_a)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not bad  # no request observed a half-swapped model
+
+
+def test_register_replace_preserves_counters_and_default(tmp_path):
+    conf_a, art_a = _train_and_export(tmp_path, "a", seed=1)
+    conf_b, art_b = _train_and_export(tmp_path, "b", seed=2)
+    srv = ScoringServer()
+    srv.register("m", art_a, conf_a)
+    srv.register("other", art_b, conf_b)
+    srv.score_lines(_lines(4), "m")
+    assert srv._models["m"].requests == 1
+    srv.register("m", art_b, conf_b)  # hot replace
+    assert srv._default == "m"
+    assert srv._models["m"].requests == 1  # serving history carries over
+    assert srv._models["m"].instances == 4
+    # swap_model on an unknown name refuses (a delta cannot create models)
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError):
+        srv.swap_model("nope", srv._models["m"].predictor)
+
+
+def test_models_endpoint_reports_lineage_and_age(server):
+    """GET /models carries per-model version lineage + freshness age and
+    refreshes the serve.model_age_seconds gauge."""
+    from paddlebox_tpu import telemetry
+
+    srv, port = server
+    srv.swap_model("a", srv._models["a"].predictor, version={
+        "base_tag": "day0", "tag": "day0-p3", "deltas_applied": 3,
+        "seq": 3, "published_at": 123.0,
+    })
+    st, m = _get(port, "/models")
+    assert st == 200 and m["default"] == "a"
+    a = m["models"]["a"]
+    assert a["base_tag"] == "day0" and a["deltas_applied"] == 3
+    assert a["tag"] == "day0-p3" and a["seq"] == 3
+    assert a["age_seconds"] > 0
+    # a directly-registered model still reports (load-time freshness)
+    b = m["models"]["b"]
+    assert b["base_tag"] is None and b["deltas_applied"] == 0
+    assert b["age_seconds"] >= 0
+    gauge = telemetry.gauge("serve.model_age_seconds")
+    assert gauge.value(model="a") == a["age_seconds"]
+
+
 def test_draining_rejects_new_requests():
     from paddlebox_tpu.inference.server import ScoringServer
 

@@ -1,0 +1,474 @@
+"""Online model delivery plane (serving_sync/): publish layout +
+donefile-last discipline, syncer delta hot-apply bit-exactness, the
+fallback ladder (chain gap / corruption -> full reload -> last-good),
+versioned registry lineage + rollback, freshness telemetry."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import telemetry
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.inference import Predictor, ScoringServer, export_model
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.serving_sync import (
+    DONEFILE_NAME,
+    ModelRegistry,
+    ModelVersion,
+    Publisher,
+    PublishError,
+    PublishEntry,
+    Syncer,
+    parse_donefile,
+)
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train.trainer import Trainer
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.faults import fault_plan
+
+S, DENSE, B = 3, 2, 8
+KCAP = B * 8
+
+
+class _Job:
+    """A tiny trainable CTR job whose table/params evolve per pass —
+    the trainer side of the delivery plane under test."""
+
+    def __init__(self, workdir, seed=0):
+        self.workdir = str(workdir)
+        self.conf = make_synth_config(
+            n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+            max_feasigns_per_ins=8,
+        )
+        self.tconf = SparseTableConfig(embedding_dim=4)
+        self.model = CtrDnn(S, self.tconf.row_width, dense_dim=DENSE,
+                            hidden=(8,))
+        self.table = SparseTable(self.tconf, seed=seed)
+        self.trainer = Trainer(self.model, self.tconf,
+                               TrainerConfig(auc_buckets=1 << 10), seed=seed)
+
+    def train_pass(self, i):
+        files = write_synth_files(
+            os.path.join(self.workdir, f"d{i}"), n_files=1, ins_per_file=32,
+            n_sparse_slots=S, vocab_per_slot=60, dense_dim=DENSE,
+            seed=100 + i,
+        )
+        ds = PadBoxSlotDataset(self.conf, read_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        self.table.begin_pass(ds.unique_keys())
+        self.trainer.train_from_dataset(ds, self.table)
+        self.table.end_pass()
+        ds.close()
+
+    def publisher(self, root, **kw):
+        return Publisher(
+            root, staging_dir=os.path.join(self.workdir, "stage"), **kw
+        )
+
+    def publish_base(self, pub, tag, **kw):
+        return pub.publish_base(
+            tag, self.model, self.trainer.params, self.table,
+            batch_size=B, key_capacity=KCAP, dense_dim=DENSE,
+            feed_conf=self.conf, **kw,
+        )
+
+    def fresh_artifact(self, out):
+        export_model(
+            self.model, self.trainer.params, self.table, out,
+            batch_size=B, key_capacity=KCAP, dense_dim=DENSE,
+            feed_conf=self.conf,
+        )
+        return out
+
+
+def _lines(n, seed=5, vocab=60):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        parts = ["1 0"]
+        for _s in range(S):
+            ks = rng.integers(0, vocab, 2)
+            parts.append(f"{len(ks)} " + " ".join(map(str, ks)))
+        parts.append(f"{DENSE} " + " ".join(
+            f"{v:.3f}" for v in rng.random(DENSE)))
+        out.append(" ".join(parts))
+    return ("\n".join(out) + "\n").encode()
+
+
+def _syncer(root, srv, tmp_path, **kw):
+    return Syncer(root, srv, "live",
+                  cache_dir=str(tmp_path / "cache"),
+                  poll_interval_s=0.05, **kw)
+
+
+# --------------------------------------------------------------------------- #
+# publisher: layout, donefile-last, failure atomicity
+# --------------------------------------------------------------------------- #
+def test_publish_layout_and_sequenced_donefile(tmp_path):
+    job = _Job(tmp_path)
+    root = str(tmp_path / "pub")
+    pub = job.publisher(root)
+    job.train_pass(0)
+    e0 = job.publish_base(pub, "p0")
+    job.train_pass(1)
+    e1 = pub.publish_delta("p1", job.table)  # sparse-only delta
+    assert (e0.seq, e0.kind, e0.base_tag) == (0, "base", "p0")
+    assert (e1.seq, e1.kind, e1.base_tag, e1.prev_tag) == (
+        1, "delta", "p0", "p0")
+    assert not e1.has_programs and e1.n_rows > 0
+    # layout: data dirs with manifests, donefile last
+    assert os.path.isdir(os.path.join(root, "base-p0", "sparse"))
+    assert os.path.exists(os.path.join(root, "base-p0", "manifest.json"))
+    assert os.path.exists(
+        os.path.join(root, "delta-p1", "sparse_delta.npz"))
+    assert os.path.exists(os.path.join(root, "delta-p1", "manifest.json"))
+    with open(os.path.join(root, DONEFILE_NAME), "rb") as fh:
+        entries = parse_donefile(fh.read())
+    assert [e.seq for e in entries] == [0, 1]
+    # the recursive artifact manifest really covers the sparse snapshot
+    with open(os.path.join(root, "base-p0", "manifest.json")) as fh:
+        files = json.load(fh)["files"]
+    assert any(name.startswith("sparse/") for name in files)
+
+    # resume: a new Publisher over the same root continues the sequence
+    pub2 = job.publisher(root)
+    assert pub2.next_seq == 2 and pub2.base_tag == "p0"
+    assert pub2.last_tag == "p1"
+
+
+def test_failed_delta_publish_keeps_tracker_and_donefile(tmp_path,
+                                                         monkeypatch):
+    """Donefile-last under injected upload failure: the failed delta never
+    becomes visible, its rows stay tracked, and the retried publish ships
+    them (at-least-once delivery of every touched row)."""
+    monkeypatch.setenv("PBOX_RETRY_MAX_ATTEMPTS", "1")
+    monkeypatch.setenv("PBOX_RETRY_BASE_DELAY_S", "0.01")
+    job = _Job(tmp_path)
+    root = str(tmp_path / "pub")
+    pub = job.publisher(root)
+    job.train_pass(0)
+    job.publish_base(pub, "p0")
+    job.train_pass(1)
+    n_tracked = job.table.delta_state_dict()["keys"].shape[0]
+    assert n_tracked > 0
+    with fault_plan({"publish.delta": "first:1"}):
+        with pytest.raises(faults.FaultInjected):
+            pub.publish_delta("p1", job.table)
+    # not visible, rows not lost
+    with open(os.path.join(root, DONEFILE_NAME), "rb") as fh:
+        assert len(parse_donefile(fh.read())) == 1
+    assert job.table.delta_state_dict()["keys"].shape[0] == n_tracked
+    # retry publishes the same rows under the next sequence number
+    e = pub.publish_delta("p1", job.table)
+    assert e.seq == 1 and e.n_rows == n_tracked
+    assert job.table.delta_state_dict()["keys"].shape[0] == 0
+
+
+def test_delta_without_base_refused(tmp_path):
+    job = _Job(tmp_path)
+    pub = job.publisher(str(tmp_path / "pub"))
+    job.train_pass(0)
+    with pytest.raises(PublishError, match="publish_base first"):
+        pub.publish_delta("p0", job.table)
+
+
+def test_publish_health_gate(tmp_path):
+    from paddlebox_tpu.utils.fleet_util import HealthPolicy, ModelMonitor
+
+    job = _Job(tmp_path)
+    pub = job.publisher(str(tmp_path / "pub"),
+                        monitor=ModelMonitor(HealthPolicy(min_auc=0.5)))
+    job.train_pass(0)
+    gated = telemetry.counter("publish.gated")
+    before = gated.value()
+    assert job.publish_base(pub, "p0", metrics={"auc": 0.2,
+                                                "loss": 0.5}) is None
+    assert gated.value() == before + 1
+    assert pub.next_seq == 0  # nothing shipped
+    assert job.publish_base(pub, "p0", metrics={"auc": 0.7,
+                                                "loss": 0.5}) is not None
+
+
+# --------------------------------------------------------------------------- #
+# syncer: bit-exact hot apply (the acceptance criterion, k = 3)
+# --------------------------------------------------------------------------- #
+def test_sync_base_plus_deltas_bit_exact(tmp_path):
+    """A server that applied base + 3 deltas scores IDENTICALLY to one
+    that loaded a full export at the same pass — and its resolved
+    key/value arrays are bit-equal to the fresh snapshot's."""
+    job = _Job(tmp_path)
+    root = str(tmp_path / "pub")
+    pub = job.publisher(root)
+    job.train_pass(0)
+    job.publish_base(pub, "p0")
+    base_features = job.table.n_features
+    for i in range(1, 4):
+        job.train_pass(i)
+        assert pub.publish_delta(
+            f"p{i}", job.table, job.model, job.trainer.params
+        ).has_programs
+
+    srv = ScoringServer()
+    sync = _syncer(root, srv, tmp_path)
+    assert sync.poll_once() == 4
+    version = sync.registry.current_version("live")
+    assert version.base_tag == "p0" and version.deltas_applied == 3
+    assert version.tag == "p3" and version.seq == 3
+
+    fresh = Predictor.load(job.fresh_artifact(str(tmp_path / "full")))
+    live = srv._models["live"].predictor
+    # the delta chain inserted genuinely-new keys, not just updates
+    assert live.n_features > base_features
+    np.testing.assert_array_equal(live._keys, fresh._keys)
+    np.testing.assert_array_equal(live._values, fresh._values)
+
+    body = _lines(23)  # multiple chunks
+    synced = srv.score_lines(body, "live")
+    srv2 = ScoringServer()
+    srv2.register("fresh", str(tmp_path / "full"))
+    assert synced == srv2.score_lines(body, "fresh")  # exact, not approx
+
+    # freshness telemetry: fully caught up, age measured from publish
+    assert telemetry.gauge("sync.lag_passes").value(model="live") == 0
+    assert telemetry.gauge(
+        "serve.model_age_seconds").value(model="live") >= 0.0
+    # a second poll with nothing new applies nothing
+    assert sync.poll_once() == 0
+
+
+def test_sparse_only_delta_updates_rows_keeps_programs(tmp_path):
+    """A delta published without model/params ships rows only: the live
+    predictor's sparse snapshot updates, the program objects are shared
+    with the previous version (dense intentionally stale)."""
+    job = _Job(tmp_path)
+    root = str(tmp_path / "pub")
+    pub = job.publisher(root)
+    job.train_pass(0)
+    job.publish_base(pub, "p0")
+    srv = ScoringServer()
+    sync = _syncer(root, srv, tmp_path)
+    sync.poll_once()
+    before = srv._models["live"].predictor
+    job.train_pass(1)
+    pub.publish_delta("p1", job.table)
+    assert sync.poll_once() == 1
+    after = srv._models["live"].predictor
+    assert after is not before  # build-aside, atomic swap
+    assert after._programs is before._programs  # shared program cache
+    assert not np.array_equal(after._values[: before.n_features],
+                              before._values)
+    # rows match the live table (full-row replace semantics)
+    state = job.table.state_dict()
+    w = job.tconf.row_width
+    np.testing.assert_array_equal(after._keys, state["keys"])
+    np.testing.assert_array_equal(
+        after._values, np.asarray(state["values"], np.float32)[:, :w])
+
+
+# --------------------------------------------------------------------------- #
+# fallback ladder
+# --------------------------------------------------------------------------- #
+def _corrupt(path):
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(path, "wb") as fh:
+        fh.write(bytes(data))
+
+
+def test_corrupt_delta_full_reload_no_failed_scores(tmp_path):
+    """The acceptance chaos path: a torn/corrupted delta (donefile entry
+    whose remote bytes are wrong) must trigger the full-reload fallback
+    (counter increments), keep serving the last-good chain, and fail ZERO
+    score requests while the syncer churns."""
+    job = _Job(tmp_path)
+    root = str(tmp_path / "pub")
+    pub = job.publisher(root)
+    job.train_pass(0)
+    job.publish_base(pub, "p0")
+    job.train_pass(1)
+    pub.publish_delta("p1", job.table, job.model, job.trainer.params)
+    srv = ScoringServer()
+    sync = _syncer(root, srv, tmp_path)
+    sync.poll_once()
+    good_keys = srv._models["live"].predictor._keys.copy()
+
+    job.train_pass(2)
+    pub.publish_delta("p2", job.table, job.model, job.trainer.params)
+    _corrupt(os.path.join(root, "delta-p2", "sparse_delta.npz"))
+
+    body = _lines(5)
+    want = srv.score_lines(body, "live")
+    failures, stop = [], threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                got = srv.score_lines(body, "live")
+                if len(got) != 5 or not all(0.0 < s < 1.0 for s in got):
+                    failures.append(got)
+            except Exception as e:  # any exception = a failed request
+                failures.append(e)
+
+    threads = [threading.Thread(target=hammer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    fallback = telemetry.counter("sync.full_reload_fallback")
+    base = fallback.value()
+    try:
+        advanced = sync.poll_once()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not failures  # zero failed requests during the churn
+    assert advanced == 0  # p2 unusable; chain held at p1
+    assert fallback.value() == base + 1
+    live = srv._models["live"].predictor
+    np.testing.assert_array_equal(live._keys, good_keys)
+    assert srv.score_lines(body, "live") == want
+    assert sync.registry.current_version("live").tag == "p1"
+    # lag telemetry names the unapplied entry
+    assert telemetry.gauge("sync.lag_passes").value(model="live") == 1
+
+    # repair (re-upload the staged copy) and the next poll catches up
+    from paddlebox_tpu.utils.fs import LocalFS
+
+    LocalFS().upload(os.path.join(str(tmp_path), "stage", "delta-p2"),
+                     os.path.join(root, "delta-p2"))
+    assert sync.poll_once() == 1
+    assert sync.registry.current_version("live").tag == "p2"
+
+
+def test_chain_gap_triggers_full_reload(tmp_path):
+    """A donefile whose chain skips an entry (gap) must full-reload from
+    the newest base instead of applying deltas out of order."""
+    job = _Job(tmp_path)
+    root = str(tmp_path / "pub")
+    pub = job.publisher(root)
+    job.train_pass(0)
+    job.publish_base(pub, "p0")
+    job.train_pass(1)
+    pub.publish_delta("p1", job.table, job.model, job.trainer.params)
+    job.train_pass(2)
+    pub.publish_delta("p2", job.table, job.model, job.trainer.params)
+    # doctor the donefile: drop p1's entry -> p2 no longer chains
+    done = os.path.join(root, DONEFILE_NAME)
+    with open(done, "rb") as fh:
+        entries = parse_donefile(fh.read())
+    with open(done, "w") as fh:
+        for e in entries:
+            if e.tag != "p1":
+                fh.write(e.to_json() + "\n")
+
+    srv = ScoringServer()
+    sync = _syncer(root, srv, tmp_path)
+    gaps = telemetry.counter("sync.chain_gap")
+    before = gaps.value()
+    sync.poll_once()
+    assert gaps.value() == before + 1
+    # the reload walks the (broken) chain as far as it links: base only
+    assert sync.registry.current_version("live").tag == "p0"
+    assert srv.score_lines(_lines(3), "live")  # still serving
+
+
+def test_injected_sync_faults_absorbed_and_counted(tmp_path, monkeypatch):
+    """The registered fault sites fire: sync.poll transients are absorbed
+    by the retry loop; a sync.apply fault falls back to full reload and
+    the delivery still converges (chaos spec for the new sites)."""
+    monkeypatch.setenv("PBOX_RETRY_BASE_DELAY_S", "0.01")
+    job = _Job(tmp_path)
+    root = str(tmp_path / "pub")
+    pub = job.publisher(root)
+    job.train_pass(0)
+    job.publish_base(pub, "p0")
+    srv = ScoringServer()
+    sync = _syncer(root, srv, tmp_path)
+    for site in ("sync.poll", "sync.apply", "publish.delta"):
+        assert site in faults.KNOWN_SITES
+    fallback = telemetry.counter("sync.full_reload_fallback")
+    base = fallback.value()
+    with fault_plan({"sync.poll": "first:1", "sync.apply": "first:1"}):
+        assert sync.poll_once() == 1  # converged despite both faults
+    assert fallback.value() == base + 1  # the apply fault took the ladder
+    from paddlebox_tpu.utils.monitor import stats
+
+    assert stats.get("retry.sync.poll.retries") >= 1
+    assert sync.registry.current_version("live").base_tag == "p0"
+
+
+def test_rollback_restores_previous_version(tmp_path):
+    job = _Job(tmp_path)
+    root = str(tmp_path / "pub")
+    pub = job.publisher(root)
+    job.train_pass(0)
+    job.publish_base(pub, "p0")
+    srv = ScoringServer()
+    sync = _syncer(root, srv, tmp_path)
+    sync.poll_once()
+    p0_pred = srv._models["live"].predictor
+    job.train_pass(1)
+    pub.publish_delta("p1", job.table, job.model, job.trainer.params)
+    sync.poll_once()
+    assert srv._models["live"].predictor is not p0_pred
+    restored = sync.rollback()
+    assert restored.tag == "p0"
+    assert srv._models["live"].predictor is p0_pred
+    assert srv.model_version("live")["tag"] == "p0"
+    # nothing older to roll back to
+    with pytest.raises(LookupError):
+        sync.rollback()
+
+
+# --------------------------------------------------------------------------- #
+# registry + donefile format units
+# --------------------------------------------------------------------------- #
+def test_parse_donefile_torn_tail_and_corruption():
+    good = PublishEntry(seq=0, kind="base", tag="t0", dir="base-t0",
+                        base_tag="t0", prev_tag=None, published_at=1.0)
+    blob = (good.to_json() + "\n").encode()
+    torn = blob + b'{"seq": 1, "kind": "del'
+    entries = parse_donefile(torn)
+    assert len(entries) == 1 and entries[0].tag == "t0"
+    with pytest.raises(ValueError):
+        parse_donefile(torn, strict=True)
+    # garbage mid-file (entries after it) is corruption, never "torn"
+    with pytest.raises(ValueError):
+        parse_donefile(b"not json\n" + blob)
+
+
+def test_registry_history_bounded_and_lineage():
+    reg = ModelRegistry(keep_versions=2)
+    preds = [object() for _ in range(4)]
+    v = ModelVersion(name="m", base_tag="b0", seq=0, published_at=1.0)
+    reg.commit("m", v, preds[0])
+    for i, e in enumerate([
+        PublishEntry(seq=1, kind="delta", tag="d1", dir="x", base_tag="b0",
+                     prev_tag="b0", published_at=2.0),
+        PublishEntry(seq=2, kind="delta", tag="d2", dir="x", base_tag="b0",
+                     prev_tag="d1", published_at=3.0),
+        PublishEntry(seq=3, kind="delta", tag="d3", dir="x", base_tag="b0",
+                     prev_tag="d2", published_at=4.0),
+    ]):
+        v = v.extend(e)
+        reg.commit("m", v, preds[i + 1])
+    assert reg.lineage("m")["deltas_applied"] == 3
+    # history bounded at 2: d3 -> d2 -> d1, then exhausted (d1's
+    # predecessor b0 was evicted)
+    assert reg.rollback("m")[0].tag == "d2"
+    assert reg.rollback("m")[0].tag == "d1"
+    with pytest.raises(LookupError):
+        reg.rollback("m")
+
+
+def test_version_extend_rejects_base():
+    v = ModelVersion(name="m", base_tag="b0")
+    with pytest.raises(ValueError):
+        v.extend(PublishEntry(seq=1, kind="base", tag="b1", dir="x",
+                              base_tag="b1", prev_tag="b0",
+                              published_at=1.0))
