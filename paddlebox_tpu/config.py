@@ -90,6 +90,11 @@ class _Flags:
         "publish_root": "",
         "sync_interval_s": 10.0,
         "sync_cache_dir": "",
+        # pass-boundary pipelining kill switch (sparse/table.py): 0 forces
+        # every table back to the serial end_pass/begin_pass lifecycle
+        # regardless of SparseTableConfig.overlap_pass_boundary — the
+        # operational escape hatch when an overlap bug is suspected
+        "overlap_pass_boundary": True,
     }
 
     def __getattr__(self, name: str):
@@ -392,6 +397,23 @@ class SparseTableConfig:
     # the rest live as .npz files — the SSD tier for stores beyond RAM.
     store_spill_dir: str = ""
     store_max_resident: int = 64
+
+    # -- pass-boundary pipelining (sparse/table.py) ----------------------- #
+    # Overlap the pass transition with device/host work: end_pass snapshots
+    # the working set (D2H only) and merges into the host store on a
+    # background thread (a pending-merge overlay keeps lookups
+    # read-your-writes; checkpoint/shrink barrier on it), and prepare_pass
+    # stages the NEXT pass's resolve + init + host buffer while the current
+    # pass still trains (begin_pass then only patches the census
+    # intersection from the finished pass and transfers).  The overlapped
+    # lifecycle is bit-exact vs the serial one (pinned by
+    # tests/test_pass_overlap.py).  False = the serial escape hatch; the
+    # PBOX_OVERLAP_PASS_BOUNDARY=0 env flag forces serial process-wide.
+    overlap_pass_boundary: bool = True
+    # host-store bucket parallelism: lookup/update/decay_evict fan their
+    # per-bucket work (independent by construction — hash-partitioned keys)
+    # over this many threads with per-bucket locking.  <= 1 = serial.
+    store_threads: int = 4
 
     @property
     def row_width(self) -> int:

@@ -113,6 +113,8 @@ def convert_criteo_files(
     ``criteo_feed_config``.  Gzipped inputs are handled (.gz suffix)."""
     import gzip
 
+    import io
+
     os.makedirs(out_dir, exist_ok=True)
     conf = criteo_feed_config(batch_size)
     gen = CriteoTSVGenerator(conf)
@@ -120,26 +122,34 @@ def convert_criteo_files(
     out = None
     n_in_shard = 0
 
-    def next_shard():
-        nonlocal out, n_in_shard
-        if out is not None:
-            out.close()
-        path = os.path.join(out_dir, f"part-{len(shards):05d}")
-        shards.append(path)
-        out = open(path, "w")
-        n_in_shard = 0
-
-    next_shard()
+    # shards open lazily on the first line actually WRITTEN: empty or
+    # fully-malformed inputs produce no zero-byte part-00000 (each line is
+    # staged through a string buffer so a line the generator drops never
+    # forces a shard into existence)
     try:
         for src in inputs:
             opener = gzip.open if str(src).endswith(".gz") else open
             with opener(src, "rt") as f:
                 for line in f:
-                    if n_in_shard >= lines_per_shard:
-                        next_shard()
-                    n_in_shard += gen.write(out, [line])
+                    buf = io.StringIO()
+                    wrote = gen.write(buf, [line])
+                    if not wrote:
+                        continue
+                    if out is not None and n_in_shard >= lines_per_shard:
+                        out.close()
+                        out = None
+                    if out is None:
+                        path = os.path.join(
+                            out_dir, f"part-{len(shards):05d}"
+                        )
+                        shards.append(path)
+                        out = open(path, "w")
+                        n_in_shard = 0
+                    out.write(buf.getvalue())
+                    n_in_shard += wrote
     finally:
-        out.close()
+        if out is not None:
+            out.close()
     return shards
 
 

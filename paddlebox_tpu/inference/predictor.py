@@ -276,20 +276,25 @@ class Predictor:
                 )
             T = m["seq_len"]
             src = np.asarray(batch.seq_pos, np.int32)
-            if src.shape[1] != T:
-                # a wider feed would silently drop behavior history at
+            if src.shape[1] > T:
+                # a WIDER feed would silently drop behavior history at
                 # serving time, skewing scores vs training (which raises on
                 # the same mismatch — LongSeqCtrDnn.apply); match it (ADVICE)
                 raise ValueError(
-                    f"batch max_seq_len {src.shape[1]} != artifact seq_len "
+                    f"batch max_seq_len {src.shape[1]} > artifact seq_len "
                     f"{T}: set DataFeedConfig.max_seq_len to the exported "
                     "length"
                 )
             # re-bucket: real positions (< this batch's real key count) are
             # valid under the bucket's key buffer too; everything else
-            # becomes the bucket's pad marker K
+            # becomes the bucket's pad marker K.  A NARROWER feed pads its
+            # tail with the marker — the exported tower already treats
+            # marker positions as absent history, so a client configured
+            # with a shorter max_seq_len scores identically to one padded
+            # to the artifact length
+            Ts = src.shape[1]
             sp = np.full((B, T), K, np.int32)
-            sp[:b] = np.where(src[:b] < nk, src[:b], K)
+            sp[:b, :Ts] = np.where(src[:b] < nk, src[:b], K)
             args.append(sp)
         preds = np.asarray(exported.call(*args))
         return preds[:b]

@@ -54,6 +54,13 @@ _MODEL_AGE = telemetry.gauge(
     "serve.model_age_seconds",
     help="seconds since the serving model's current version was published",
 )
+# instances whose features were truncated to the batch key capacity —
+# their scores ARE served (training would have clipped identically) but a
+# sustained rate here means the capacity/ladder needs re-exporting
+_CLIPPED = telemetry.counter(
+    "server.clipped_instances",
+    help="scored instances with key-capacity-truncated features",
+)
 
 
 def _status_class(code: int) -> str:
@@ -96,6 +103,10 @@ class ScoringServer:
         self._default: Optional[str] = None
         self._lock = threading.Lock()  # serializes scoring (device work)
         self._meta_lock = threading.Lock()  # registry/stats reads+writes
+        # per-request scoring diagnostics (clipped-instance count): thread-
+        # local so concurrent requests can't read each other's tallies, and
+        # a monkeypatched/overridden score_lines simply leaves it at 0
+        self._tls = threading.local()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         # graceful-drain accounting: in-flight scoring requests, guarded by
@@ -188,6 +199,18 @@ class ScoringServer:
             return dict(entry.version) if entry.version else None
 
     # -- scoring ------------------------------------------------------------ #
+    def score_lines_detail(self, text: bytes,
+                           name: Optional[str] = None) -> dict:
+        """score_lines plus request diagnostics: ``{"scores": [...],
+        "clipped_instances": N}`` where N counts instances whose features
+        were truncated to the batch key capacity before scoring (the HTTP
+        handler surfaces it in the response when non-zero)."""
+        tls = self._tls
+        tls.clipped = 0
+        scores = self.score_lines(text, name)
+        return {"scores": scores,
+                "clipped_instances": getattr(tls, "clipped", 0)}
+
     def score_lines(self, text: bytes, name: Optional[str] = None) -> list:
         """Scores for every instance in canonical slot-text ``text``.
 
@@ -196,7 +219,11 @@ class ScoringServer:
         bucket (key-dense instances) is split in half recursively until it
         fits — so any request serves as long as each single instance fits
         some bucket (the reference's freely-resizable feed tensors,
-        analysis_predictor.cc, by decomposition instead of recompilation)."""
+        analysis_predictor.cc, by decomposition instead of recompilation).
+
+        Instances whose features exceeded the key capacity serve CLIPPED
+        (training parity); the per-call count lands in thread-local state
+        for score_lines_detail / the HTTP handler to surface."""
         with self._meta_lock:
             entry = self._models[name or self._default]
             # pin ONE predictor snapshot for the whole request: a
@@ -222,8 +249,10 @@ class ScoringServer:
         # instead of surviving a split
         lens = np.diff(block.key_offsets[:: block.n_sparse_slots])
         buckets = predictor.bucket_shapes
+        clipped = 0
 
         def score_ids(ids) -> list:
+            nonlocal clipped
             nk = int(lens[ids].sum())
             overflow = nk > builder.key_capacity or not any(
                 len(ids) <= bb and nk <= bk for bb, bk in buckets
@@ -232,8 +261,12 @@ class ScoringServer:
                 mid = len(ids) // 2
                 return score_ids(ids[:mid]) + score_ids(ids[mid:])
             # a SINGLE instance beyond key capacity serves clipped — exactly
-            # what training would have done with it (dropped_keys counts it)
+            # what training would have done with it (dropped_keys counts it;
+            # the per-request clipped_instances total rides the response)
+            d0 = builder.dropped_keys
             batch = builder.build(block, ids)
+            if builder.dropped_keys > d0:
+                clipped += len(ids)
             return [float(s) for s in predictor.predict(batch)]
 
         with self._lock, telemetry.span(
@@ -242,6 +275,9 @@ class ScoringServer:
             for lo in range(0, block.n_ins, B):
                 ids = np.arange(lo, min(lo + B, block.n_ins))
                 scores.extend(score_ids(ids))
+        if clipped:
+            _CLIPPED.inc(clipped, model=entry.name)
+        self._tls.clipped = clipped
         with self._meta_lock:
             entry.requests += 1
             entry.instances += len(scores)
@@ -356,8 +392,15 @@ class ScoringServer:
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     body = self.rfile.read(n)
+                    server._tls.clipped = 0
                     scores = server.score_lines(body, name)
-                    self._send(200, {"scores": scores})
+                    payload = {"scores": scores}
+                    clipped = getattr(server._tls, "clipped", 0)
+                    if clipped:
+                        # surfaced only when capacity actually truncated
+                        # features: callers alert on its presence
+                        payload["clipped_instances"] = clipped
+                    self._send(200, payload)
                 except KeyError:
                     self._send(404, {"error": f"unknown model {name!r}"})
                 except (ValueError, UnicodeDecodeError) as e:

@@ -53,6 +53,7 @@ US = n * C:
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -153,16 +154,9 @@ class ShardedSparseTable(SparseTable):
         return self._local_pos.shape[0]
 
     # -- pass lifecycle --------------------------------------------------- #
-    def begin_pass(self, pass_keys: np.ndarray) -> None:
-        """Promote the pass working set (this process's shards) to device.
-
-        pass_keys: the keys THIS process saw in its dataset shard; the
-        global census is the allgather-union (multi-host collective #1).
-        """
-        if self._in_pass:
-            raise RuntimeError("end_pass the previous pass first")
-        pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
-        pk = np.unique(host_allgather_varlen(pk))  # no-op single-process
+    def _shard_split(self, pk: np.ndarray):
+        """(owner, shard_keys, row_within) for a sorted global census —
+        deterministic in pk, so staging and begin_pass always agree."""
         n = self.n_shards
         owner = (pk % np.uint64(n)).astype(np.int64)
         shard_keys = [pk[owner == o] for o in range(n)]  # each stays sorted
@@ -172,24 +166,100 @@ class ShardedSparseTable(SparseTable):
         for o in range(n):
             m = owner == o
             row_within[m] = np.arange(int(m.sum()), dtype=np.int32)
-        w = self.conf.row_width
+        return owner, shard_keys, row_within
+
+    def _sharded_cap(self, shard_keys) -> int:
         # shard layout mirrors the single-chip table: [0, live) rows |
         # [live, cap-1) plan scratch (distinct scatter targets for the
         # serve_uniq padding tail -> unique push indices) | cap-1 dead.
         # After the first plan, the observed serve-buffer size is the exact
         # scratch need; pass 1 falls back to the config default.
         scratch = self._last_serve_n or self.conf.plan_scratch_rows
-        cap = _next_pow2(
+        return _next_pow2(
             max((sk.shape[0] for sk in shard_keys), default=0) + 1 + scratch
         )
-        # materialize only the local shards: rows come from this process's
-        # host store (each process persists exactly its owned shards), and
-        # fresh keys init key-deterministically (_key_uniform), so any
-        # process layout produces identical row values
+
+    def prepare_pass(self, pass_keys) -> None:
+        """Stage the next pass's stacked working set in the background.
+        Multi-process runs keep the synchronous begin_pass (the census
+        allgather is a collective that must run on the main thread in
+        lockstep across ranks); the async end-pass write-back still
+        applies there — it is purely local."""
+        if is_multiprocess():
+            return
+        super().prepare_pass(pass_keys)
+
+    def _stage_job(self, pass_keys):
+        from paddlebox_tpu import telemetry
+
+        t0 = time.perf_counter()
+        if callable(pass_keys):
+            pass_keys = pass_keys()
+        # single-process only (prepare_pass gates): the local census IS the
+        # global census, no allgather needed off-thread
+        pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
+        with self._overlay_lock:
+            stage_seq = self._wb_seq
+            entries = list(self._overlay)
+        owner, shard_keys, row_within = self._shard_split(pk)
+        w = self.conf.row_width
+        cap = self._sharded_cap(shard_keys)
         lvals = np.zeros((self.n_local, cap, w + 1), dtype=np.float32)
         for i, o in enumerate(self._local_pos):
             sk = shard_keys[o]
-            lvals[i, : sk.shape[0]] = self._resolve_or_init(sk)
+            lvals[i, : sk.shape[0]] = self._resolve_or_init(
+                sk, _entries=entries
+            )
+        telemetry.histogram(
+            "pass.promote_seconds",
+            "background next-pass census resolve + init + staging wall time",
+        ).observe(time.perf_counter() - t0)
+        return pk, owner, shard_keys, row_within, lvals, stage_seq
+
+    def begin_pass(self, pass_keys: np.ndarray) -> None:
+        """Promote the pass working set (this process's shards) to device.
+
+        pass_keys: the keys THIS process saw in its dataset shard; the
+        global census is the allgather-union (multi-host collective #1).
+        With a matching prepare_pass stage, the visible work is one
+        per-shard intersection patch + the sharded device_put.
+        """
+        if self._in_pass:
+            raise RuntimeError("end_pass the previous pass first")
+        from paddlebox_tpu.utils.monitor import stats
+
+        pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
+        pk = np.unique(host_allgather_varlen(pk))  # no-op single-process
+        w = self.conf.row_width
+        payload, patches = self._pop_stage()
+        lvals = None
+        if payload is not None:
+            spk, owner, shard_keys, row_within, svals, _ = payload
+            if (
+                np.array_equal(spk, pk)
+                and svals.shape[1] == self._sharded_cap(shard_keys)
+                and svals.shape[0] == self.n_local
+            ):
+                lvals = svals
+                for i, o in enumerate(self._local_pos):
+                    sk = shard_keys[o]
+                    if sk.shape[0]:
+                        self._patch_rows(
+                            sk, lvals[i, : sk.shape[0]], patches
+                        )
+            else:
+                stats.add("pass.stage_discards")
+        if lvals is None:
+            owner, shard_keys, row_within = self._shard_split(pk)
+            cap = self._sharded_cap(shard_keys)
+            # materialize only the local shards: rows come from this
+            # process's host store (each process persists exactly its owned
+            # shards), and fresh keys init key-deterministically
+            # (_key_uniform), so any process layout produces identical rows
+            lvals = np.zeros((self.n_local, cap, w + 1), dtype=np.float32)
+            for i, o in enumerate(self._local_pos):
+                sk = shard_keys[o]
+                lvals[i, : sk.shape[0]] = self._resolve_or_init(sk)
         sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         self.values = global_from_local(sharding, jnp.asarray(lvals[:, :, :w]))
         self.g2sum = global_from_local(sharding, jnp.asarray(lvals[:, :, w]))
@@ -207,6 +277,7 @@ class ShardedSparseTable(SparseTable):
             if is_multiprocess()
             else pk
         )
+        self._observe_gap()
 
     def end_pass(self) -> None:
         if not self._in_pass:
@@ -216,12 +287,26 @@ class ShardedSparseTable(SparseTable):
         self._census_index = None
         vals = local_view(self.values)  # [L, cap, W]
         g2 = local_view(self.g2sum)  # [L, cap]
+        ks, vs = [], []
         for i, o in enumerate(self._local_pos):
             sk = self._shard_keys[o]
             m = sk.shape[0]
             if m:
-                merged = np.concatenate([vals[i, :m], g2[i, :m, None]], axis=1)
-                self._merge_into_store(sk, merged)
+                ks.append(sk)
+                vs.append(np.concatenate([vals[i, :m], g2[i, :m, None]], axis=1))
+        if ks:
+            # one globally-sorted write-back (shards partition the key
+            # space, so the concat is unique; the overlay's searchsorted
+            # reads and the bucketed merge both want sorted keys)
+            k = np.concatenate(ks)
+            v = np.concatenate(vs)
+            order = np.argsort(k, kind="stable")
+            self._write_back(k[order], v[order])
+        else:
+            self._write_back(
+                np.empty(0, np.uint64),
+                np.empty((0, self.conf.row_width + 1), np.float32),
+            )
         self.values = None
         self.g2sum = None
         self._shard_keys = None

@@ -541,6 +541,7 @@ class MultiChipTrainer:
         table: ShardedSparseTable,
         auc_state: Optional[AucState] = None,
         drop_last: bool = False,
+        next_pass_keys=None,
     ) -> dict:
         """One pass over the dataset, one batch per LOCAL device at a time
         (the caller owns begin_pass/end_pass, as in the single-chip Trainer).
@@ -551,6 +552,7 @@ class MultiChipTrainer:
             table,
             _group_batches(dataset.batches(drop_last=drop_last), self.n_local),
             auc_state=auc_state,
+            next_pass_keys=next_pass_keys,
         )
 
     def train_groups(
@@ -558,7 +560,12 @@ class MultiChipTrainer:
         table: ShardedSparseTable,
         groups: Iterator[Sequence[HostBatch]],
         auc_state: Optional[AucState] = None,
+        next_pass_keys=None,
     ) -> dict:
+        """next_pass_keys: next pass's census (array or zero-arg callable),
+        staged via table.prepare_pass once this pass's groups are exhausted
+        — the sharded half of pass-boundary pipelining (single-process
+        only; multi-host prepare_pass no-ops, see sharded_table.py)."""
         if self._step_fn is None:
             self._step_fn = self._build_step()
         if self._sync_fn is None and self.conf.sync_dense_mode == "kstep":
@@ -813,6 +820,14 @@ class MultiChipTrainer:
                 prefetcher.close()
             if dumper is not None:
                 dumper.close()
+        # pre-promotion: groups are exhausted but the device still drains
+        # queued steps (the metric merge below blocks on them) — stage the
+        # next pass's working set in that window (single-chip Trainer
+        # discipline; sharded prepare_pass no-ops multi-host)
+        if next_pass_keys is not None:
+            prepare = getattr(table, "prepare_pass", None)
+            if prepare is not None:
+                prepare(next_pass_keys)
         # cross-device merge: sum each stream's histograms over the device
         # axis (multi-host: jitted replicated sum + local read,
         # collect_data_nccl analog)

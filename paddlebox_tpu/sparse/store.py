@@ -26,15 +26,27 @@ Optional disk tier: with ``spill_dir`` set, at most ``max_resident``
 buckets stay in RAM (LRU); the rest live as ``.npz`` files and reload on
 access.  That bounds resident memory at ~max_resident/n_buckets of the
 store, the SSD-tier analog for stores beyond RAM.
+
+Parallelism: buckets are independent by construction (hash-partitioned key
+spaces), so with ``n_threads > 1`` the per-bucket work of ``lookup`` /
+``update`` / ``decay_evict`` fans out over a thread pool.  A per-bucket
+lock serializes access to each bucket's arrays (the pass-boundary merge
+thread, the next-pass staging thread and the caller may all touch the
+store concurrently — sparse/table.py); the LRU/spill bookkeeping holds its
+own lock and only ever *tries* a bucket lock (non-blocking) when evicting,
+so the two lock orders cannot deadlock.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from collections import OrderedDict
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
+
+from paddlebox_tpu.utils.monitor import stats
 
 _EMPTY_KEYS = np.empty(0, dtype=np.uint64)
 
@@ -62,6 +74,7 @@ class BucketStore:
         n_buckets: int = 256,
         spill_dir: str = "",
         max_resident: int = 64,
+        n_threads: int = 0,
     ):
         if n_buckets & (n_buckets - 1) or n_buckets <= 0:
             raise ValueError(f"n_buckets must be a power of two, got {n_buckets}")
@@ -77,6 +90,14 @@ class BucketStore:
         self._lru: "OrderedDict[int, None]" = OrderedDict()
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
+        # bucket parallelism: per-bucket content locks + one LRU/spill lock
+        # + one counter lock (see module docstring for the lock discipline)
+        self.n_threads = max(int(n_threads), 0)
+        self._locks = [threading.Lock() for _ in range(n_buckets)]
+        self._lru_lock = threading.Lock()
+        self._ctr_lock = threading.Lock()
+        self._pool = None
+        self._pool_lock = threading.Lock()
         # observability: pass-boundary merge behavior
         self.updated_in_place = 0  # keys whose rows were overwritten in place
         self.inserted = 0  # genuinely new keys
@@ -100,11 +121,33 @@ class BucketStore:
     def _touch(self, b: int) -> None:
         if not self.spill_dir:
             return
-        self._lru[b] = None
-        self._lru.move_to_end(b)
-        while len(self._lru) > self.max_resident:
-            old, _ = self._lru.popitem(last=False)
-            self._spill(old)
+        with self._lru_lock:
+            self._lru[b] = None
+            self._lru.move_to_end(b)
+            while len(self._lru) > self.max_resident:
+                old, _ = self._lru.popitem(last=False)
+                if old == b:
+                    # never evict the bucket being touched: the caller
+                    # holds its lock and is mid-operation on its arrays
+                    self._lru[old] = None
+                    self._lru.move_to_end(old)
+                    if len(self._lru) <= 1:
+                        break
+                    continue
+                # bucket-lock -> lru-lock is the normal order; the evictor
+                # holds lru-lock, so it may only TRY the victim's bucket
+                # lock — a busy victim counts as recently used (deadlock-
+                # free; residency becomes best-effort under contention)
+                lk = self._locks[old]
+                if lk.acquire(blocking=False):
+                    try:
+                        self._spill(old)
+                    finally:
+                        lk.release()
+                else:
+                    self._lru[old] = None
+                    self._lru.move_to_end(old)
+                    break
 
     def _spill(self, b: int) -> None:
         k = self._keys[b]
@@ -166,6 +209,35 @@ class BucketStore:
         for j in range(ub.shape[0]):
             yield int(ub[j]), order[starts[j] : bounds[j + 1]]
 
+    # -- parallel bucket dispatch ------------------------------------------- #
+    def _run_buckets(self, tasks: list) -> list:
+        """Run ``(bucket, thunk)`` tasks, each under its bucket's lock —
+        thread-pooled when parallelism is on and there is more than one
+        bucket to touch, serial otherwise.  Returns the thunk results in
+        task order.  numpy releases the GIL inside the searchsorted/copy
+        kernels, so independent buckets genuinely overlap."""
+
+        def one(b, fn):
+            with self._locks[b]:
+                return fn()
+
+        if self.n_threads > 1 and len(tasks) > 1:
+            pool = self._pool
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with self._pool_lock:
+                    if self._pool is None:
+                        self._pool = ThreadPoolExecutor(
+                            max_workers=self.n_threads,
+                            thread_name_prefix="bucket-store",
+                        )
+                    pool = self._pool
+            stats.add("store.parallel_buckets", len(tasks))
+            futs = [pool.submit(one, b, fn) for b, fn in tasks]
+            return [f.result() for f in futs]
+        return [one(b, fn) for b, fn in tasks]
+
     # -- core API ----------------------------------------------------------- #
     def lookup(self, q: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Rows for sorted unique uint64 keys ``q``.
@@ -175,36 +247,46 @@ class BucketStore:
         n = q.shape[0]
         out = np.zeros((n, self.n_cols), dtype=np.float32)
         found = np.zeros(n, dtype=bool)
-        for b, idx in self._split(q):
+
+        def work(b, idx):
+            # each bucket's idx rows are disjoint: concurrent writes into
+            # out/found never overlap
             bk, bv = self._get(b)
             if bk.shape[0] == 0:
-                continue
+                return
             sub = q[idx]
             pos = np.searchsorted(bk, sub)
             pos_c = np.minimum(pos, bk.shape[0] - 1)
             hit = bk[pos_c] == sub
             out[idx[hit]] = bv[pos_c[hit]]
             found[idx] = hit
+
+        self._run_buckets(
+            [(b, lambda b=b, idx=idx: work(b, idx)) for b, idx in self._split(q)]
+        )
         return out, found
 
     def update(self, q: np.ndarray, vals: np.ndarray) -> None:
         """Overwrite/insert rows for sorted unique keys ``q`` (end-of-pass
         write-back).  Existing keys update in place; buckets receiving new
         keys are rebuilt with one sorted insert each."""
-        for b, idx in self._split(q):
+
+        def work(b, idx):
             bk, bv = self._get(b)
             sub, subv = q[idx], vals[idx]
             if bk.shape[0] == 0:
                 self._set(b, sub.copy(), subv.astype(np.float32, copy=True))
-                self.inserted += sub.shape[0]
-                self.buckets_rebuilt += 1
-                continue
+                with self._ctr_lock:
+                    self.inserted += sub.shape[0]
+                    self.buckets_rebuilt += 1
+                return
             pos = np.searchsorted(bk, sub)
             pos_c = np.minimum(pos, bk.shape[0] - 1)
             hit = bk[pos_c] == sub
             if hit.any():
                 bv[pos_c[hit]] = subv[hit]
-                self.updated_in_place += int(hit.sum())
+                with self._ctr_lock:
+                    self.updated_in_place += int(hit.sum())
             miss = ~hit
             if miss.any():
                 nk = sub[miss]
@@ -214,8 +296,13 @@ class BucketStore:
                     np.insert(bk, pos[miss], nk),
                     np.insert(bv, pos[miss], nv, axis=0),
                 )
-                self.inserted += nk.shape[0]
-                self.buckets_rebuilt += 1
+                with self._ctr_lock:
+                    self.inserted += nk.shape[0]
+                    self.buckets_rebuilt += 1
+
+        self._run_buckets(
+            [(b, lambda b=b, idx=idx: work(b, idx)) for b, idx in self._split(q)]
+        )
 
     # -- maintenance -------------------------------------------------------- #
     def decay_evict(self, decay_cols: int, decay: float, threshold: float) -> int:
@@ -223,19 +310,22 @@ class BucketStore:
         whose column 0 falls below ``threshold``.  Returns evicted count.
         (ShrinkTable semantics — touches every bucket, once per day, not per
         pass.)"""
-        evicted = 0
-        for b in range(self.n_buckets):
-            if self._counts[b] == 0:
-                continue
+
+        def work(b):
             bk, bv = self._get(b)
             bv[:, :decay_cols] *= decay
-            if threshold > 0.0:
-                keep = bv[:, 0] >= threshold
-                ne = int((~keep).sum())
-                if ne:
-                    self._set(b, bk[keep], bv[keep])
-                    evicted += ne
-        return evicted
+            if threshold <= 0.0:
+                return 0
+            keep = bv[:, 0] >= threshold
+            ne = int((~keep).sum())
+            if ne:
+                self._set(b, bk[keep], bv[keep])
+            return ne
+
+        return sum(self._run_buckets(
+            [(b, lambda b=b: work(b))
+             for b in range(self.n_buckets) if self._counts[b]]
+        ))
 
     # -- bulk / serialization ------------------------------------------------ #
     def clear(self) -> None:
@@ -276,10 +366,11 @@ class BucketStore:
         for b in range(self.n_buckets):
             if self._counts[b] == 0:
                 continue
-            bk, bv = self._get(b)
-            n_bytes += int(bk.nbytes + bv.nbytes)
-            if finite:
-                finite = bool(np.isfinite(bv).all())
+            with self._locks[b]:
+                bk, bv = self._get(b)
+                n_bytes += int(bk.nbytes + bv.nbytes)
+                if finite:
+                    finite = bool(np.isfinite(bv).all())
         return {"n": self.n, "bytes": n_bytes, "finite": finite}
 
     def materialize(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -290,9 +381,10 @@ class BucketStore:
         for b in range(self.n_buckets):
             if self._counts[b] == 0:
                 continue
-            bk, bv = self._get(b)
-            ks.append(bk)  # concatenate + argsort below already copy;
-            vs.append(bv)  # result never aliases live buckets
+            with self._locks[b]:
+                bk, bv = self._get(b)
+                ks.append(bk)  # concatenate + argsort below already copy;
+                vs.append(bv)  # result never aliases live buckets
         if not ks:
             return _EMPTY_KEYS, np.empty((0, self.n_cols), dtype=np.float32)
         keys = np.concatenate(ks)

@@ -33,6 +33,10 @@ it is re-zeroed after every push so stray gradients cannot leak into it.
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
 from typing import Optional
 
 import jax
@@ -42,6 +46,43 @@ import numpy as np
 from paddlebox_tpu.config import SparseTableConfig
 from paddlebox_tpu.data.feed import HostBatch
 from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
+
+
+class _SerialWorker:
+    """One lazily-started daemon thread running submitted jobs FIFO.
+
+    The pass-boundary pipeline needs strictly ordered background work
+    (store merges must land in pass order), futures for the barrier sites,
+    and daemon threads so a hang-injected merge can never wedge interpreter
+    exit — a plain queue+thread gives all three where ThreadPoolExecutor
+    gives none."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def submit(self, fn, *args) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True
+                )
+                self._thread.start()
+        self._q.put((fut, fn, args))
+        return fut
+
+    def _run(self) -> None:
+        while True:
+            fut, fn, args = self._q.get()
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:  # surfaced at the barrier sites
+                fut.set_exception(e)
 
 
 @dataclasses.dataclass
@@ -89,6 +130,7 @@ def _key_uniform(keys: np.ndarray, seed: int, n_cols: int, rng_range: float) -> 
 
 class SparseTable:
     def __init__(self, conf: SparseTableConfig, seed: int = 0):
+        from paddlebox_tpu.config import flags
         from paddlebox_tpu.sparse.store import BucketStore
 
         self.conf = conf
@@ -102,6 +144,7 @@ class SparseTable:
             n_buckets=conf.store_buckets,
             spill_dir=conf.store_spill_dir,
             max_resident=conf.store_max_resident,
+            n_threads=conf.store_threads,
         )
         # pass-scoped device state
         self.values: Optional[jax.Array] = None  # [P, w]
@@ -116,8 +159,246 @@ class SparseTable:
         # native per-pass census hash index (lazily built on first plan;
         # borrows self._pass_keys, so it must drop with the pass)
         self._census_index = None
+        # -- pass-boundary pipelining state ------------------------------- #
+        # end_pass write-backs merge into the store on a background thread;
+        # until a merge lands its (seq, keys, vals) entry sits in _overlay
+        # so every read (_lookup_with_overlay) stays read-your-writes.
+        # _patch_log additionally retains write-back snapshots while a
+        # next-pass stage is pending, independent of merge completion —
+        # begin_pass patches the staged buffer's census intersection from
+        # them.  Checkpoint/shrink/state_dict barrier via flush().
+        self._overlap = bool(
+            conf.overlap_pass_boundary and flags.overlap_pass_boundary
+        )
+        self._overlay: list = []  # [(seq, keys sorted, vals [n, W+1])]
+        self._overlay_lock = threading.Lock()
+        self._wb_seq = 0
+        self._merge_worker = _SerialWorker("table-merge")
+        self._merge_futures: list = []
+        self._merge_poisoned = False
+        self._stage_worker = _SerialWorker("table-stage")
+        self._stage_future: Optional[Future] = None
+        self._patch_log: list = []  # write-backs newer than a pending stage
+        self._last_end_t: Optional[float] = None
         # stats
         self.missing_key_count = 0
+
+    # -- pass-boundary pipelining helpers --------------------------------- #
+    @property
+    def overlap_enabled(self) -> bool:
+        """True when the overlapped pass lifecycle (async write-back +
+        pre-promotion) is active on this table."""
+        return self._overlap
+
+    def _lookup_with_overlay(self, q: np.ndarray, entries=None):
+        """Store lookup with pending write-backs layered on top (newest
+        wins).  ``entries`` pins a snapshot of the overlay taken under the
+        lock (the staging job's consistency point); None reads the current
+        overlay.  An entry whose merge already landed is harmless to
+        re-apply — it holds exactly the rows the store received."""
+        if entries is None:
+            with self._overlay_lock:
+                entries = list(self._overlay)
+        vals, found = self._store.lookup(q)
+        n = q.shape[0]
+        for _, ek, ev in entries:  # oldest -> newest: later passes win
+            if not ek.shape[0] or not n:
+                continue
+            pos = np.searchsorted(ek, q)
+            pos_c = np.minimum(pos, ek.shape[0] - 1)
+            hit = ek[pos_c] == q
+            if hit.any():
+                vals[hit] = ev[pos_c[hit]]
+                found |= hit
+        return vals, found
+
+    def _write_back(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        """Hand one pass's final rows to the host store: synchronous merge
+        on the serial path, overlay + background merge when overlapped."""
+        if keys.shape[0] == 0:
+            self._last_end_t = time.monotonic()
+            return
+        if not self._overlap:
+            self._merge_into_store(keys, vals)
+            self._last_end_t = time.monotonic()
+            return
+        with self._overlay_lock:
+            self._wb_seq += 1
+            entry = (self._wb_seq, keys, vals)
+            self._overlay.append(entry)
+            if self._stage_future is not None:
+                # a pending stage resolved BEFORE this write-back existed:
+                # keep the snapshot for begin_pass's intersection patch
+                self._patch_log.append(entry)
+        self._merge_futures.append(
+            self._merge_worker.submit(self._merge_job, entry)
+        )
+        self._last_end_t = time.monotonic()
+
+    def _merge_job(self, entry) -> None:
+        from paddlebox_tpu import telemetry
+        from paddlebox_tpu.utils import faults
+
+        seq, keys, vals = entry
+        t0 = time.perf_counter()
+        try:
+            if self._merge_poisoned:
+                # a previous pass's merge failed: merging THIS pass would
+                # skip one in the store's layering and make overlay reads
+                # stale-ordered — freeze the store at the last good pass
+                # (entries keep accumulating in the overlay, so reads stay
+                # correct; flush raises at the next barrier)
+                raise RuntimeError(
+                    "store merge disabled: an earlier pass write-back "
+                    "failed (surfaced at flush)"
+                )
+            # chaos site: a hang/failure here is a slow or dying merge
+            # thread — reads must stay correct via the overlay, barriers
+            # must surface it
+            faults.inject("store.merge")
+            self._merge_into_store(keys, vals)
+        except BaseException:
+            self._merge_poisoned = True
+            raise
+        with self._overlay_lock:
+            # merges run FIFO on one worker and a failure poisons the rest:
+            # the oldest overlay entry is always ours
+            head = self._overlay.pop(0)
+            assert head[0] == seq, "merge completed out of order"
+        telemetry.histogram(
+            "store.merge_seconds",
+            "background pass write-back merge wall time",
+        ).observe(time.perf_counter() - t0)
+
+    def flush(self) -> None:
+        """Barrier on the pass-boundary pipeline: wait for every pending
+        background merge (re-raising the first failure).  Checkpointing
+        (state_dict/delta_state_dict), shrink and load_state_dict call this
+        so persisted state never misses an in-flight write-back."""
+        while self._merge_futures:
+            self._merge_futures.pop(0).result()
+
+    def _discard_stage(self) -> None:
+        """Drop any staged next-pass buffer (waiting for the job so no
+        staging read can race a store mutation) and trim the patch log."""
+        fut, self._stage_future = self._stage_future, None
+        if fut is not None:
+            try:
+                fut.result()
+            except Exception:
+                pass  # a failed stage has nothing to discard
+        with self._overlay_lock:
+            self._patch_log = []
+
+    def prepare_pass(self, pass_keys) -> None:
+        """Stage the NEXT pass's working set in the background while the
+        current pass still trains (the reference's BeginFeedPass background
+        promote, box_wrapper.cc:609-659): census resolve against
+        store+overlay, `_key_uniform` init for unseen keys, and the host
+        buffer begin_pass will hand to jnp.asarray.  ``pass_keys`` may be
+        the key array or a zero-arg callable returning it — a callable is
+        evaluated on the staging thread, so a blocking census provider
+        (e.g. dataset.wait_preload_done) stays off the critical path.
+        No-op on a serial table.  begin_pass with a matching census
+        consumes the stage and only patches rows the finishing pass also
+        touched; any mismatch falls back to the synchronous resolve."""
+        if not self._overlap:
+            return
+        self._discard_stage()
+        self._stage_future = self._stage_worker.submit(
+            self._stage_job, pass_keys
+        )
+
+    def staged_pass_keys(self) -> Optional[np.ndarray]:
+        """Block until a pending stage finishes and return its census (the
+        sorted unique keys begin_pass must be called with), or None when
+        nothing is staged — drivers that let prepare_pass's callable
+        consume a dataset preload read the census back from here."""
+        if self._stage_future is None:
+            return None
+        return self._stage_future.result()[0]
+
+    def _stage_cap(self, n_keys: int) -> int:
+        scratch = self._last_plan_k or self.conf.plan_scratch_rows
+        return _next_pow2(n_keys + 1 + scratch)
+
+    def _stage_job(self, pass_keys):
+        from paddlebox_tpu import telemetry
+
+        t0 = time.perf_counter()
+        if callable(pass_keys):
+            pass_keys = pass_keys()
+        pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
+        with self._overlay_lock:
+            stage_seq = self._wb_seq
+            entries = list(self._overlay)
+        w = self.conf.row_width
+        cap = self._stage_cap(pk.shape[0])
+        vals = np.zeros((cap, w + 1), dtype=np.float32)
+        vals[: pk.shape[0]] = self._resolve_or_init(pk, _entries=entries)
+        telemetry.histogram(
+            "pass.promote_seconds",
+            "background next-pass census resolve + init + staging wall time",
+        ).observe(time.perf_counter() - t0)
+        return pk, vals, stage_seq
+
+    def _pop_stage(self):
+        """Consume the pending stage: (payload, patches) where payload is
+        the `_stage_job` result (payload[0] = staged census, payload[-1] =
+        the stage's overlay consistency point) and patches are the
+        write-back snapshots that landed after it — or (None, []) when
+        nothing is staged."""
+        from paddlebox_tpu.utils.monitor import stats
+
+        fut, self._stage_future = self._stage_future, None
+        if fut is None:
+            return None, []
+        try:
+            payload = fut.result()
+        except Exception:
+            stats.add("pass.stage_discards")
+            with self._overlay_lock:
+                self._patch_log = []
+            raise
+        with self._overlay_lock:
+            stage_seq = payload[-1]
+            patches = [e for e in self._patch_log if e[0] > stage_seq]
+            self._patch_log = []
+        return payload, patches
+
+    @staticmethod
+    def _patch_rows(keys: np.ndarray, rows: np.ndarray, patches) -> None:
+        """Overwrite ``rows`` (aligned with sorted ``keys``) with every
+        patch entry's rows for keys they share — the host-side sorted
+        intersect + row copy that makes a staged buffer current."""
+        n = keys.shape[0]
+        for _, ek, ev in patches:  # oldest -> newest
+            if not ek.shape[0] or not n:
+                continue
+            pos = np.searchsorted(ek, keys)
+            pos_c = np.minimum(pos, ek.shape[0] - 1)
+            hit = ek[pos_c] == keys
+            if hit.any():
+                rows[hit] = ev[pos_c[hit]]
+
+    def _take_stage(self, pk: np.ndarray, cap: int):
+        """Consume a pending stage if it matches (census AND capacity);
+        returns the patched [cap, W+1] host buffer or None.  Patch = for
+        every write-back newer than the stage's consistency point, copy the
+        rows of its census ∩ ``pk`` (host-side sorted intersect)."""
+        from paddlebox_tpu.utils.monitor import stats
+
+        payload, patches = self._pop_stage()
+        if payload is None:
+            return None
+        spk, vals, _ = payload
+        if vals.shape[0] != cap or not np.array_equal(spk, pk):
+            # census changed between staging and begin_pass (or the scratch
+            # sizing moved): the stage is stale — resolve synchronously
+            stats.add("pass.stage_discards")
+            return None
+        self._patch_rows(pk, vals[: pk.shape[0]], patches)
+        return vals
 
     def _native_index(self):
         """Lazily built native census index for this pass (None when the
@@ -137,6 +418,7 @@ class SparseTable:
     # -- introspection --------------------------------------------------- #
     @property
     def n_features(self) -> int:
+        self.flush()  # pending merges may still be inserting new keys
         return self._store.n
 
     @property
@@ -148,14 +430,15 @@ class SparseTable:
         return self.capacity - 1
 
     # -- pass lifecycle --------------------------------------------------- #
-    def _resolve_or_init(self, pk: np.ndarray) -> np.ndarray:
+    def _resolve_or_init(self, pk: np.ndarray, _entries=None) -> np.ndarray:
         """Rows for sorted unique keys ``pk``: fetched from the host store
-        when present, freshly initialized otherwise.  Returns [n, W+1]."""
+        (with pending write-backs overlaid) when present, freshly
+        initialized otherwise.  Returns [n, W+1]."""
         w = self.conf.row_width
         n = pk.shape[0]
         if not n:
             return np.zeros((0, w + 1), dtype=np.float32)
-        vals, found = self._store.lookup(pk)
+        vals, found = self._lookup_with_overlay(pk, _entries)
         n_new = int((~found).sum())
         if n_new:
             init = np.zeros((n_new, w + 1), dtype=np.float32)
@@ -166,9 +449,25 @@ class SparseTable:
             vals[~found] = init
         return vals
 
+    def _observe_gap(self) -> None:
+        """Record one pass-boundary device-idle gap (end_pass return ->
+        begin_pass return) — the number the whole pipeline exists to
+        shrink."""
+        if self._last_end_t is None:
+            return
+        from paddlebox_tpu import telemetry
+
+        telemetry.histogram(
+            "pass.boundary_gap_seconds",
+            "device-idle gap from end_pass return to begin_pass return",
+        ).observe(time.monotonic() - self._last_end_t)
+        self._last_end_t = None
+
     def begin_pass(self, pass_keys: np.ndarray) -> None:
         """Promote the pass working set to device (reference: EndFeedPass
-        SSD->CPU->HBM promote + BeginPass, box_wrapper.cc:630-659)."""
+        SSD->CPU->HBM promote + BeginPass, box_wrapper.cc:630-659).  When
+        prepare_pass staged this census, the visible work is one
+        intersection patch + jnp.asarray."""
         if self._in_pass:
             raise RuntimeError("end_pass the previous pass first")
         pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
@@ -180,21 +479,25 @@ class SparseTable:
         # pass 1 uses the config default (over-provisioning only rounds
         # into the same pow2 in the common case, and plan_keys degrades
         # gracefully if a later batch needs more).
-        scratch = self._last_plan_k or self.conf.plan_scratch_rows
-        cap = _next_pow2(pk.shape[0] + 1 + scratch)
-        vals = np.zeros((cap, w + 1), dtype=np.float32)
+        cap = self._stage_cap(pk.shape[0])
         n = pk.shape[0]
-        vals[:n] = self._resolve_or_init(pk)
+        vals = self._take_stage(pk, cap)
+        if vals is None:
+            vals = np.zeros((cap, w + 1), dtype=np.float32)
+            vals[:n] = self._resolve_or_init(pk)
         self.values = jnp.asarray(vals[:, :w])
         self.g2sum = jnp.asarray(vals[:, w])
         self._pass_keys = pk
         self._census_index = None  # stale: points at the previous census
         self._in_pass = True
         self._delta_keys.append(pk)
+        self._observe_gap()
 
     def end_pass(self) -> None:
         """Write the working set back to the host store (reference: EndPass
-        HBM->CPU/SSD write-back, box_wrapper.cc:660-673)."""
+        HBM->CPU/SSD write-back, box_wrapper.cc:660-673).  Overlapped
+        tables only pay the D2H snapshot here; the store merge runs on the
+        background thread (flush() is the barrier)."""
         if not self._in_pass:
             raise RuntimeError("no pass in flight")
         pk = self._pass_keys
@@ -202,7 +505,7 @@ class SparseTable:
         vals = np.concatenate(
             [np.asarray(self.values), np.asarray(self.g2sum)[:, None]], axis=1
         )[:n]
-        self._merge_into_store(pk, vals)
+        self._write_back(pk, vals)
         self.values = None
         self.g2sum = None
         # DROP the native index reference rather than eagerly closing it: a
@@ -307,7 +610,11 @@ class SparseTable:
         Returns the number of evicted rows."""
         if self._in_pass:
             raise RuntimeError("shrink between passes, not inside one")
-        if self.n_features == 0:
+        # barrier + stage invalidation: the decay/evict must see every
+        # pending write-back, and a staged next pass resolved pre-shrink
+        # would resurrect undecayed rows
+        self._discard_stage()
+        if self.n_features == 0:  # n_features flushes pending merges
             return 0
         return self._store.decay_evict(
             decay_cols=2,  # show + clk
@@ -321,10 +628,13 @@ class SparseTable:
         copy: the bucketed store has no single contiguous array to view)."""
         if self._in_pass:
             raise RuntimeError("end_pass before checkpointing")
+        self.flush()  # checkpoint barrier: no write-back may be in flight
         keys, vals = self._store.materialize()
         return {"keys": keys, "values": vals}
 
     def load_state_dict(self, state: dict) -> None:
+        self.flush()  # pending merges must not land on top of the restore
+        self._discard_stage()  # a staged pass resolved pre-restore is stale
         self._store.load_bulk(
             np.asarray(state["keys"], dtype=np.uint64),
             np.asarray(state["values"], dtype=np.float32),
@@ -346,6 +656,7 @@ class SparseTable:
         (reference: box_wrapper.cc:1411-1460)."""
         if self._in_pass:
             raise RuntimeError("end_pass before checkpointing")
+        self.flush()  # checkpoint barrier (see state_dict)
         if not self._delta_keys:
             return {
                 "keys": np.empty(0, np.uint64),
@@ -368,6 +679,10 @@ class SparseTable:
     def apply_delta(self, state: dict) -> None:
         keys = np.asarray(state["keys"], dtype=np.uint64)
         if keys.shape[0]:
+            # order against in-flight write-backs, and drop any staged pass
+            # that resolved before these rows existed
+            self.flush()
+            self._discard_stage()
             self._merge_into_store(keys, np.asarray(state["values"], np.float32))
 
 

@@ -608,11 +608,19 @@ class Trainer:
         table: SparseTable,
         auc_state: Optional[AucState] = None,
         drop_last: bool = False,
+        next_pass_keys=None,
     ) -> dict:
         """Run one pass over the dataset's batches (the TrainFiles analog).
 
         The caller owns the pass lifecycle: table.begin_pass() before,
         table.end_pass() after.  Returns the pass metrics.
+
+        next_pass_keys: the NEXT pass's key census (array, or a zero-arg
+        callable returning one — evaluated on the table's staging thread,
+        so it may block on a dataset preload).  Handed to
+        table.prepare_pass once this pass's feeds are exhausted, while the
+        device still drains its queued tail steps — the pre-promotion half
+        of pass-boundary pipelining (no-op on serial tables).
 
         Non-finite batches follow TrainerConfig.nan_policy: "raise" aborts
         (NonFiniteBatchError), "skip_batch" discards the batch on device
@@ -884,6 +892,14 @@ class Trainer:
             ):
                 self._rollback_to_checkpoint(table)  # raises PassRolledBack
             raise
+        # pre-promotion: the feed loop is done but the device is still
+        # draining queued steps (and the metric readback below blocks on
+        # them) — exactly the tail window the next pass's census resolve +
+        # init + staging can hide in
+        if next_pass_keys is not None:
+            prepare = getattr(table, "prepare_pass", None)
+            if prepare is not None:
+                prepare(next_pass_keys)
         if self.conf.need_dump_param and self.conf.dump_fields_path:
             from paddlebox_tpu.train.dump import dump_params
 

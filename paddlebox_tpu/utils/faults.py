@@ -68,6 +68,8 @@ KNOWN_SITES = {
     "fs.touch", "fs.cat", "fs.put", "fs.get", "fs.test", "fs.touchz",
     # data + checkpoint paths
     "data.read", "ckpt.save", "ckpt.load",
+    # pass-boundary pipeline: the background store merge (sparse/table.py)
+    "store.merge",
     # checkpoint/model publishing (utils/fs + serving_sync/publisher)
     "publish.mkdir", "publish.upload", "publish.donefile", "publish.delta",
     # training + distributed plane

@@ -1,0 +1,350 @@
+"""Pass-boundary pipelining correctness (ARCHITECTURE.md "Pass-boundary
+pipelining").
+
+The overlapped lifecycle — async end-pass write-back behind a pending-merge
+overlay, next-pass pre-promotion with the begin_pass intersection patch,
+thread-pooled bucket store — must be BIT-exact vs the serial escape hatch:
+same keys, same values, same g2sum, same AUC, over multiple passes, on both
+trainer paths.  Plus: the overlay stays read-your-writes under an injected
+slow merge (chaos site ``store.merge``), and checkpoint/shrink barrier on
+the background merge before touching the store.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.train.trainer import Trainer
+from paddlebox_tpu.utils import faults
+
+N_SLOTS = 3
+DENSE = 2
+N_PASSES = 3
+
+
+def _tconf(overlap: bool, **kw) -> SparseTableConfig:
+    return SparseTableConfig(
+        embedding_dim=4, learning_rate=0.4, initial_range=0.05,
+        store_buckets=16, plan_scratch_rows=64,
+        overlap_pass_boundary=overlap, store_threads=4 if overlap else 0,
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def pass_datasets(tmp_path_factory):
+    """N_PASSES loaded datasets over a SHARED key space (vocab 40: heavy
+    census overlap between passes — the begin_pass patch path must carry
+    pass p's updates into pass p+1's staged buffer)."""
+    conf = make_synth_config(
+        n_sparse_slots=N_SLOTS, dense_dim=DENSE, batch_size=64,
+        max_feasigns_per_ins=16,
+    )
+    datasets = []
+    for p in range(N_PASSES):
+        d = tmp_path_factory.mktemp(f"pass{p}")
+        files = write_synth_files(
+            str(d), n_files=2, ins_per_file=192, n_sparse_slots=N_SLOTS,
+            vocab_per_slot=40, dense_dim=DENSE, seed=11 + p,
+        )
+        ds = PadBoxSlotDataset(conf, read_threads=2)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        datasets.append(ds)
+    yield conf, datasets
+    for ds in datasets:
+        ds.close()
+
+
+def _run_single_chip(datasets, overlap: bool, prepare: bool):
+    tconf = _tconf(overlap)
+    table = SparseTable(tconf, seed=3)
+    model = CtrDnn(N_SLOTS, tconf.row_width, dense_dim=DENSE, hidden=(16, 8))
+    trainer = Trainer(
+        model, tconf, TrainerConfig(dense_lr=3e-3, auc_buckets=1 << 12),
+        seed=3,
+    )
+    auc_state = None
+    metrics = None
+    for p, ds in enumerate(datasets):
+        table.begin_pass(ds.unique_keys())
+        nxt = (
+            datasets[p + 1].unique_keys
+            if prepare and p + 1 < len(datasets) else None
+        )
+        metrics = trainer.train_from_dataset(
+            ds, table, auc_state=auc_state, drop_last=True,
+            next_pass_keys=nxt,
+        )
+        auc_state = trainer.last_metric_state
+        table.end_pass()
+    sd = table.state_dict()
+    delta = table.pop_delta()
+    return sd, delta, metrics
+
+
+def _run_multichip(datasets, overlap: bool, prepare: bool):
+    from paddlebox_tpu.parallel import (
+        MultiChipTrainer,
+        ShardedSparseTable,
+        make_mesh,
+    )
+
+    mesh = make_mesh(8)
+    tconf = _tconf(overlap)
+    table = ShardedSparseTable(tconf, mesh, seed=3)
+    model = CtrDnn(N_SLOTS, tconf.row_width, dense_dim=DENSE, hidden=(16, 8))
+    trainer = MultiChipTrainer(
+        model, tconf, mesh, TrainerConfig(dense_lr=3e-3, auc_buckets=1 << 12),
+        seed=3,
+    )
+    metrics = None
+    for p, ds in enumerate(datasets):
+        table.begin_pass(ds.unique_keys())
+        nxt = (
+            datasets[p + 1].unique_keys
+            if prepare and p + 1 < len(datasets) else None
+        )
+        metrics = trainer.train_from_dataset(
+            ds, table, drop_last=True, next_pass_keys=nxt,
+        )
+        table.end_pass()
+    return table.state_dict(), metrics
+
+
+def _assert_state_equal(a, b):
+    assert np.array_equal(a["keys"], b["keys"])
+    # values carry [show, clk, embed..., g2sum]: exact equality pins the
+    # counters, the embeddings AND the optimizer state bit-for-bit
+    assert np.array_equal(a["values"], b["values"])
+
+
+class TestBitExactness:
+    def test_single_chip_overlap_matches_serial(self, pass_datasets):
+        _, datasets = pass_datasets
+        sd_s, delta_s, m_s = _run_single_chip(datasets, False, False)
+        sd_o, delta_o, m_o = _run_single_chip(datasets, True, True)
+        _assert_state_equal(sd_s, sd_o)
+        _assert_state_equal(delta_s, delta_o)
+        assert m_s["auc"] == m_o["auc"]
+        assert m_s["loss"] == m_o["loss"]
+
+    def test_single_chip_overlap_without_prepare_matches(self, pass_datasets):
+        # async write-back alone (no staging): begin_pass resolves through
+        # the overlay synchronously — still bit-exact
+        _, datasets = pass_datasets
+        sd_s, _, m_s = _run_single_chip(datasets, False, False)
+        sd_o, _, m_o = _run_single_chip(datasets, True, False)
+        _assert_state_equal(sd_s, sd_o)
+        assert m_s["auc"] == m_o["auc"]
+
+    def test_multichip_overlap_matches_serial(self, pass_datasets):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the conftest 8-device CPU mesh")
+        _, datasets = pass_datasets
+        sd_s, m_s = _run_multichip(datasets, False, False)
+        sd_o, m_o = _run_multichip(datasets, True, True)
+        _assert_state_equal(sd_s, sd_o)
+        assert m_s["auc"] == m_o["auc"]
+
+
+class TestOverlayReadYourWrites:
+    def test_lookup_and_begin_pass_see_unmerged_writeback(self):
+        # PBOX_FAULT_PLAN-style hang at store.merge: the background merge
+        # freezes, yet every read must already see the pass's rows
+        with faults.fault_plan({"store.merge": "hang:first:1"}):
+            t = SparseTable(_tconf(True), seed=0)
+            keys = np.arange(1, 60, dtype=np.uint64)
+            t.begin_pass(keys)
+            t.values = t.values + 2.0  # show col: 0 -> 2
+            t0 = time.monotonic()
+            t.end_pass()
+            assert time.monotonic() - t0 < 2.0, "end_pass waited on the merge"
+            vals, found = t._lookup_with_overlay(keys)
+            assert found.all() and (vals[:, 0] == 2.0).all()
+            # a new pass over an overlapping census resolves from the
+            # overlay, not the (stale) store
+            t.begin_pass(keys[:30])
+            assert (np.asarray(t.values)[:30, 0] == 2.0).all()
+            t.abort_pass()
+            faults.release_hangs()
+            t.flush()
+            sd = t.state_dict()
+            assert (sd["values"][:, 0] == 2.0).all()
+
+    def test_staged_pass_patched_with_final_rows(self):
+        # prepare_pass BEFORE end_pass: the staged buffer resolves the OLD
+        # rows; begin_pass must patch the census intersection from the
+        # finished pass's write-back
+        t = SparseTable(_tconf(True), seed=0)
+        keys = np.arange(1, 40, dtype=np.uint64)
+        t.begin_pass(keys)
+        t.values = t.values + 5.0
+        t.prepare_pass(keys)  # staged against the PRE-pass store
+        t.end_pass()
+        t.begin_pass(keys)  # consumes the stage + patches
+        assert (np.asarray(t.values)[: len(keys), 0] == 5.0).all()
+        t.end_pass()
+        t.flush()
+
+    def test_stage_discarded_on_census_mismatch(self):
+        from paddlebox_tpu.utils.monitor import stats
+
+        t = SparseTable(_tconf(True), seed=0)
+        t.prepare_pass(np.arange(1, 10, dtype=np.uint64))
+        before = stats.get("pass.stage_discards")
+        t.begin_pass(np.arange(1, 30, dtype=np.uint64))  # different census
+        assert stats.get("pass.stage_discards") == before + 1
+        assert t.capacity > 0  # synchronous fallback still promoted
+        t.end_pass()
+        t.flush()
+
+
+class TestBarriers:
+    def test_state_dict_waits_for_hung_merge(self):
+        with faults.fault_plan({"store.merge": "hang:first:1"}):
+            t = SparseTable(_tconf(True), seed=0)
+            keys = np.arange(1, 50, dtype=np.uint64)
+            t.begin_pass(keys)
+            t.values = t.values + 3.0
+            t.end_pass()
+            release = threading.Timer(0.3, faults.release_hangs)
+            release.start()
+            t0 = time.monotonic()
+            sd = t.state_dict()  # must barrier on the in-flight merge
+            assert time.monotonic() - t0 >= 0.25
+            assert (sd["values"][:, 0] == 3.0).all()
+            release.join()
+
+    def test_shrink_barriers_and_discards_stage(self):
+        # decay at shrink must see the write-back, and a staged buffer
+        # resolved pre-shrink must not resurrect undecayed rows
+        tconf = _tconf(True, show_decay_rate=0.5)
+        serial = _tconf(False, show_decay_rate=0.5)
+
+        def run(tc, prepare):
+            t = SparseTable(tc, seed=0)
+            keys = np.arange(1, 30, dtype=np.uint64)
+            t.begin_pass(keys)
+            t.values = t.values + 4.0
+            if prepare:
+                t.prepare_pass(keys)
+                t.staged_pass_keys()  # ensure the stage resolved pre-shrink
+            t.end_pass()
+            t.shrink()
+            t.begin_pass(keys)
+            vals = np.asarray(t.values).copy()
+            t.end_pass()
+            t.flush()
+            return vals
+
+        v_serial = run(serial, False)
+        v_overlap = run(tconf, True)
+        assert (v_serial[:29, 0] == 2.0).all()  # 4.0 decayed by 0.5
+        assert np.array_equal(v_serial, v_overlap)
+
+    def test_merge_failure_surfaces_at_flush(self):
+        with faults.fault_plan({"store.merge": "first:1"}):
+            t = SparseTable(_tconf(True), seed=0)
+            keys = np.arange(1, 20, dtype=np.uint64)
+            t.begin_pass(keys)
+            t.values = t.values + 1.0
+            t.end_pass()
+            with pytest.raises(faults.FaultInjected):
+                t.flush()
+            # the failed write-back is still readable through the overlay
+            vals, found = t._lookup_with_overlay(keys)
+            assert found.all() and (vals[:, 0] == 1.0).all()
+
+    def test_failed_merge_poisons_later_merges_not_reads(self):
+        # a later pass must NOT land in the store on top of a missing one
+        # (the overlay layering would go stale-ordered); reads keep seeing
+        # the newest write-back and every barrier raises
+        with faults.fault_plan({"store.merge": "first:1"}):
+            t = SparseTable(_tconf(True), seed=0)
+            keys = np.arange(1, 20, dtype=np.uint64)
+            t.begin_pass(keys)
+            t.values = t.values + 1.0
+            t.end_pass()  # this merge fails
+            t.begin_pass(keys)  # resolves 1.0 through the overlay
+            assert (np.asarray(t.values)[:19, 0] == 1.0).all()
+            t.values = t.values + 1.0
+            t.end_pass()  # this merge is poisoned, store stays empty
+            time.sleep(0.1)  # let the poisoned merge job run
+            vals, found = t._lookup_with_overlay(keys)
+            assert found.all() and (vals[:, 0] == 2.0).all()  # newest wins
+            assert t._store.n == 0  # nothing ever landed
+            with pytest.raises(faults.FaultInjected):
+                t.flush()
+
+
+class TestParallelStore:
+    def test_parallel_store_matches_serial(self):
+        from paddlebox_tpu.sparse.store import BucketStore
+
+        rng = np.random.default_rng(0)
+        serial = BucketStore(n_cols=5, n_buckets=32, n_threads=0)
+        pooled = BucketStore(n_cols=5, n_buckets=32, n_threads=4)
+        for i in range(5):
+            keys = np.unique(
+                rng.integers(0, 10_000, size=2000).astype(np.uint64)
+            )
+            vals = rng.normal(size=(keys.shape[0], 5)).astype(np.float32)
+            serial.update(keys, vals)
+            pooled.update(keys, vals)
+        q = np.unique(rng.integers(0, 12_000, size=3000).astype(np.uint64))
+        vs, fs = serial.lookup(q)
+        vp, fp = pooled.lookup(q)
+        assert np.array_equal(fs, fp) and np.array_equal(vs, vp)
+        es = serial.decay_evict(decay_cols=2, decay=0.5, threshold=0.0)
+        ep = pooled.decay_evict(decay_cols=2, decay=0.5, threshold=0.0)
+        assert es == ep
+        ks, vvs = serial.materialize()
+        kp, vvp = pooled.materialize()
+        assert np.array_equal(ks, kp) and np.array_equal(vvs, vvp)
+
+    def test_concurrent_lookup_update_disjoint_keys(self):
+        # merge thread (update) and staging thread (lookup) on disjoint
+        # key ranges must not corrupt each other under the pool
+        from paddlebox_tpu.sparse.store import BucketStore
+
+        store = BucketStore(n_cols=3, n_buckets=16, n_threads=4)
+        base = np.arange(0, 4000, dtype=np.uint64)
+        store.update(base, np.ones((4000, 3), np.float32))
+        errs = []
+
+        def reader():
+            try:
+                for _ in range(20):
+                    v, f = store.lookup(base[:2000])
+                    assert f.all() and (v == 1.0).all()
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        def writer():
+            try:
+                for i in range(20):
+                    store.update(
+                        base[2000:],
+                        np.full((2000, 3), float(i + 2), np.float32),
+                    )
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        threads = [threading.Thread(target=reader),
+                   threading.Thread(target=writer)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs
+        v, f = store.lookup(base[2000:])
+        assert f.all() and (v == 21.0).all()

@@ -166,6 +166,48 @@ def test_key_dense_requests_split_not_clipped(tmp_path):
     assert b.dropped_keys > 0
 
 
+def test_clipped_single_instance_reported(tmp_path):
+    """ONE instance beyond key capacity serves clipped (training parity) —
+    and the response says so: score_lines_detail counts it and the HTTP
+    payload carries clipped_instances (ADVICE r5)."""
+    conf, art = _train_and_export(tmp_path, "clip", seed=9)
+    srv = ScoringServer()
+    srv.register("clip", art, conf)
+    kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+
+    rng = np.random.default_rng(3)
+    parts = ["1 0"]
+    per_slot = kcap // S + 8  # a single instance over the whole capacity
+    for s in range(S):
+        ks = rng.integers(0, 40, per_slot)
+        parts.append(f"{len(ks)} " + " ".join(map(str, ks)))
+    parts.append(f"{DENSE} " + " ".join(
+        f"{v:.3f}" for v in rng.random(DENSE)))
+    fat = (" ".join(parts) + "\n").encode()
+
+    detail = srv.score_lines_detail(fat)
+    assert len(detail["scores"]) == 1
+    assert detail["clipped_instances"] == 1
+    # an in-capacity request reports zero and the field stays off the wire
+    detail = srv.score_lines_detail(_lines(2))
+    assert detail["clipped_instances"] == 0
+
+    port = srv.start(port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/score", data=fat, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["clipped_instances"] == 1 and len(out["scores"]) == 1
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/score", data=_lines(2), method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert "clipped_instances" not in out
+    finally:
+        srv.stop()
+
+
 def test_longseq_artifact_serves(tmp_path):
     """A behavior-sequence model (uses_seq_pos) exports and serves over the
     packaged server: the feed builds seq_pos from the configured
@@ -190,6 +232,28 @@ def test_longseq_artifact_serves(tmp_path):
         assert all(0.0 < s < 1.0 for s in out["scores"])
     finally:
         srv.stop()
+
+    # a NARROWER client feed (shorter max_seq_len) pads with the bucket's
+    # marker and scores identically to the artifact-width feed; a WIDER one
+    # still raises (it would drop history — ADVICE r5)
+    import dataclasses
+
+    from paddlebox_tpu.data.slot_parser import SlotParser
+    from paddlebox_tpu.data.feed import BatchBuilder
+    from paddlebox_tpu.inference import Predictor
+
+    pred = Predictor.load(art)
+    lines = _lines(4).decode().splitlines()
+
+    def score_at(seq_len):
+        c = dataclasses.replace(conf, max_seq_len=seq_len)
+        block = SlotParser(c).parse_lines(lines)
+        batch = BatchBuilder(c).build(block, np.arange(4))
+        return pred.predict(batch)
+
+    np.testing.assert_allclose(score_at(T // 2), score_at(T), rtol=1e-6)
+    with pytest.raises(ValueError, match="seq_len"):
+        score_at(2 * T)
 
 
 def test_multitask_artifact_rejected(tmp_path):
