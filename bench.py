@@ -1026,6 +1026,145 @@ def stage_pass_boundary(backend, args, tconf, trconf, n_slots, dense, bsz,
           "vs_baseline": None, "backend": backend, **res})
 
 
+def bench_hbm_cache(n_passes: int, tconf0, trconf, n_slots: int, dense: int,
+                    bsz: int, ins_per_pass: int, hidden,
+                    vocab_per_slot: int = 4000, zipf_a: float = 1.3) -> dict:
+    """HBM-cache ablation (ISSUE 6 acceptance): the SAME skewed key stream
+    (Zipf-drawn ids — real CTR traffic's hot head) driven uncached
+    (hbm_cache_rows=0, every pass round-trips its full working set through
+    the host store) and cached (device-resident hot tier), measuring the
+    per-pass PROMOTION PATCH — rows the host must supply at begin_pass —
+    plus hit rate, inter-pass gap, samples/s and host-tier pressure
+    (BucketStore.stats spilled_buckets/resident_rows), and checking the
+    final stores bit-exact.  Cheap enough to re-run on CPU (the ROADMAP
+    bench caveat: CPU ablations are the admissible evidence while the
+    accelerator tunnel is down)."""
+    import dataclasses
+
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.train.trainer import Trainer
+
+    conf = make_synth_config(
+        n_sparse_slots=n_slots, dense_dim=dense, batch_size=bsz,
+        max_feasigns_per_ins=64,
+        batch_key_capacity=bsz * n_slots * 4,
+    )
+    res: dict = {}
+    states = {}
+    with tempfile.TemporaryDirectory() as td:
+        datasets = []
+        for p in range(n_passes):
+            files = write_synth_files(
+                os.path.join(td, f"p{p}"), n_files=2,
+                ins_per_file=ins_per_pass // 2, n_sparse_slots=n_slots,
+                vocab_per_slot=vocab_per_slot, dense_dim=dense, seed=57 + p,
+                zipf_a=zipf_a,
+            )
+            ds = PadBoxSlotDataset(conf, read_threads=2)
+            ds.set_filelist(files)
+            ds.load_into_memory()
+            datasets.append(ds)
+        try:
+            for mode in ("uncached", "cached"):
+                tconf = dataclasses.replace(
+                    tconf0,
+                    hbm_cache_rows=(
+                        tconf0.hbm_cache_rows if mode == "cached" else 0
+                    ),
+                )
+                model = CtrDnn(n_slots, tconf.row_width, dense_dim=dense,
+                               hidden=hidden)
+                table = SparseTable(tconf, seed=0)
+                trainer = Trainer(model, tconf, trconf, seed=0)
+                gaps, patch_rows, census_rows, hit_rates = [], [], [], []
+                auc_state = None
+                total = prev_count = 0
+                prev_end_s = None
+                t_all = time.perf_counter()
+                for p, ds in enumerate(datasets):
+                    t0 = time.perf_counter()
+                    table.begin_pass(ds.unique_keys())
+                    if prev_end_s is not None:
+                        gaps.append(prev_end_s + time.perf_counter() - t0)
+                    n_census = table._pass_keys.shape[0]
+                    census_rows.append(n_census)
+                    if mode == "cached":
+                        patch_rows.append(table.last_cache_misses)
+                        hit_rates.append(
+                            table.last_cache_hits / max(n_census, 1)
+                        )
+                    else:  # no cache: the host supplies the full census
+                        patch_rows.append(n_census)
+                    nxt = (
+                        datasets[p + 1].unique_keys
+                        if p + 1 < n_passes else None
+                    )
+                    m = trainer.train_from_dataset(
+                        ds, table, auc_state=auc_state, drop_last=True,
+                        next_pass_keys=nxt,
+                    )
+                    auc_state = trainer.last_metric_state
+                    t0 = time.perf_counter()
+                    table.end_pass()
+                    prev_end_s = time.perf_counter() - t0
+                    total += int(m["count"]) - prev_count
+                    prev_count = int(m["count"])
+                table.flush()
+                dt = time.perf_counter() - t_all
+                states[mode] = table.state_dict()
+                st = table._store.stats()
+                res[f"{mode}_gap_ms"] = round(
+                    sum(gaps) / max(len(gaps), 1) * 1e3, 2)
+                res[f"{mode}_samples_per_sec"] = round(total / dt, 1)
+                # steady-state promotion patch: skip pass 0 (all-miss warmup)
+                res[f"{mode}_promotion_patch_rows"] = round(
+                    sum(patch_rows[1:]) / max(len(patch_rows) - 1, 1), 1)
+                res[f"{mode}_census_rows"] = round(
+                    sum(census_rows[1:]) / max(len(census_rows) - 1, 1), 1)
+                res[f"{mode}_spilled_buckets"] = st["spilled_buckets"]
+                res[f"{mode}_store_resident_rows"] = st["resident_rows"]
+                if mode == "cached":
+                    res["cached_hit_rate"] = round(
+                        sum(hit_rates[1:]) / max(len(hit_rates) - 1, 1), 4)
+                log(f"hbm-cache {mode}: promotion patch "
+                    f"{res[f'{mode}_promotion_patch_rows']:.0f} rows/pass "
+                    f"(census {res[f'{mode}_census_rows']:.0f}), gap "
+                    f"{res[f'{mode}_gap_ms']:.1f} ms, "
+                    f"{total / dt:,.0f} samples/s")
+        finally:
+            for ds in datasets:
+                ds.close()
+    res["bitexact"] = bool(
+        np.array_equal(states["uncached"]["keys"], states["cached"]["keys"])
+        and np.array_equal(states["uncached"]["values"],
+                           states["cached"]["values"])
+    )
+    if res["cached_promotion_patch_rows"] > 0:
+        res["patch_shrink"] = round(
+            res["uncached_promotion_patch_rows"]
+            / res["cached_promotion_patch_rows"], 2)
+    log(f"hbm-cache: bitexact={res['bitexact']} hit_rate="
+        f"{res.get('cached_hit_rate')} patch "
+        f"{res['uncached_promotion_patch_rows']:.0f} -> "
+        f"{res['cached_promotion_patch_rows']:.0f} rows/pass")
+    return res
+
+
+def stage_hbm_cache(backend, args, tconf, trconf, n_slots, dense, bsz,
+                    n_ins, hidden) -> None:
+    res = bench_hbm_cache(
+        4, tconf, trconf, n_slots, dense, bsz, max(n_ins // 2, 4 * bsz),
+        hidden, vocab_per_slot=max(args.vocab // 25, 200),
+    )
+    emit({"metric": "hbm_cache_promotion_patch_rows",
+          "value": res.get("cached_promotion_patch_rows"), "unit": "rows",
+          "vs_baseline": res.get("uncached_promotion_patch_rows"),
+          "backend": backend, **res})
+
+
 def _rank(q: float, n: int) -> int:
     """Nearest-rank percentile index into a sorted length-n list
     (``int(n * q)`` would return the sample MAX for n <= 100 at q=0.99)."""
@@ -1439,6 +1578,7 @@ def run_all(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
     stage("headline", stage_headline, *common, model_name="ctr_dnn",
           with_naive=True)
     stage("pass_boundary", stage_pass_boundary, *common)
+    stage("hbm_cache", stage_hbm_cache, *common)
     stage("device_profile", stage_device_profile, *common, scan_k=8)
     stage("pallas", stage_pallas, backend)
     stage("ops", stage_ops, backend, args)
@@ -1493,6 +1633,11 @@ def main() -> None:
                     help="serial vs overlapped pass-lifecycle ablation: "
                          "inter-pass device-idle gap, multi-pass samples/s "
                          "and bit-exactness of the two stores")
+    ap.add_argument("--hbm-cache", action="store_true",
+                    help="uncached vs HBM-cached pass lifecycle on a "
+                         "skewed (Zipf) key stream: begin-pass promotion "
+                         "patch rows, hit rate, inter-pass gap and "
+                         "bit-exactness of the two stores")
     ap.add_argument("--pallas", action="store_true",
                     help="Pallas vs XLA gather/scatter at table shapes")
     ap.add_argument("--ops", action="store_true",
@@ -1543,6 +1688,8 @@ def main() -> None:
         fail_metric, fail_unit = f"{args.model}_device_profile", "ms/step"
     elif args.pass_boundary:
         fail_metric, fail_unit = "pass_boundary_gap_ms", "ms"
+    elif args.hbm_cache:
+        fail_metric, fail_unit = "hbm_cache_promotion_patch_rows", "rows"
     elif args.trainer_path:
         fail_metric = f"{args.model}_trainer_path_samples_per_sec"
         fail_unit = "samples/sec"
@@ -1591,6 +1738,10 @@ def main() -> None:
 
     if args.pass_boundary:
         stage_pass_boundary(*common)
+        return
+
+    if args.hbm_cache:
+        stage_hbm_cache(*common)
         return
 
     if args.trainer_path:

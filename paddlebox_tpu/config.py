@@ -95,6 +95,12 @@ class _Flags:
         # regardless of SparseTableConfig.overlap_pass_boundary — the
         # operational escape hatch when an overlap bug is suspected
         "overlap_pass_boundary": True,
+        # device-resident embedding engine kill switch (sparse/engine/):
+        # PBOX_HBM_CACHE=0 disables the persistent HBM hot-key cache
+        # process-wide regardless of SparseTableConfig.hbm_cache_rows —
+        # every pass then round-trips its full working set through the
+        # host store again (the pre-engine lifecycle, bit-exact by test)
+        "hbm_cache": True,
     }
 
     def __getattr__(self, name: str):
@@ -414,6 +420,24 @@ class SparseTableConfig:
     # per-bucket work (independent by construction — hash-partitioned keys)
     # over this many threads with per-bucket locking.  <= 1 = serial.
     store_threads: int = 4
+
+    # -- device-resident embedding engine (sparse/engine/) ---------------- #
+    # Capacity (rows) of the persistent HBM hot-key cache that lives ABOVE
+    # the per-pass working set: hot rows stay device-resident across
+    # passes (LFU-with-aging admission from each census) and census
+    # resolve fetches only cache MISSES from the host store, shrinking
+    # the begin-pass promotion patch from O(working set) to O(cold keys)
+    # — the reference's per-device BoxPS embedding cache (PAPER.md §2.7).
+    # 0 disables; PBOX_HBM_CACHE=0 is the process-wide kill switch.  The
+    # sharded table splits this capacity evenly across its shards.  The
+    # cached lifecycle is bit-exact vs cache-off (tests/test_hbm_cache.py);
+    # dirty rows drain to the host store at every checkpoint/shrink/delta
+    # barrier, so persistence never sees a stale view.
+    hbm_cache_rows: int = 1 << 16
+    # per-pass frequency decay of the cache's LFU-with-aging policy: a
+    # resident row untouched for k passes keeps freq * aging^k and becomes
+    # evictable once that falls below a fresh candidate's 1.0
+    hbm_cache_aging: float = 0.8
 
     @property
     def row_width(self) -> int:

@@ -59,13 +59,19 @@ def write_synth_files(
     max_ads_per_pv: int = 4,
     cmatch_values: Sequence[int] = (222, 223),
     n_task_labels: int = 0,
+    zipf_a: float = 0.0,
 ) -> list[str]:
     """Writes slot-text files; returns their paths.
 
     with_logkey adds the ``search_id:rank:cmatch`` prefix and groups
     consecutive instances into page-views sharing a search_id, with ranks
     1..n_ads (the PV-merge / rank_attention input shape,
-    reference data_feed.h:756-774)."""
+    reference data_feed.h:756-774).
+
+    zipf_a > 1 draws each slot's local key ids from a (vocab-clipped)
+    Zipf(a) distribution instead of uniform — the skewed key stream of
+    real CTR traffic, where a small hot set dominates every pass (what
+    the HBM hot-key cache ablation needs a synthetic stand-in for)."""
     rng = np.random.default_rng(seed)
     # latent per-key weights drive the label
     key_w = rng.normal(size=(n_sparse_slots, vocab_per_slot)) * signal_scale
@@ -90,7 +96,13 @@ def write_synth_files(
                     slot_keys: list[np.ndarray] = []
                     for s in range(n_sparse_slots):
                         n = int(rng.integers(1, max_keys_per_slot + 1))
-                        local = rng.integers(0, vocab_per_slot, size=n)
+                        if zipf_a > 1.0:
+                            # hot head at low ids; clip the unbounded tail
+                            local = np.minimum(
+                                rng.zipf(zipf_a, size=n), vocab_per_slot
+                            ) - 1
+                        else:
+                            local = rng.integers(0, vocab_per_slot, size=n)
                         # globally unique feasign: slot s owns [s*vocab, (s+1)*vocab)
                         slot_keys.append(local + s * vocab_per_slot + 1)
                         logit += key_w[s, local].mean()

@@ -21,6 +21,23 @@ is a dense HBM array and the host has already resolved keys to row indices
     completed, so cross-tile duplicates are plain sequential
     read-modify-writes.
 
+Cache-tier ops (sparse/engine/hbm_cache.py — the persistent HBM hot-row
+cache above the per-pass working set):
+
+  * ``pallas_gather_slots(table, slots)`` — row gather where a NEGATIVE
+    slot yields a zero row (the miss sentinel of the cache's key→slot
+    resolve), so a hit/miss-mixed slot vector gathers in one call.
+  * ``pallas_scatter_rows(table, slots, rows)`` — in-place row REPLACE
+    (the cache admission/update write: new row values overwrite the slot,
+    nothing accumulates).  Negative slots are dropped; duplicate slots
+    resolve last-occurrence-wins (within a tile via an explicit
+    last-of-group mask, across tiles by grid-step ordering).
+  * ``pallas_sorted_search(hay, n_real, q)`` — vectorized branchless
+    binary search of uint64 keys (carried as uint32 (hi, lo) pairs — JAX
+    arrays are x64-disabled by default) over a sorted haystack: the
+    device-side key→slot resolve of the cache directory.  Returns the
+    sorted position per query, -1 when absent.
+
 Enabled via ``flags.use_pallas_sparse`` (default off): XLA's native
 gather/scatter is already tuned for these shapes, so these kernels are the
 explicit-DMA variant to benchmark against it on real hardware; correctness
@@ -53,6 +70,18 @@ def _on_tpu() -> bool:
         return jax.devices()[0].platform == "tpu"
     except Exception:
         return False
+
+
+def _compiler_params(**kw):
+    """jax-version compat: 0.4.x exposes ``TPUCompilerParams`` (without the
+    ``has_side_effects`` field); newer jax renames it ``CompilerParams``.
+    Unknown fields are dropped — they only tune real-TPU lowering, which
+    interpret mode (every CI run here) never reaches."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    try:
+        return cls(**kw)
+    except TypeError:
+        return cls()
 
 
 def _gather_kernel(idx_ref, values_ref, out_ref, scratch, sems, *, tile):
@@ -206,5 +235,207 @@ def pallas_scatter_add(values: jax.Array, idx: jax.Array, delta: jax.Array,
         grid_spec=grid_spec,
         input_output_aliases={2: 0},  # (idx, delta, values) -> values out
         interpret=interpret or not _on_tpu(),
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_compiler_params(has_side_effects=True),
     )(idx, delta, values)
+
+
+# --------------------------------------------------------------------------- #
+# Cache-tier kernels (sparse/engine/hbm_cache.py)
+# --------------------------------------------------------------------------- #
+def _gather_slots_kernel(idx_ref, table_ref, out_ref, scratch, sems, *, tile):
+    """One grid step DMAs ``tile`` rows into VMEM (negative slots clamp to
+    row 0 for the copy) and emits them with missed rows zeroed."""
+    g = pl.program_id(0)
+    for i in range(tile):
+        pltpu.make_async_copy(
+            table_ref.at[pl.ds(jnp.maximum(idx_ref[g * tile + i], 0), 1), :],
+            scratch.at[pl.ds(i, 1), :],
+            sems.at[i],
+        ).start()
+    for i in range(tile):
+        pltpu.make_async_copy(
+            table_ref.at[pl.ds(jnp.maximum(idx_ref[g * tile + i], 0), 1), :],
+            scratch.at[pl.ds(i, 1), :],
+            sems.at[i],
+        ).wait()
+    ids = jnp.stack([idx_ref[g * tile + i] for i in range(tile)])
+    out_ref[:] = jnp.where((ids >= 0)[:, None], scratch[:], 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_gather_slots(table: jax.Array, slots: jax.Array,
+                        interpret: bool = False) -> jax.Array:
+    """table: [C, W] (HBM); slots: int32 [K], negative = miss.  Returns
+    [K, W]: ``table[slot]`` per slot, the zero row where slot < 0 —
+    identical to ``jnp.where(slots[:, None] >= 0,
+    jnp.take(table, jnp.maximum(slots, 0), axis=0), 0.0)``."""
+    k = slots.shape[0]
+    w = table.shape[1]
+    if k == 0:
+        return jnp.zeros((0, w), table.dtype)
+    tile = _tile_for(k)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(k // tile,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # table stays in HBM
+        out_specs=pl.BlockSpec(
+            (tile, w), lambda g, idx: (g, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tile, w), table.dtype),
+            pltpu.SemaphoreType.DMA((tile,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_gather_slots_kernel, tile=tile),
+        out_shape=jax.ShapeDtypeStruct((k, w), table.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret or not _on_tpu(),
+    )(slots, table)
+
+
+def _scatter_rows_kernel(idx_ref, rows_ref, table_ref, out_ref, sems, *,
+                         tile):
+    """One grid step REPLACES ``tile`` table rows with their new values.
+    Within a tile only the LAST occurrence of each slot stores (explicit
+    last-of-group mask — no two in-flight stores ever target one row);
+    across tiles later grid steps store after earlier ones completed, so
+    duplicate slots resolve last-occurrence-wins end to end.  Negative
+    slots store nothing.  All stores go through the aliased output ref."""
+    del table_ref  # aliased into out_ref; never touched directly
+    g = pl.program_id(0)
+    ids = jnp.stack([idx_ref[g * tile + i] for i in range(tile)])
+    dup_later = (ids[:, None] == ids[None, :]) & (
+        jnp.arange(tile)[None, :] > jnp.arange(tile)[:, None]
+    )
+    is_last = ~dup_later.any(axis=1)
+    for i in range(tile):
+        cp = pltpu.make_async_copy(
+            rows_ref.at[pl.ds(i, 1), :],
+            out_ref.at[pl.ds(jnp.maximum(ids[i], 0), 1), :],
+            sems.at[i],
+        )
+        ok = (ids[i] >= 0) & is_last[i]
+
+        @pl.when(ok)
+        def _(cp=cp):
+            cp.start()
+            cp.wait()
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_scatter_rows(table: jax.Array, slots: jax.Array, rows: jax.Array,
+                        interpret: bool = False) -> jax.Array:
+    """In-place ``table[slots] = rows`` (donating table via aliasing).
+
+    table: [C, W]; slots: int32 [K] (negative = dropped, duplicates =
+    last occurrence wins); rows: [K, W].  The replace (not accumulate)
+    write of the cache admission/update path."""
+    k = slots.shape[0]
+    if k == 0:
+        return table
+    w = table.shape[1]
+    tile = _tile_for(k)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(k // tile,),
+        in_specs=[
+            pl.BlockSpec(
+                (tile, w), lambda g, idx: (g, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),  # table aliased in HBM
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((tile,))],
+    )
+    return pl.pallas_call(
+        functools.partial(_scatter_rows_kernel, tile=tile),
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        grid_spec=grid_spec,
+        input_output_aliases={2: 0},  # (slots, rows, table) -> table out
+        interpret=interpret or not _on_tpu(),
+        compiler_params=_compiler_params(has_side_effects=True),
+    )(slots, rows, table)
+
+
+def _sorted_search_kernel(nreal_ref, hay_ref, q_ref, out_ref, *, cbits,
+                          cpad):
+    """Branchless vectorized lower-bound over a pow2-padded sorted
+    haystack of uint64 keys carried as (hi, lo) uint32 pairs: cbits bit-
+    descent steps, each probing one key per query lane.  A query matches
+    only a position below ``n_real`` (padding is 0xFFFFFFFF sentinels,
+    which a real all-ones key must not false-positive against)."""
+    qh = q_ref[:, 0]
+    ql = q_ref[:, 1]
+    hh = hay_ref[:, 0]
+    hl = hay_ref[:, 1]
+    pos = jnp.zeros(qh.shape, jnp.int32)
+    for b in range(cbits - 1, -1, -1):
+        cand = pos + (1 << b)
+        kh = jnp.take(hh, cand - 1)
+        kl = jnp.take(hl, cand - 1)
+        lt = (kh < qh) | ((kh == qh) & (kl < ql))
+        pos = jnp.where(lt, cand, pos)
+    safe = jnp.minimum(pos, cpad - 1)
+    found = (
+        (pos < nreal_ref[0])
+        & (jnp.take(hh, safe) == qh)
+        & (jnp.take(hl, safe) == ql)
+    )
+    out_ref[:] = jnp.where(found, pos, -1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_sorted_search(hay: jax.Array, n_real: jax.Array, q: jax.Array,
+                         interpret: bool = False) -> jax.Array:
+    """hay: uint32 [C, 2] — (hi, lo) halves of uint64 keys, sorted by the
+    key they encode, valid in [0, n_real), padded to pow2 C with
+    0xFFFFFFFF pairs.  n_real: int32 [1].  q: uint32 [Q, 2].  Returns
+    int32 [Q]: each query's position in hay, -1 when absent — the
+    device-side key→slot resolve (the host equivalent is one
+    ``np.searchsorted`` + equality check)."""
+    c = hay.shape[0]
+    nq = q.shape[0]
+    if nq == 0:
+        return jnp.zeros((0,), jnp.int32)
+    if c == 0:
+        return jnp.full((nq,), -1, jnp.int32)
+    if c & (c - 1):
+        raise ValueError(f"hay must be pow2-padded, got {c}")
+    tile = _tile_for(nq)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # n_real
+        grid=(nq // tile,),
+        in_specs=[
+            pl.BlockSpec(
+                (c, 2), lambda g, nreal: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (tile, 2), lambda g, nreal: (g, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile,), lambda g, nreal: (g,), memory_space=pltpu.VMEM
+        ),
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _sorted_search_kernel, cbits=c.bit_length() - 1, cpad=c
+        ),
+        out_shape=jax.ShapeDtypeStruct((nq,), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret or not _on_tpu(),
+    )(n_real, hay, q)
+
+
+def split_u64(keys) -> jnp.ndarray:
+    """np.uint64 [N] -> uint32 [N, 2] (hi, lo) device array — the key
+    representation the sorted-search kernel takes (JAX arrays default to
+    x64-disabled, so uint64 keys cannot ride a device array directly)."""
+    import numpy as np
+
+    keys = np.asarray(keys, dtype=np.uint64)
+    out = np.empty((keys.shape[0], 2), dtype=np.uint32)
+    out[:, 0] = (keys >> np.uint64(32)).astype(np.uint32)
+    out[:, 1] = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return jnp.asarray(out)

@@ -132,6 +132,12 @@ class ShardedSparseTable(SparseTable):
         # pass's per-shard scratch region (pass 1 falls back to
         # conf.plan_scratch_rows)
         self._last_serve_n = 0
+        # device-resident embedding engine, sharded: one HbmCache per LOCAL
+        # shard (conf.hbm_cache_rows split evenly across shards), built
+        # lazily by _caches(); single-process only — the multi-host census
+        # allgather path keeps the uncached lifecycle (PR-5 scope split)
+        self._shard_cache_list: list = []
+        self._cache_plans = None
         # mesh positions (== global shard ids) whose devices this process
         # owns; single-process: every position.  The want-matrix allgather in
         # plan_group assumes each process's positions are one contiguous run
@@ -152,6 +158,43 @@ class ShardedSparseTable(SparseTable):
     def n_local(self) -> int:
         """Devices (== shards) owned by this process."""
         return self._local_pos.shape[0]
+
+    # -- device-resident cache (per-shard) -------------------------------- #
+    def _get_cache(self):
+        """The single-chip cache object is unused here — the sharded
+        lifecycle goes through the per-shard list (_caches)."""
+        return None
+
+    def _caches(self) -> list:
+        """One HbmCache per local shard (lazily built; empty when disabled
+        or multi-process).  Capacity splits evenly across shards."""
+        if not self._cache_tried:
+            with self._cache_lock:
+                if not self._cache_tried:
+                    from paddlebox_tpu.config import flags
+
+                    per_shard = self.conf.hbm_cache_rows // self.n_shards
+                    if (
+                        per_shard > 0
+                        and flags.hbm_cache
+                        and not is_multiprocess()
+                    ):
+                        from paddlebox_tpu.sparse.engine import HbmCache
+
+                        self._shard_cache_list = [
+                            HbmCache(
+                                per_shard,
+                                self.conf.row_width + 1,
+                                aging=self.conf.hbm_cache_aging,
+                            )
+                            for _ in range(self.n_local)
+                        ]
+                    self._cache_tried = True
+        return self._shard_cache_list
+
+    def abort_pass(self) -> None:
+        self._cache_plans = None
+        super().abort_pass()
 
     # -- pass lifecycle --------------------------------------------------- #
     def _shard_split(self, pk: np.ndarray):
@@ -198,23 +241,58 @@ class ShardedSparseTable(SparseTable):
         # single-process only (prepare_pass gates): the local census IS the
         # global census, no allgather needed off-thread
         pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
-        with self._overlay_lock:
-            stage_seq = self._wb_seq
-            entries = list(self._overlay)
+        cache_keys, stage_seq, entries = self._stage_snapshot()
         owner, shard_keys, row_within = self._shard_split(pk)
         w = self.conf.row_width
         cap = self._sharded_cap(shard_keys)
         lvals = np.zeros((self.n_local, cap, w + 1), dtype=np.float32)
         for i, o in enumerate(self._local_pos):
             sk = shard_keys[o]
-            lvals[i, : sk.shape[0]] = self._resolve_or_init(
-                sk, _entries=entries
+            ok = self._stage_resolve(
+                sk,
+                lvals[i, : sk.shape[0]],
+                cache_keys[i] if cache_keys else None,
+                entries,
             )
+            if not ok:  # fault-injected promotion fetch: stage => discard
+                return pk, owner, shard_keys, row_within, None, stage_seq
         telemetry.histogram(
             "pass.promote_seconds",
             "background next-pass census resolve + init + staging wall time",
         ).observe(time.perf_counter() - t0)
         return pk, owner, shard_keys, row_within, lvals, stage_seq
+
+    def _cached_sync_resolve(self, caches, shard_keys, lvals, pk) -> list:
+        """Synchronous per-shard census resolve against the HBM cache:
+        fill only each shard's cache misses from the host store.  A
+        fault-injected promotion fetch (site ``cache.fetch``) degrades the
+        whole pass to the uncached host resolve — dirty rows drain first,
+        census keys leave every cache — and returns [] so the caller skips
+        the device hit-fill."""
+        from paddlebox_tpu import telemetry
+        from paddlebox_tpu.utils import faults
+
+        try:
+            for i, o in enumerate(self._local_pos):
+                sk = shard_keys[o]
+                if not sk.shape[0]:
+                    continue
+                hit = caches[i].lookup(sk).hit_mask
+                miss_pos = np.nonzero(~hit)[0]
+                if miss_pos.shape[0]:
+                    lvals[i, miss_pos] = self._cache_fetch_rows(sk[miss_pos])
+        except faults.FaultInjected:
+            telemetry.counter(
+                "cache.fetch_fallbacks",
+                "promotion fetches degraded to the full host resolve",
+            ).inc()
+            self._cache_degrade(pk)
+            lvals[:] = 0.0
+            for i, o in enumerate(self._local_pos):
+                sk = shard_keys[o]
+                lvals[i, : sk.shape[0]] = self._resolve_or_init(sk)
+            return []
+        return caches
 
     def begin_pass(self, pass_keys: np.ndarray) -> None:
         """Promote the pass working set (this process's shards) to device.
@@ -235,7 +313,9 @@ class ShardedSparseTable(SparseTable):
         lvals = None
         if payload is not None:
             spk, owner, shard_keys, row_within, svals, _ = payload
-            if (
+            if svals is None:  # fault-injected stage fetch: sync fallback
+                stats.add("pass.stage_discards")
+            elif (
                 np.array_equal(spk, pk)
                 and svals.shape[1] == self._sharded_cap(shard_keys)
                 and svals.shape[0] == self.n_local
@@ -249,20 +329,51 @@ class ShardedSparseTable(SparseTable):
                         )
             else:
                 stats.add("pass.stage_discards")
+        caches = self._caches()
         if lvals is None:
             owner, shard_keys, row_within = self._shard_split(pk)
             cap = self._sharded_cap(shard_keys)
             # materialize only the local shards: rows come from this
             # process's host store (each process persists exactly its owned
             # shards), and fresh keys init key-deterministically
-            # (_key_uniform), so any process layout produces identical rows
+            # (_key_uniform), so any process layout produces identical rows.
+            # With the HBM cache, the host supplies only the cache MISSES
+            # per shard — the hit positions are filled from device below.
             lvals = np.zeros((self.n_local, cap, w + 1), dtype=np.float32)
-            for i, o in enumerate(self._local_pos):
-                sk = shard_keys[o]
-                lvals[i, : sk.shape[0]] = self._resolve_or_init(sk)
+            if caches:
+                caches = self._cached_sync_resolve(caches, shard_keys, lvals, pk)
+            else:
+                for i, o in enumerate(self._local_pos):
+                    sk = shard_keys[o]
+                    lvals[i, : sk.shape[0]] = self._resolve_or_init(sk)
         sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         self.values = global_from_local(sharding, jnp.asarray(lvals[:, :, :w]))
         self.g2sum = global_from_local(sharding, jnp.asarray(lvals[:, :, w]))
+        self._cache_plans = None
+        if caches:
+            # current hits never touch the host: one device gather+scatter
+            # per shard straight out of its persistent cache
+            from paddlebox_tpu import telemetry
+
+            plans, total_hits = [], 0
+            for i, o in enumerate(self._local_pos):
+                sk = shard_keys[o]
+                plan = caches[i].lookup(sk)
+                if plan.n_hits:
+                    hr = caches[i].gather_rows(plan.hit_slots)
+                    rp = jnp.asarray(plan.hit_pos)
+                    self.values = self.values.at[o, rp].set(hr[:, :w])
+                    self.g2sum = self.g2sum.at[o, rp].set(hr[:, w])
+                caches[i].touch(plan)
+                plans.append(plan)
+                total_hits += plan.n_hits
+            self._cache_plans = plans
+            self.last_cache_hits = total_hits
+            self.last_cache_misses = pk.shape[0] - total_hits
+            telemetry.gauge(
+                "cache.hit_rate",
+                "fraction of the pass census served from the HBM cache",
+            ).set(total_hits / max(pk.shape[0], 1))
         self._shard_keys = shard_keys
         self._census_index = None  # stale: points at the previous census
         self._shard_live = np.asarray(
@@ -279,25 +390,95 @@ class ShardedSparseTable(SparseTable):
         )
         self._observe_gap()
 
-    def end_pass(self) -> None:
-        if not self._in_pass:
-            raise RuntimeError("no pass in flight")
-        # drop (never eagerly close) the native index: a prefetch producer
-        # may still hold a reference — see SparseTable.end_pass
-        self._census_index = None
-        vals = local_view(self.values)  # [L, cap, W]
-        g2 = local_view(self.g2sum)  # [L, cap]
+    def _end_pass_cached_sharded(self, caches, plans) -> None:
+        """Cached sharded end-of-pass: per shard, hits + admits update
+        their cache slots with a device gather/scatter out of the stacked
+        working set, and only cold + evicted rows come D2H into ONE
+        globally-sorted write-back.  A fault at ``cache.admit`` degrades
+        every shard to the full write-back with the census leaving the
+        cache (rows route through the host exactly like cache-off)."""
+        from paddlebox_tpu import telemetry
+        from paddlebox_tpu.utils import faults
+
+        w = self.conf.row_width
+        empty_rows = np.empty((0, w + 1), np.float32)
+        upds = None
+        try:
+            faults.inject("cache.admit")
+            upds = [
+                caches[i].plan_update(self._shard_keys[o], plans[i])
+                for i, o in enumerate(self._local_pos)
+            ]
+        except faults.FaultInjected:
+            telemetry.counter(
+                "cache.admit_fallbacks",
+                "cache admissions degraded to the full host write-back",
+            ).inc()
+        if upds is None:
+            vals = local_view(self.values)
+            g2 = local_view(self.g2sum)
+            ks, vs = [], []
+            with self._cache_lock:
+                for i, o in enumerate(self._local_pos):
+                    sk = self._shard_keys[o]
+                    m = sk.shape[0]
+                    if m:
+                        ks.append(sk)
+                        vs.append(np.concatenate(
+                            [vals[i, :m], g2[i, :m, None]], axis=1
+                        ))
+                        caches[i].evict_keys(sk[plans[i].hit_mask])
+                self._sorted_write_back(ks, vs)
+            return
+        vals, g2 = self.values, self.g2sum
         ks, vs = [], []
+        n_evicted = 0
         for i, o in enumerate(self._local_pos):
             sk = self._shard_keys[o]
-            m = sk.shape[0]
-            if m:
-                ks.append(sk)
-                vs.append(np.concatenate([vals[i, :m], g2[i, :m, None]], axis=1))
+            plan, upd = plans[i], upds[i]
+            if sk.shape[0] == 0:
+                continue
+            victim_rows = empty_rows
+            upd_pos = np.concatenate([plan.hit_pos, upd.admit_pos])
+            if upd_pos.shape[0]:
+                if upd.victim_slots.shape[0]:
+                    victim_rows = np.asarray(
+                        caches[i].gather_rows(upd.victim_slots)
+                    )
+                rp = jnp.asarray(upd_pos)
+                src = jnp.concatenate(
+                    [vals[o, rp], g2[o, rp, None]], axis=1
+                )
+                caches[i].set_rows(
+                    np.concatenate([plan.hit_slots, upd.admit_slots]), src
+                )
+            cold = empty_rows
+            if upd.cold_pos.shape[0]:
+                cp = jnp.asarray(upd.cold_pos)
+                cold = np.asarray(
+                    jnp.concatenate([vals[o, cp], g2[o, cp, None]], axis=1)
+                )
+            ks += [sk[upd.cold_pos], upd.victim_keys]
+            vs += [cold, victim_rows]
+            n_evicted += int(upd.victim_slots.shape[0])
+        with self._cache_lock:
+            for i in range(len(caches)):
+                caches[i].commit_update(plans[i], upds[i])
+            self._sorted_write_back(ks, vs)
+        if n_evicted:
+            telemetry.counter(
+                "cache.evicted_rows",
+                "rows evicted from the HBM cache (written back to the host)",
+            ).inc(n_evicted)
+
+    def _sorted_write_back(self, ks: list, vs: list) -> None:
+        """One globally-sorted write-back from per-shard key/row pieces
+        (shards partition the key space, so the concat is unique; the
+        overlay's searchsorted reads and the bucketed merge both want
+        sorted keys)."""
+        ks = [k for k in ks if k.shape[0]]
+        vs = [v for v in vs if v.shape[0]]
         if ks:
-            # one globally-sorted write-back (shards partition the key
-            # space, so the concat is unique; the overlay's searchsorted
-            # reads and the bucketed merge both want sorted keys)
             k = np.concatenate(ks)
             v = np.concatenate(vs)
             order = np.argsort(k, kind="stable")
@@ -307,6 +488,30 @@ class ShardedSparseTable(SparseTable):
                 np.empty(0, np.uint64),
                 np.empty((0, self.conf.row_width + 1), np.float32),
             )
+
+    def end_pass(self) -> None:
+        if not self._in_pass:
+            raise RuntimeError("no pass in flight")
+        # drop (never eagerly close) the native index: a prefetch producer
+        # may still hold a reference — see SparseTable.end_pass
+        self._census_index = None
+        caches = self._caches()
+        plans, self._cache_plans = self._cache_plans, None
+        if caches and plans is not None:
+            self._end_pass_cached_sharded(caches, plans)
+        else:
+            vals = local_view(self.values)  # [L, cap, W]
+            g2 = local_view(self.g2sum)  # [L, cap]
+            ks, vs = [], []
+            for i, o in enumerate(self._local_pos):
+                sk = self._shard_keys[o]
+                m = sk.shape[0]
+                if m:
+                    ks.append(sk)
+                    vs.append(
+                        np.concatenate([vals[i, :m], g2[i, :m, None]], axis=1)
+                    )
+            self._sorted_write_back(ks, vs)
         self.values = None
         self.g2sum = None
         self._shard_keys = None

@@ -8,11 +8,14 @@ sorted lookup and the device never hashes — pull is a static-shape gather,
 push is a deduped scatter-add + fused sparse adagrad.
 """
 
+from paddlebox_tpu.sparse.engine import CachePlan, HbmCache
 from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
 from paddlebox_tpu.sparse.table import BatchPlan, SparseTable, pull_rows, push_and_update
 
 __all__ = [
     "BatchPlan",
+    "CachePlan",
+    "HbmCache",
     "SparseTable",
     "pull_rows",
     "push_and_update",

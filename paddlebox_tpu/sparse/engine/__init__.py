@@ -1,0 +1,17 @@
+"""Device-resident embedding engine — the persistent HBM tier.
+
+The multi-tier table the ROADMAP's BoxPS-equivalence goal names: a
+fixed-capacity device-resident hot-key cache (:class:`HbmCache`) persists
+ACROSS passes above the per-pass working set, the host ``BucketStore``
+(warm) and its ``.npz`` spill tier (cold).  Census resolve then fetches
+only cache MISSES from the host, shrinking the per-pass promotion patch
+from O(working set) to O(cold keys) — the ``pull_box_sparse`` /
+``push_box_sparse`` per-device embedding cache of the reference's
+closed-source core (PAPER.md §2.7), rebuilt TPU-native.
+"""
+
+from paddlebox_tpu.sparse.engine.hbm_cache import (  # noqa: F401
+    CachePlan,
+    HbmCache,
+    UpdatePlan,
+)

@@ -1,0 +1,322 @@
+"""HBM-resident hot-key row cache: the persistent device tier.
+
+The reference's BoxPS core keeps each device's hot sparse working set in an
+HBM hash table across passes (``pull_box_sparse``/``push_box_sparse``
+against a per-device embedding cache, PAPER.md §2.7); this is the
+TPU-native analog over the census-driven pass lifecycle: a fixed-capacity
+slot table whose ROWS (``[capacity, W+1]`` — value columns + g2sum) live as
+one JAX device array, with a host-side directory (keys, frequency/recency
+metadata, dirty flags) deciding membership once per pass from the census.
+
+Why the directory is host-side numpy while the rows are device-side JAX:
+every key decision in this system (census resolve, batch planning, shard
+routing) already happens on the host where dynamic shapes are free — the
+directory is ~tens of bytes per slot and mutates once per pass, while the
+rows are the multi-KB-per-slot payload whose round trip the cache exists to
+eliminate.  A device mirror of the sorted key index (uint32 (hi, lo) pairs)
+is built on demand for the Pallas sorted-search resolve when
+``flags.use_pallas_sparse`` is on; both resolve paths return identical
+plans.
+
+Policy: LFU with aging.  Every pass multiplies all resident frequencies by
+``aging`` and adds 1 to this census's hits; admission (at end_pass, from
+the pass census) fills free slots first, then evicts the
+lowest-(frequency, recency) resident slots not touched by the current pass
+whose aged frequency has fallen below a fresh candidate's (1.0).  Eviction
+and admission move only directory state here — the owning table moves the
+rows (device scatter for admits, D2H + host write-back for evictions: an
+evicted row is ALWAYS written back, dirty or not, so a pre-staged next
+pass that believed the key was cache-resident can be patched from the
+write-back log instead of reading a hole).
+
+Coherence contract (enforced by sparse/table.py): rows newer than the host
+store are marked ``dirty`` and must be drained (``drain()`` →
+``_write_back``) before anything reads the store as truth — checkpoint
+``state_dict``/``delta_state_dict``, ``n_features``, shrink, publish.
+``invalidate()`` drops membership without moving rows and is required
+whenever the store changes underneath the cache (restore, apply_delta,
+shrink's decay).  Thread-safety is the caller's: the owning table wraps
+directory mutation and its census-staging snapshot in one lock so a
+background stage never sees a half-updated (directory, write-back log)
+pair.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EMPTY_U64 = np.empty(0, dtype=np.uint64)
+_EMPTY_I32 = np.empty(0, dtype=np.int32)
+
+
+@dataclasses.dataclass
+class CachePlan:
+    """One census resolved against the cache directory.
+
+    hit_mask:  bool [n] aligned with the sorted unique census keys.
+    hit_pos:   int32 [H] census positions of the hits (ascending).
+    hit_slots: int32 [H] cache slot per hit, aligned with hit_pos.
+    """
+
+    hit_mask: np.ndarray
+    hit_pos: np.ndarray
+    hit_slots: np.ndarray
+
+    @property
+    def n_hits(self) -> int:
+        return int(self.hit_slots.shape[0])
+
+
+@dataclasses.dataclass
+class UpdatePlan:
+    """End-of-pass admission/eviction decision (directory-only; the owning
+    table moves the rows).  admit_* are parallel; victim_* are parallel;
+    every victim slot is reused by exactly one admit."""
+
+    admit_pos: np.ndarray  # int32 — census positions being admitted
+    admit_keys: np.ndarray  # uint64 — keys at those positions
+    admit_slots: np.ndarray  # int32 — slots they land in
+    victim_slots: np.ndarray  # int32 — evicted slots (⊆ admit_slots)
+    victim_keys: np.ndarray  # uint64 — keys leaving the cache
+    cold_pos: np.ndarray  # int32 — census misses NOT admitted (host-bound)
+
+
+class HbmCache:
+    def __init__(self, capacity: int, n_cols: int, aging: float = 0.8,
+                 device=None):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        if not 0.0 < aging < 1.0:
+            raise ValueError(f"aging must be in (0, 1), got {aging}")
+        self.capacity = int(capacity)
+        self.n_cols = int(n_cols)
+        self.aging = float(aging)
+        rows = jnp.zeros((self.capacity, self.n_cols), jnp.float32)
+        if device is not None:
+            rows = jax.device_put(rows, device)
+        self.rows: jax.Array = rows
+        # directory (slot-indexed)
+        self.keys = np.zeros(self.capacity, dtype=np.uint64)
+        self.used = np.zeros(self.capacity, dtype=bool)
+        self.freq = np.zeros(self.capacity, dtype=np.float64)
+        self.last_seen = np.full(self.capacity, -1, dtype=np.int64)
+        self.dirty = np.zeros(self.capacity, dtype=bool)
+        self.tick = 0
+        # sorted view for the key→slot resolve (rebuilt on membership change)
+        self._sorted_keys = _EMPTY_U64
+        self._sorted_slots = _EMPTY_I32
+        self._dev_index: Optional[tuple] = None  # lazy Pallas mirror
+
+    # -- introspection ---------------------------------------------------- #
+    @property
+    def resident(self) -> int:
+        return int(self.used.sum())
+
+    @property
+    def dirty_rows(self) -> int:
+        return int(self.dirty.sum())
+
+    def snapshot_keys(self) -> np.ndarray:
+        """The sorted resident-key array, safe to hand to another thread:
+        rebuilds REPLACE the array, they never mutate it in place (the
+        owning table still takes its cache lock around the grab so the
+        (keys, write-back seq) pair it snapshots is consistent)."""
+        return self._sorted_keys
+
+    @staticmethod
+    def hit_mask_in(sorted_keys: np.ndarray, pk: np.ndarray) -> np.ndarray:
+        """bool [n]: which of sorted unique ``pk`` are in ``sorted_keys``
+        — the snapshot-based membership test the staging thread uses."""
+        n = pk.shape[0]
+        if sorted_keys.shape[0] == 0 or n == 0:
+            return np.zeros(n, dtype=bool)
+        pos = np.searchsorted(sorted_keys, pk)
+        pos_c = np.minimum(pos, sorted_keys.shape[0] - 1)
+        return sorted_keys[pos_c] == pk
+
+    # -- resolve ---------------------------------------------------------- #
+    def _rebuild_index(self) -> None:
+        slots = np.nonzero(self.used)[0].astype(np.int32)
+        if slots.shape[0]:
+            order = np.argsort(self.keys[slots], kind="stable")
+            self._sorted_keys = self.keys[slots][order]
+            self._sorted_slots = slots[order]
+        else:
+            self._sorted_keys = _EMPTY_U64
+            self._sorted_slots = _EMPTY_I32
+        self._dev_index = None
+
+    def _device_positions(self, pk: np.ndarray) -> np.ndarray:
+        """Sorted-view positions of ``pk`` (-1 = miss) via the Pallas
+        sorted-search kernel over the device key mirror."""
+        from paddlebox_tpu.ops.pallas_sparse import (
+            pallas_sorted_search,
+            split_u64,
+        )
+
+        if self._dev_index is None:
+            n = self._sorted_keys.shape[0]
+            cpad = 1 << max(0, (n - 1).bit_length()) if n else 0
+            hay = np.full((cpad, 2), 0xFFFFFFFF, dtype=np.uint32)
+            if n:
+                hay[:n] = np.asarray(split_u64(self._sorted_keys))
+            self._dev_index = (
+                jnp.asarray(hay),
+                jnp.asarray([n], dtype=np.int32),
+            )
+        hay, n_real = self._dev_index
+        return np.asarray(pallas_sorted_search(hay, n_real, split_u64(pk)))
+
+    def lookup(self, pk: np.ndarray) -> CachePlan:
+        """Resolve a sorted unique census against the directory."""
+        from paddlebox_tpu.config import flags
+
+        n = pk.shape[0]
+        sk = self._sorted_keys
+        if n == 0 or sk.shape[0] == 0:
+            return CachePlan(np.zeros(n, dtype=bool), _EMPTY_I32, _EMPTY_I32)
+        if flags.use_pallas_sparse:
+            pos = self._device_positions(pk)
+            hit = pos >= 0
+        else:
+            pos = np.searchsorted(sk, pk)
+            pos = np.minimum(pos, sk.shape[0] - 1)
+            hit = sk[pos] == pk
+        hit_pos = np.nonzero(hit)[0].astype(np.int32)
+        return CachePlan(hit, hit_pos, self._sorted_slots[pos[hit]])
+
+    # -- policy ----------------------------------------------------------- #
+    def touch(self, plan: CachePlan) -> None:
+        """One pass observed: age every resident frequency, credit this
+        census's hits (metadata only — membership is untouched, so the
+        staging snapshot stays valid without the table lock)."""
+        if self.used.any():
+            self.freq[self.used] *= self.aging
+        if plan.n_hits:
+            self.freq[plan.hit_slots] += 1.0
+            self.last_seen[plan.hit_slots] = self.tick
+        self.tick += 1
+
+    def plan_update(self, pk: np.ndarray, plan: CachePlan) -> UpdatePlan:
+        """Admission/eviction for the finished pass's census: misses fill
+        free slots first, then evict the coldest non-census residents whose
+        aged frequency dropped below a fresh candidate's (1.0).  Pure
+        decision — ``commit_update`` applies it."""
+        miss_pos = np.nonzero(~plan.hit_mask)[0].astype(np.int32)
+        n_cand = miss_pos.shape[0]
+        free = np.nonzero(~self.used)[0].astype(np.int32)
+        n_free = min(n_cand, free.shape[0])
+        victim_slots = _EMPTY_I32
+        if n_cand > n_free:
+            evictable = self.used.copy()
+            evictable[plan.hit_slots] = False  # never evict a current hit
+            cand_slots = np.nonzero(evictable & (self.freq < 1.0))[0]
+            if cand_slots.shape[0]:
+                order = np.lexsort(
+                    (cand_slots, self.last_seen[cand_slots],
+                     self.freq[cand_slots])
+                )
+                n_evict = min(n_cand - n_free, cand_slots.shape[0])
+                victim_slots = cand_slots[order[:n_evict]].astype(np.int32)
+        n_admit = n_free + victim_slots.shape[0]
+        admit_pos = miss_pos[:n_admit]
+        admit_slots = np.concatenate([free[:n_free], victim_slots])
+        return UpdatePlan(
+            admit_pos=admit_pos,
+            admit_keys=pk[admit_pos],
+            admit_slots=admit_slots,
+            victim_slots=victim_slots,
+            victim_keys=self.keys[victim_slots],
+            cold_pos=miss_pos[n_admit:],
+        )
+
+    def commit_update(self, plan: CachePlan, upd: UpdatePlan) -> None:
+        """Apply an UpdatePlan to the directory: victims leave, admits
+        enter (fresh frequency 1.0), and every row the pass touched —
+        surviving hits and admits — is now newer than the host store."""
+        if upd.victim_slots.shape[0]:
+            self.used[upd.victim_slots] = False
+            self.dirty[upd.victim_slots] = False
+        if upd.admit_slots.shape[0]:
+            self.keys[upd.admit_slots] = upd.admit_keys
+            self.used[upd.admit_slots] = True
+            self.freq[upd.admit_slots] = 1.0
+            self.last_seen[upd.admit_slots] = self.tick
+            self.dirty[upd.admit_slots] = True
+        if plan.n_hits:
+            self.dirty[plan.hit_slots] = True
+        if upd.admit_slots.shape[0] or upd.victim_slots.shape[0]:
+            self._rebuild_index()
+
+    def evict_keys(self, keys: np.ndarray) -> int:
+        """Drop ``keys`` from the directory WITHOUT moving rows — the
+        degraded paths (cache.fetch / cache.admit faults) use this after
+        routing the same keys' current rows to the host tier.  Unknown
+        keys are ignored; returns the number actually evicted."""
+        mask = self.hit_mask_in(self._sorted_keys, np.asarray(keys))
+        if not mask.any():
+            return 0
+        pos = np.searchsorted(self._sorted_keys, np.asarray(keys)[mask])
+        slots = self._sorted_slots[pos]
+        self.used[slots] = False
+        self.dirty[slots] = False
+        self._rebuild_index()
+        return int(slots.shape[0])
+
+    # -- row movement ------------------------------------------------------ #
+    def gather_rows(self, slots: np.ndarray) -> jax.Array:
+        """Device gather of ``slots`` rows (Pallas cache-slot gather when
+        the flag is on, XLA take otherwise — identical results)."""
+        from paddlebox_tpu.config import flags
+
+        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        if flags.use_pallas_sparse:
+            from paddlebox_tpu.ops.pallas_sparse import pallas_gather_slots
+
+            return pallas_gather_slots(self.rows, idx)
+        return jnp.take(self.rows, idx, axis=0)
+
+    def set_rows(self, slots: np.ndarray, rows: jax.Array) -> None:
+        """Device scatter-replace of ``rows`` into ``slots`` (Pallas
+        cache-slot scatter when the flag is on)."""
+        from paddlebox_tpu.config import flags
+
+        if np.asarray(slots).shape[0] == 0:
+            return
+        idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        if flags.use_pallas_sparse:
+            from paddlebox_tpu.ops.pallas_sparse import pallas_scatter_rows
+
+            self.rows = pallas_scatter_rows(self.rows, idx, rows)
+        else:
+            self.rows = self.rows.at[idx].set(rows)
+
+    # -- coherence --------------------------------------------------------- #
+    def drain(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys sorted, rows [n, n_cols]) of every DIRTY slot, marking
+        them clean — the barrier half of the coherence contract: after a
+        drain lands through the table's write-back path, the host store is
+        truth again for every resident key."""
+        d = np.nonzero(self.dirty)[0]
+        if d.shape[0] == 0:
+            return _EMPTY_U64, np.empty((0, self.n_cols), dtype=np.float32)
+        keys = self.keys[d]
+        order = np.argsort(keys, kind="stable")
+        rows = np.asarray(self.gather_rows(d[order].astype(np.int32)))
+        self.dirty[d] = False
+        return keys[order], rows
+
+    def invalidate(self) -> None:
+        """Forget every resident key without moving rows — required when
+        the host store changed underneath (restore, apply_delta, shrink's
+        decay/evict).  Callers needing the rows preserved drain() first."""
+        self.used[:] = False
+        self.dirty[:] = False
+        self.freq[:] = 0.0
+        self.last_seen[:] = -1
+        self._rebuild_index()

@@ -360,7 +360,20 @@ class BucketStore:
     def stats(self) -> dict:
         """Bucket-by-bucket size/finiteness report WITHOUT materializing a
         global copy (the pre-publish check must not be the thing that OOMs
-        the day-loop host at 1e8+ features)."""
+        the day-loop host at 1e8+ features).  ``spilled_buckets`` /
+        ``resident_rows`` report host-tier pressure (captured BEFORE the
+        scan below faults spilled buckets back in): how much of the warm
+        tier has fallen to disk and how many rows are actually RAM-held —
+        the inputs to HBM-cache sizing and the bench ablation's
+        host-pressure column."""
+        spilled_buckets = int(self._spilled.sum())
+        resident_rows = int(
+            sum(
+                int(self._counts[b])
+                for b in range(self.n_buckets)
+                if self._keys[b] is not None
+            )
+        )
         n_bytes = 0
         finite = True
         for b in range(self.n_buckets):
@@ -371,7 +384,13 @@ class BucketStore:
                 n_bytes += int(bk.nbytes + bv.nbytes)
                 if finite:
                     finite = bool(np.isfinite(bv).all())
-        return {"n": self.n, "bytes": n_bytes, "finite": finite}
+        return {
+            "n": self.n,
+            "bytes": n_bytes,
+            "finite": finite,
+            "spilled_buckets": spilled_buckets,
+            "resident_rows": resident_rows,
+        }
 
     def materialize(self) -> Tuple[np.ndarray, np.ndarray]:
         """Whole store as (keys, vals), globally key-sorted.  Hash bucketing

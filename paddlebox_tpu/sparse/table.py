@@ -180,6 +180,22 @@ class SparseTable:
         self._stage_future: Optional[Future] = None
         self._patch_log: list = []  # write-backs newer than a pending stage
         self._last_end_t: Optional[float] = None
+        # -- device-resident embedding engine (sparse/engine/) ------------ #
+        # A persistent HBM hot-key cache above the per-pass working set:
+        # begin_pass fetches only cache misses from the host store and
+        # fills hits with a device gather (they never leave HBM); end_pass
+        # updates resident rows in place, admits new hot keys (LFU with
+        # aging) and writes back only cold + evicted rows.  Dirty rows
+        # drain through _write_back at every flush() barrier, so
+        # checkpoint/shrink/delta always see a coherent host store.
+        # _cache_lock makes (directory, write-back log) mutations atomic
+        # against the staging thread's snapshot.
+        self._cache = None
+        self._cache_tried = False
+        self._cache_lock = threading.Lock()
+        self._cache_plan = None
+        self.last_cache_hits = 0  # bench/ablation introspection
+        self.last_cache_misses = 0  # == the begin-pass promotion patch rows
         # stats
         self.missing_key_count = 0
 
@@ -211,6 +227,93 @@ class SparseTable:
                 vals[hit] = ev[pos_c[hit]]
                 found |= hit
         return vals, found
+
+    # -- device-resident cache helpers ------------------------------------ #
+    def _get_cache(self):
+        """Lazily build the persistent HBM hot-row cache (None when
+        disabled via conf.hbm_cache_rows=0 or PBOX_HBM_CACHE=0).  Creation
+        is double-checked under the cache lock: the staging thread's
+        snapshot may race the first begin_pass here."""
+        if not self._cache_tried:
+            with self._cache_lock:
+                if not self._cache_tried:
+                    from paddlebox_tpu.config import flags
+
+                    if self.conf.hbm_cache_rows > 0 and flags.hbm_cache:
+                        from paddlebox_tpu.sparse.engine import HbmCache
+
+                        self._cache = HbmCache(
+                            self.conf.hbm_cache_rows,
+                            self.conf.row_width + 1,
+                            aging=self.conf.hbm_cache_aging,
+                        )
+                    self._cache_tried = True
+        return self._cache
+
+    def _caches(self) -> list:
+        """Every cache this table owns (the sharded table overrides with
+        its per-shard list)."""
+        c = self._get_cache()
+        return [c] if c is not None else []
+
+    def _cache_fetch_rows(self, miss: np.ndarray, _entries=None) -> np.ndarray:
+        """Host-tier fetch of cache-MISS rows — the begin-pass promotion
+        patch, now O(cold keys).  Chaos site ``cache.fetch``: a failure
+        here must degrade to the full synchronous host resolve, never
+        corrupt rows (the callers catch and call _cache_degrade)."""
+        from paddlebox_tpu import telemetry
+        from paddlebox_tpu.utils import faults
+
+        faults.inject("cache.fetch")
+        t0 = time.perf_counter()
+        rows = self._resolve_or_init(miss, _entries=_entries)
+        telemetry.histogram(
+            "cache.miss_fetch_seconds",
+            "host-tier fetch of the census cache misses (promotion patch)",
+        ).observe(time.perf_counter() - t0)
+        return rows
+
+    def _cache_degrade(self, pk: np.ndarray) -> None:
+        """cache.fetch failed: push every dirty row to the host tier and
+        drop the census keys from the cache, so the pass can run fully
+        host-resolved (through the overlay) with zero stale rows."""
+        self._drain_cache()
+        caches = self._caches()
+        with self._cache_lock:
+            for c in caches:
+                c.evict_keys(pk)
+
+    def _drain_cache(self) -> None:
+        """Route every dirty cache row through the write-back path (one
+        globally-sorted merge across caches) so the host store becomes
+        truth for all resident keys.  Part of the flush() barrier."""
+        caches = self._caches()
+        if not caches:
+            return
+        with self._cache_lock:
+            ks, vs = [], []
+            for c in caches:
+                k, v = c.drain()
+                if k.shape[0]:
+                    ks.append(k)
+                    vs.append(v)
+            if not ks:
+                return
+            if len(ks) == 1:
+                self._write_back(ks[0], vs[0])
+            else:
+                k = np.concatenate(ks)
+                v = np.concatenate(vs)
+                order = np.argsort(k, kind="stable")
+                self._write_back(k[order], v[order])
+
+    def _invalidate_caches(self) -> None:
+        """Drop cache membership (no row movement) — required whenever the
+        host store changes underneath: restore, apply_delta, shrink."""
+        caches = self._caches()  # before the lock: creation takes it too
+        with self._cache_lock:
+            for c in caches:
+                c.invalidate()
 
     def _write_back(self, keys: np.ndarray, vals: np.ndarray) -> None:
         """Hand one pass's final rows to the host store: synchronous merge
@@ -271,10 +374,13 @@ class SparseTable:
         ).observe(time.perf_counter() - t0)
 
     def flush(self) -> None:
-        """Barrier on the pass-boundary pipeline: wait for every pending
+        """Barrier on the pass-boundary pipeline: drain dirty device-cache
+        rows into the write-back path, then wait for every pending
         background merge (re-raising the first failure).  Checkpointing
         (state_dict/delta_state_dict), shrink and load_state_dict call this
-        so persisted state never misses an in-flight write-back."""
+        so persisted state never misses an in-flight write-back OR a row
+        that only ever lived in the HBM cache."""
+        self._drain_cache()
         while self._merge_futures:
             self._merge_futures.pop(0).result()
 
@@ -322,6 +428,45 @@ class SparseTable:
         scratch = self._last_plan_k or self.conf.plan_scratch_rows
         return _next_pow2(n_keys + 1 + scratch)
 
+    def _stage_snapshot(self):
+        """Atomic (cache directories, overlay, write-back seq) snapshot for
+        a staging job.  One lock pair — _cache_lock then _overlay_lock, the
+        same order end_pass mutates under — guarantees the stage never
+        pairs a pre-eviction directory with a post-eviction overlay (which
+        would leave an evicted key's staged row a hole no patch covers)."""
+        caches = self._caches()  # before the lock: creation takes it too
+        with self._cache_lock:
+            cache_keys = [c.snapshot_keys() for c in caches]
+            with self._overlay_lock:
+                return cache_keys, self._wb_seq, list(self._overlay)
+
+    def _stage_resolve(self, pk: np.ndarray, out: np.ndarray, cache_keys,
+                       entries) -> bool:
+        """Fill ``out`` [n, W+1] for census ``pk`` on the staging thread:
+        with a cache, resolve ONLY the keys absent from the snapshot
+        directory (hits are filled from HBM at begin_pass; keys the
+        finishing pass evicts are always written back, so the begin_pass
+        patch covers the snapshot's staleness).  Returns False when the
+        promotion fetch was fault-injected — the stage is then consumed as
+        a discard and begin_pass falls back to its synchronous resolve."""
+        from paddlebox_tpu.utils import faults
+
+        if cache_keys is None:
+            out[:] = self._resolve_or_init(pk, _entries=entries)
+            return True
+        from paddlebox_tpu.sparse.engine import HbmCache
+
+        hit = HbmCache.hit_mask_in(cache_keys, pk)
+        miss_pos = np.nonzero(~hit)[0]
+        try:
+            if miss_pos.shape[0]:
+                out[miss_pos] = self._cache_fetch_rows(
+                    pk[miss_pos], _entries=entries
+                )
+        except faults.FaultInjected:
+            return False
+        return True
+
     def _stage_job(self, pass_keys):
         from paddlebox_tpu import telemetry
 
@@ -329,13 +474,16 @@ class SparseTable:
         if callable(pass_keys):
             pass_keys = pass_keys()
         pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
-        with self._overlay_lock:
-            stage_seq = self._wb_seq
-            entries = list(self._overlay)
+        cache_keys, stage_seq, entries = self._stage_snapshot()
         w = self.conf.row_width
         cap = self._stage_cap(pk.shape[0])
         vals = np.zeros((cap, w + 1), dtype=np.float32)
-        vals[: pk.shape[0]] = self._resolve_or_init(pk, _entries=entries)
+        ok = self._stage_resolve(
+            pk, vals[: pk.shape[0]],
+            cache_keys[0] if cache_keys else None, entries,
+        )
+        if not ok:
+            return pk, None, stage_seq
         telemetry.histogram(
             "pass.promote_seconds",
             "background next-pass census resolve + init + staging wall time",
@@ -392,6 +540,12 @@ class SparseTable:
         if payload is None:
             return None
         spk, vals, _ = payload
+        if vals is None:
+            # the staging thread's promotion fetch was fault-injected
+            # (site cache.fetch): consume the stage as a discard and let
+            # begin_pass run its synchronous resolve
+            stats.add("pass.stage_discards")
+            return None
         if vals.shape[0] != cap or not np.array_equal(spk, pk):
             # census changed between staging and begin_pass (or the scratch
             # sizing moved): the stage is stale — resolve synchronously
@@ -463,11 +617,38 @@ class SparseTable:
         ).observe(time.monotonic() - self._last_end_t)
         self._last_end_t = None
 
+    def _cache_plan_and_fill(self, cache, pk: np.ndarray, v: jax.Array):
+        """Resolve the census against the cache directory, fill every hit
+        position of the device buffer ``v`` [cap, W+1] straight from HBM
+        (hits never touch the host), and record the pass's plan + hit-rate
+        telemetry.  Returns (plan, v)."""
+        from paddlebox_tpu import telemetry
+
+        plan = cache.lookup(pk)
+        if plan.n_hits:
+            v = v.at[jnp.asarray(plan.hit_pos)].set(
+                cache.gather_rows(plan.hit_slots)
+            )
+        cache.touch(plan)
+        n = pk.shape[0]
+        self.last_cache_hits = plan.n_hits
+        self.last_cache_misses = n - plan.n_hits
+        telemetry.gauge(
+            "cache.hit_rate",
+            "fraction of the pass census served from the HBM cache",
+        ).set(plan.n_hits / max(n, 1))
+        return plan, v
+
     def begin_pass(self, pass_keys: np.ndarray) -> None:
         """Promote the pass working set to device (reference: EndFeedPass
         SSD->CPU->HBM promote + BeginPass, box_wrapper.cc:630-659).  When
         prepare_pass staged this census, the visible work is one
-        intersection patch + jnp.asarray."""
+        intersection patch + jnp.asarray; with the HBM cache, the host
+        only ever supplies the cache MISSES (the promotion patch) and hit
+        rows are filled by a device gather."""
+        from paddlebox_tpu import telemetry
+        from paddlebox_tpu.utils import faults
+
         if self._in_pass:
             raise RuntimeError("end_pass the previous pass first")
         pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
@@ -481,31 +662,129 @@ class SparseTable:
         # gracefully if a later batch needs more).
         cap = self._stage_cap(pk.shape[0])
         n = pk.shape[0]
-        vals = self._take_stage(pk, cap)
+        cache = self._get_cache()
+        staged = self._take_stage(pk, cap)
+        vals = staged
         if vals is None:
             vals = np.zeros((cap, w + 1), dtype=np.float32)
-            vals[:n] = self._resolve_or_init(pk)
-        self.values = jnp.asarray(vals[:, :w])
-        self.g2sum = jnp.asarray(vals[:, w])
+            if cache is None:
+                vals[:n] = self._resolve_or_init(pk)
+            else:
+                try:
+                    miss_pos = np.nonzero(~cache.lookup(pk).hit_mask)[0]
+                    if miss_pos.shape[0]:
+                        vals[miss_pos] = self._cache_fetch_rows(pk[miss_pos])
+                except faults.FaultInjected:
+                    # degraded pass: dirty rows drain to the host tier,
+                    # census keys leave the cache, full host resolve (the
+                    # overlay makes the drained rows visible immediately)
+                    telemetry.counter(
+                        "cache.fetch_fallbacks",
+                        "promotion fetches degraded to the full host resolve",
+                    ).inc()
+                    self._cache_degrade(pk)
+                    cache = None
+                    vals[:n] = self._resolve_or_init(pk)
+        plan = None
+        v = jnp.asarray(vals)
+        if cache is not None:
+            # staged path included: current-miss positions carry staged
+            # rows (+ write-back patches — evictions always write back),
+            # current hits are overwritten from HBM here
+            plan, v = self._cache_plan_and_fill(cache, pk, v)
+        self._cache_plan = plan
+        self.values = v[:, :w]
+        self.g2sum = v[:, w]
         self._pass_keys = pk
         self._census_index = None  # stale: points at the previous census
         self._in_pass = True
         self._delta_keys.append(pk)
         self._observe_gap()
 
+    def _cache_update_plan(self, cache, pk: np.ndarray, plan):
+        """Admission/eviction decision for the finished pass — chaos site
+        ``cache.admit``: a failure returns None and end_pass degrades to
+        evicting the census from the cache + a full host write-back (rows
+        route through the host tier exactly like the cache-off lifecycle,
+        so nothing is lost or stale)."""
+        from paddlebox_tpu import telemetry
+        from paddlebox_tpu.utils import faults
+
+        try:
+            faults.inject("cache.admit")
+            return cache.plan_update(pk, plan)
+        except faults.FaultInjected:
+            telemetry.counter(
+                "cache.admit_fallbacks",
+                "cache admissions degraded to the full host write-back",
+            ).inc()
+            return None
+
+    def _end_pass_cached(self, cache, plan, pk: np.ndarray, n: int) -> None:
+        """Cached end-of-pass: hits update their HBM slots in place, the
+        hottest misses are admitted (evicting aged-out residents), and
+        ONLY cold + evicted rows travel D2H into the host write-back.
+        Evicted rows are written back even when clean so a pre-staged next
+        pass can always be patched current from the write-back log."""
+        from paddlebox_tpu import telemetry
+
+        full = jnp.concatenate([self.values, self.g2sum[:, None]], axis=1)
+        upd = self._cache_update_plan(cache, pk, plan)
+        if upd is None:
+            vals = np.asarray(full[:n])
+            with self._cache_lock:
+                cache.evict_keys(pk[plan.hit_mask])
+                self._write_back(pk, vals)
+            return
+        upd_pos = np.concatenate([plan.hit_pos, upd.admit_pos])
+        upd_slots = np.concatenate([plan.hit_slots, upd.admit_slots])
+        victim_rows = (
+            np.asarray(cache.gather_rows(upd.victim_slots))
+            if upd.victim_slots.shape[0]
+            else np.empty((0, cache.n_cols), np.float32)
+        )
+        cold_rows = (
+            np.asarray(full[jnp.asarray(upd.cold_pos)])
+            if upd.cold_pos.shape[0]
+            else np.empty((0, cache.n_cols), np.float32)
+        )
+        if upd_slots.shape[0]:
+            cache.set_rows(upd_slots, full[jnp.asarray(upd_pos)])
+        wb_keys = np.concatenate([pk[upd.cold_pos], upd.victim_keys])
+        order = np.argsort(wb_keys, kind="stable")
+        with self._cache_lock:
+            cache.commit_update(plan, upd)
+            self._write_back(
+                wb_keys[order],
+                np.concatenate([cold_rows, victim_rows])[order],
+            )
+        if upd.victim_slots.shape[0]:
+            telemetry.counter(
+                "cache.evicted_rows",
+                "rows evicted from the HBM cache (written back to the host)",
+            ).inc(int(upd.victim_slots.shape[0]))
+
     def end_pass(self) -> None:
         """Write the working set back to the host store (reference: EndPass
         HBM->CPU/SSD write-back, box_wrapper.cc:660-673).  Overlapped
         tables only pay the D2H snapshot here; the store merge runs on the
-        background thread (flush() is the barrier)."""
+        background thread (flush() is the barrier).  With the HBM cache,
+        only cold + evicted rows come down — hits never leave the device
+        (_end_pass_cached)."""
         if not self._in_pass:
             raise RuntimeError("no pass in flight")
         pk = self._pass_keys
         n = pk.shape[0]
-        vals = np.concatenate(
-            [np.asarray(self.values), np.asarray(self.g2sum)[:, None]], axis=1
-        )[:n]
-        self._write_back(pk, vals)
+        cache = self._get_cache()
+        plan, self._cache_plan = self._cache_plan, None
+        if cache is not None and plan is not None and n:
+            self._end_pass_cached(cache, plan, pk, n)
+        else:
+            vals = np.concatenate(
+                [np.asarray(self.values), np.asarray(self.g2sum)[:, None]],
+                axis=1,
+            )[:n]
+            self._write_back(pk, vals)
         self.values = None
         self.g2sum = None
         # DROP the native index reference rather than eagerly closing it: a
@@ -531,6 +810,9 @@ class SparseTable:
         self._census_index = None  # dropped, not closed — see end_pass
         self._pass_keys = None
         self._in_pass = False
+        # cache rows were never written by this pass (updates land only at
+        # end_pass); begin_pass's frequency credit is metadata-only noise
+        self._cache_plan = None
         if self._delta_keys:
             self._delta_keys.pop()
 
@@ -614,13 +896,18 @@ class SparseTable:
         # pending write-back, and a staged next pass resolved pre-shrink
         # would resurrect undecayed rows
         self._discard_stage()
-        if self.n_features == 0:  # n_features flushes pending merges
+        if self.n_features == 0:  # n_features flushes merges + cache drain
             return 0
-        return self._store.decay_evict(
+        evicted = self._store.decay_evict(
             decay_cols=2,  # show + clk
             decay=self.conf.show_decay_rate,
             threshold=self.conf.delete_threshold,
         )
+        # cached rows pre-date the decay (they were drained, then the
+        # store decayed/evicted): membership must drop so the next pass
+        # re-reads the decayed rows from the store
+        self._invalidate_caches()
+        return evicted
 
     # -- persistence ------------------------------------------------------ #
     def state_dict(self) -> dict:
@@ -639,6 +926,8 @@ class SparseTable:
             np.asarray(state["keys"], dtype=np.uint64),
             np.asarray(state["values"], dtype=np.float32),
         )
+        # every cached row is now stale relative to the restored store
+        self._invalidate_caches()
 
     def pass_state_dict(self) -> dict:
         """Snapshot usable mid-pass: the live working set when a pass is
@@ -684,6 +973,8 @@ class SparseTable:
             self.flush()
             self._discard_stage()
             self._merge_into_store(keys, np.asarray(state["values"], np.float32))
+            # delta rows may overwrite keys the cache holds — drop membership
+            self._invalidate_caches()
 
 
 # ------------------------------------------------------------------------- #

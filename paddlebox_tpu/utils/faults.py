@@ -70,6 +70,11 @@ KNOWN_SITES = {
     "data.read", "ckpt.save", "ckpt.load",
     # pass-boundary pipeline: the background store merge (sparse/table.py)
     "store.merge",
+    # device-resident embedding engine (sparse/engine/): the begin-pass
+    # promotion fetch of cache misses (failure => full synchronous host
+    # resolve) and the end-pass admission decision (failure => census
+    # leaves the cache, full host write-back) — both degrade, never corrupt
+    "cache.fetch", "cache.admit",
     # checkpoint/model publishing (utils/fs + serving_sync/publisher)
     "publish.mkdir", "publish.upload", "publish.donefile", "publish.delta",
     # training + distributed plane

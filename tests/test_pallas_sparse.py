@@ -13,7 +13,14 @@ import numpy as np
 import pytest
 
 from paddlebox_tpu.config import flags
-from paddlebox_tpu.ops.pallas_sparse import pallas_pull_rows, pallas_scatter_add
+from paddlebox_tpu.ops.pallas_sparse import (
+    pallas_gather_slots,
+    pallas_pull_rows,
+    pallas_scatter_add,
+    pallas_scatter_rows,
+    pallas_sorted_search,
+    split_u64,
+)
 
 
 @pytest.fixture
@@ -142,6 +149,165 @@ def test_pallas_scatter_add_duplicates_across_tiles():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
     )
+
+
+# --------------------------------------------------------------------------- #
+# Cache-tier kernels (sparse/engine): numpy-reference parity in interpret mode
+# --------------------------------------------------------------------------- #
+def _np_gather_slots(table: np.ndarray, slots: np.ndarray) -> np.ndarray:
+    """Reference: table[slot] per slot, the zero row where slot < 0."""
+    return np.where(
+        slots[:, None] >= 0, table[np.maximum(slots, 0)], 0.0
+    ).astype(table.dtype)
+
+
+def _np_scatter_rows(table, slots, rows) -> np.ndarray:
+    """Reference: sequential replace — negative dropped, later wins."""
+    out = table.copy()
+    for i, s in enumerate(slots):
+        if s >= 0:
+            out[s] = rows[i]
+    return out
+
+
+def _np_sorted_search(keys: np.ndarray, q: np.ndarray) -> np.ndarray:
+    """Reference: position of each query in sorted unique ``keys``, -1
+    when absent (what HbmCache's numpy resolve computes)."""
+    if keys.shape[0] == 0:
+        return np.full(q.shape[0], -1, np.int32)
+    pos = np.searchsorted(keys, q)
+    pos_c = np.minimum(pos, keys.shape[0] - 1)
+    return np.where(keys[pos_c] == q, pos_c, -1).astype(np.int32)
+
+
+def _hay(keys: np.ndarray) -> jnp.ndarray:
+    """pow2-padded (hi, lo) haystack for pallas_sorted_search."""
+    n = keys.shape[0]
+    cpad = 1 << max(0, (n - 1).bit_length()) if n else 0
+    hay = np.full((cpad, 2), 0xFFFFFFFF, np.uint32)
+    if n:
+        hay[:n] = np.asarray(split_u64(keys))
+    return jnp.asarray(hay)
+
+
+class TestCacheKernels:
+    def test_gather_slots_matches_reference_with_misses(self):
+        rng = np.random.default_rng(4)
+        table = rng.normal(size=(64, 12)).astype(np.float32)
+        for k in (1, 8, 40):
+            slots = rng.integers(-1, 64, size=k).astype(np.int32)
+            got = pallas_gather_slots(
+                jnp.asarray(table), jnp.asarray(slots), interpret=True
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got), _np_gather_slots(table, slots)
+            )
+
+    def test_gather_slots_all_miss_and_empty(self):
+        table = np.arange(32, dtype=np.float32).reshape(8, 4)
+        all_miss = np.full(8, -1, np.int32)
+        got = pallas_gather_slots(
+            jnp.asarray(table), jnp.asarray(all_miss), interpret=True
+        )
+        assert np.asarray(got).sum() == 0.0
+        empty = pallas_gather_slots(
+            jnp.asarray(table), jnp.zeros(0, jnp.int32), interpret=True
+        )
+        assert empty.shape == (0, 4)
+
+    def test_scatter_rows_replace_drops_negatives_last_wins(self):
+        rng = np.random.default_rng(5)
+        table = rng.normal(size=(32, 8)).astype(np.float32)
+        # duplicates within AND across tiles (size 8 -> tile 8; also try 16)
+        for k in (8, 16):
+            slots = rng.integers(-1, 32, size=k).astype(np.int32)
+            slots[k // 2] = slots[0]  # force a duplicate
+            rows = rng.normal(size=(k, 8)).astype(np.float32)
+            got = pallas_scatter_rows(
+                jnp.asarray(table), jnp.asarray(slots), jnp.asarray(rows),
+                interpret=True,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got), _np_scatter_rows(table, slots, rows)
+            )
+
+    def test_sorted_search_matches_reference(self):
+        rng = np.random.default_rng(6)
+        keys = np.unique(rng.integers(0, 2**63, size=100).astype(np.uint64))
+        n = keys.shape[0]
+        q = np.concatenate([
+            keys[::3],
+            np.asarray([12345, 2**63 + 17, 0], np.uint64),
+        ])
+        got = pallas_sorted_search(
+            _hay(keys), jnp.asarray([n], jnp.int32), split_u64(q),
+            interpret=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got), _np_sorted_search(keys, q)
+        )
+
+    def test_sorted_search_empty_miss_and_all_miss(self):
+        keys = np.asarray([5, 9, 11, 40], np.uint64)
+        nr = jnp.asarray([4], jnp.int32)
+        # empty-miss: every query present
+        got = pallas_sorted_search(_hay(keys), nr, split_u64(keys),
+                                   interpret=True)
+        np.testing.assert_array_equal(np.asarray(got), [0, 1, 2, 3])
+        # all-miss: none present (incl. a key colliding with the sentinel
+        # low bits and one past the end)
+        q = np.asarray([1, 6, 41, 2**64 - 1], np.uint64)
+        got = pallas_sorted_search(_hay(keys), nr, split_u64(q),
+                                   interpret=True)
+        np.testing.assert_array_equal(np.asarray(got), [-1, -1, -1, -1])
+        # empty haystack / empty queries
+        got = pallas_sorted_search(
+            _hay(np.empty(0, np.uint64)), jnp.asarray([0], jnp.int32),
+            split_u64(keys), interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got), [-1] * 4)
+        assert pallas_sorted_search(
+            _hay(keys), nr, split_u64(np.empty(0, np.uint64)),
+            interpret=True,
+        ).shape == (0,)
+
+    def test_sorted_search_max_key_vs_sentinel_padding(self):
+        """A real all-ones key must match itself and a missing all-ones
+        query must NOT false-positive against the 0xFFFFFFFF padding."""
+        keys = np.asarray([3, 2**64 - 1], np.uint64)
+        got = pallas_sorted_search(
+            _hay(keys), jnp.asarray([2], jnp.int32), split_u64(keys),
+            interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got), [0, 1])
+        keys2 = np.asarray([3, 9, 11], np.uint64)  # padded to 4 slots
+        got = pallas_sorted_search(
+            _hay(keys2), jnp.asarray([3], jnp.int32),
+            split_u64(np.asarray([2**64 - 1], np.uint64)), interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got), [-1])
+
+    def test_hbm_cache_lookup_pallas_parity(self):
+        """HbmCache.lookup must produce the identical plan through the
+        Pallas sorted-search path and the numpy searchsorted path."""
+        from paddlebox_tpu.sparse.engine import HbmCache
+
+        rng = np.random.default_rng(7)
+        c = HbmCache(64, 5)
+        keys = np.unique(rng.integers(1, 500, size=48).astype(np.uint64))
+        c.keys[: keys.shape[0]] = keys
+        c.used[: keys.shape[0]] = True
+        c._rebuild_index()
+        q = np.unique(rng.integers(1, 600, size=80).astype(np.uint64))
+        plan_np = c.lookup(q)
+        flags.set("use_pallas_sparse", True)
+        try:
+            plan_pl = c.lookup(q)
+        finally:
+            flags.set("use_pallas_sparse", False)
+        np.testing.assert_array_equal(plan_np.hit_mask, plan_pl.hit_mask)
+        np.testing.assert_array_equal(plan_np.hit_pos, plan_pl.hit_pos)
+        np.testing.assert_array_equal(plan_np.hit_slots, plan_pl.hit_slots)
 
 
 def test_pallas_kernels_odd_and_large_shapes():

@@ -31,9 +31,13 @@ N_PASSES = 3
 
 
 def _tconf(overlap: bool, **kw) -> SparseTableConfig:
+    # hbm_cache_rows=0: these tests pin the PR-5 overlay/write-back
+    # machinery itself — with the device cache on, steady-state passes
+    # write back almost nothing and the overlay paths go unexercised
+    # (the cached lifecycle has its own suite, tests/test_hbm_cache.py)
     return SparseTableConfig(
         embedding_dim=4, learning_rate=0.4, initial_range=0.05,
-        store_buckets=16, plan_scratch_rows=64,
+        store_buckets=16, plan_scratch_rows=64, hbm_cache_rows=0,
         overlap_pass_boundary=overlap, store_threads=4 if overlap else 0,
         **kw,
     )
