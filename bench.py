@@ -1281,6 +1281,199 @@ def stage_serving(backend) -> None:
           "backend": backend, **res})
 
 
+def bench_fleet(n_replicas: int = 3, qps: float = 25.0,
+                duration_s: float = 12.0, kill_at_s: float = 4.0,
+                n_slots: int = 4, dense: int = 4):
+    """Serving-fleet SLO evidence, OPEN-LOOP (ROADMAP item 2(c)): train a
+    tiny CTR-DNN, export one self-contained artifact, spawn N real
+    replica server processes under the ReplicaSupervisor, put the
+    FleetRouter in front, then drive a fixed-schedule request stream
+    (send times set by the clock, NOT by response arrival — closed-loop
+    generators hide overload by slowing down with the server) while
+    chaos runs: a probabilistic fleet.probe fault plan plus a REAL
+    SIGKILL of one replica mid-stream.  Reports p50/p99/achieved-QPS,
+    shed and failed counts, the supervisor restart count, fleet-view
+    convergence, and the hard zero-failed-requests check."""
+    import http.client
+    import signal as _signal
+    import subprocess
+    import threading
+
+    from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.inference import export_model
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.serving_fleet import (
+        EJECTED,
+        FleetRouter,
+        ReplicaSupervisor,
+    )
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.train.trainer import Trainer
+    from paddlebox_tpu.utils.faults import fault_plan
+
+    B = 64
+    res: dict = {"n_replicas": n_replicas, "target_qps": qps,
+                 "duration_s": duration_s}
+    with tempfile.TemporaryDirectory() as td:
+        conf = make_synth_config(n_sparse_slots=n_slots, dense_dim=dense,
+                                 batch_size=B, max_feasigns_per_ins=8)
+        files = write_synth_files(td, n_files=1, ins_per_file=2 * B,
+                                  n_sparse_slots=n_slots, vocab_per_slot=500,
+                                  dense_dim=dense, seed=17)
+        ds = PadBoxSlotDataset(conf, read_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        tconf = SparseTableConfig(embedding_dim=4)
+        model = CtrDnn(n_slots, tconf.row_width, dense_dim=dense,
+                       hidden=(16,))
+        table = SparseTable(tconf, seed=0)
+        trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10),
+                          seed=0)
+        table.begin_pass(ds.unique_keys())
+        trainer.train_from_dataset(ds, table)
+        table.end_pass()
+        ds.close()
+        kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+        art = os.path.join(td, "artifact")
+        export_model(model, trainer.params, table, art, batch_size=B,
+                     key_capacity=kcap, dense_dim=dense, feed_conf=conf)
+        with open(files[0], "rb") as f:
+            body = b"\n".join(f.read().splitlines()[:8]) + b"\n"
+
+        def argv_for(rid, port):
+            return [sys.executable, "-m", "paddlebox_tpu.serve",
+                    "--artifact", art, "--port", str(port), "--cpu",
+                    "--max-queue", "64"]
+
+        sup = ReplicaSupervisor(n_replicas, argv_for,
+                                log_dir=os.path.join(td, "logs"))
+        sup.start()
+        router = FleetRouter(sup.endpoints(), probe_interval_s=0.3)
+        lat_ok: list = []
+        shed = failed = 0
+        count_lock = threading.Lock()
+        try:
+            # replica startup = a full jax import + artifact load each
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 300:
+                router.probe_once()
+                if all(r.state != EJECTED for r in router.replicas):
+                    break
+                time.sleep(0.5)
+            else:
+                raise RuntimeError("replicas never came healthy: "
+                                   f"{[r.last_error for r in router.replicas]}")
+            log(f"fleet: {n_replicas} replicas healthy in "
+                f"{time.monotonic() - t0:.0f}s")
+            port = router.start(port=0)
+            for _ in range(5):  # warm every replica's compile path
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                conn.request("POST", "/score", body=body)
+                conn.getresponse().read()
+                conn.close()
+
+            n_requests = int(qps * duration_s)
+            idx = {"i": 0}
+            start = time.monotonic()
+            killed = {"pid": None}
+
+            def worker():
+                nonlocal shed, failed
+                while True:
+                    with count_lock:
+                        i = idx["i"]
+                        if i >= n_requests:
+                            return
+                        idx["i"] = i + 1
+                    # open loop: request i goes out at start + i/qps no
+                    # matter how request i-1 fared
+                    delay = start + i / qps - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                    t1 = time.perf_counter()
+                    try:
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=30)
+                        conn.request("POST", "/score", body=body)
+                        r = conn.getresponse()
+                        r.read()
+                        status = r.status
+                        conn.close()
+                    except Exception:
+                        status = -1
+                    dt = (time.perf_counter() - t1) * 1e3
+                    with count_lock:
+                        if status == 200:
+                            lat_ok.append(dt)
+                        elif status == 429:
+                            shed += 1
+                        else:
+                            failed += 1
+
+            # chaos: probabilistic probe faults (the PBOX_FAULT_PLAN
+            # shape) + one real SIGKILL mid-stream
+            with fault_plan({"fleet.probe": "p:0.05"}, seed=7):
+                threads = [threading.Thread(target=worker, daemon=True)
+                           for _ in range(16)]
+                for t in threads:
+                    t.start()
+                time.sleep(kill_at_s)
+                killed["pid"] = sup.kill_replica(0, _signal.SIGKILL)
+                log(f"fleet: SIGKILLed replica 0 (pid {killed['pid']}) at "
+                    f"t+{kill_at_s:.0f}s")
+                for t in threads:
+                    t.join(timeout=duration_s + 120)
+            wall = time.monotonic() - start
+
+            # convergence: the killed replica restarts (new pid) and the
+            # fleet view returns to all-serving
+            t0 = time.monotonic()
+            converged = False
+            while time.monotonic() - t0 < 300:
+                router.probe_once()
+                view = router.fleet_view()
+                if view["n_serving"] == n_replicas \
+                        and sup.restart_count() >= 1:
+                    converged = True
+                    break
+                time.sleep(0.5)
+        finally:
+            router.stop()
+            sup.stop()
+
+    lat_ok.sort()
+    n_ok = len(lat_ok)
+    res.update({
+        "requests": n_ok + shed + failed,
+        "ok": n_ok,
+        "shed": shed,
+        "failed_requests": failed,
+        "zero_failed": failed == 0,
+        "p50_ms": round(lat_ok[n_ok // 2], 2) if n_ok else None,
+        "p99_ms": round(lat_ok[_rank(0.99, n_ok)], 2) if n_ok else None,
+        "achieved_qps": round((n_ok + shed + failed) / wall, 1),
+        "supervisor_restarts": sup.restart_count(),
+        "killed_pid": killed["pid"],
+        "fleet_converged": converged,
+    })
+    log(f"fleet: {n_ok} ok / {shed} shed / {failed} FAILED of "
+        f"{res['requests']} @ {res['achieved_qps']} qps; p50 "
+        f"{res['p50_ms']}ms p99 {res['p99_ms']}ms; restarts "
+        f"{res['supervisor_restarts']} converged={converged}")
+    return res
+
+
+def stage_fleet(backend, args) -> None:
+    res = bench_fleet(qps=args.fleet_qps, duration_s=args.fleet_seconds)
+    emit({"metric": "fleet_router_p99_ms", "value": res.get("p99_ms"),
+          "unit": "ms p99 (8-instance request, 1 replica SIGKILLed "
+                  "mid-stream)", "vs_baseline": None, "backend": backend,
+          **res})
+
+
 def step_cost_for_config(tconf, trconf, n_slots, dense, bsz, hidden,
                          vocab) -> dict:
     """XLA cost analysis (FLOPs / bytes per CALL) of the jitted step at an
@@ -1646,6 +1839,16 @@ def main() -> None:
                     help="serving-path p50/p99 latency + QPS per shape "
                          "bucket (ScoringServer.score_lines + loopback "
                          "HTTP)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="serving-fleet SLO run: open-loop QPS through "
+                         "the health-checked router over 3 replica "
+                         "processes while one is SIGKILLed mid-stream — "
+                         "p50/p99, shed counts and the hard "
+                         "zero-failed-requests check")
+    ap.add_argument("--fleet-qps", type=float, default=25.0,
+                    help="open-loop target QPS for --fleet")
+    ap.add_argument("--fleet-seconds", type=float, default=12.0,
+                    help="load duration for --fleet")
     ap.add_argument("--all", action="store_true",
                     help="one process, every measurement: headline (plain "
                          "AND scan trainer path) + naive, device profile, "
@@ -1682,6 +1885,9 @@ def main() -> None:
     elif args.serving:
         fail_metric = "serving_score_latency"
         fail_unit = "ms p50 (64-instance request)"
+    elif args.fleet:
+        fail_metric = "fleet_router_p99_ms"
+        fail_unit = "ms p99 (8-instance request)"
     elif args.pallas:
         fail_metric, fail_unit = "pallas_vs_xla_gather_scatter", "ms"
     elif args.device_profile:
@@ -1726,6 +1932,10 @@ def main() -> None:
 
     if args.serving:
         stage_serving(backend)
+        return
+
+    if args.fleet:
+        stage_fleet(backend, args)
         return
 
     if args.all:

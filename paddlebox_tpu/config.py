@@ -90,6 +90,32 @@ class _Flags:
         "publish_root": "",
         "sync_interval_s": 10.0,
         "sync_cache_dir": "",
+        # serving-fleet resilience (serving_fleet/ + inference/server.py).
+        # serve_replicas > 0 switches `python -m paddlebox_tpu.serve` into
+        # fleet mode: a ReplicaSupervisor spawns that many single-model
+        # server processes and a FleetRouter front door spreads /score
+        # traffic over them (health-checked, failover on replica death).
+        "serve_replicas": 0,
+        # port the fleet router binds (fleet mode only; 0 = ephemeral)
+        "router_port": 8180,
+        # admission control (every ScoringServer): max requests WAITING
+        # for a scoring slot before new arrivals shed with 429 — bounds
+        # queue memory and tail latency under overload (never unbounded
+        # queuing into saturation)
+        "serve_max_queue": 64,
+        # scoring requests in flight at once (calibrated device batches;
+        # >1 buys nothing single-chip — the device lock still serializes)
+        "serve_max_concurrency": 1,
+        # default per-request deadline (ms): arrivals whose ESTIMATED
+        # queue wait exceeds it shed immediately with 429 + Retry-After
+        # (clients override per request via X-Request-Deadline-Ms).
+        # 0 = no deadline: shedding happens on queue_full only.
+        "request_deadline_ms": 0,
+        # largest accepted /score request body; beyond it the server
+        # answers 413 without reading the payload
+        "serve_max_body_bytes": 8 << 20,
+        # fleet router health/freshness probe cadence per replica
+        "fleet_probe_interval_s": 1.0,
         # pass-boundary pipelining kill switch (sparse/table.py): 0 forces
         # every table back to the serial end_pass/begin_pass lifecycle
         # regardless of SparseTableConfig.overlap_pass_boundary — the

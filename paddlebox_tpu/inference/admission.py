@@ -1,0 +1,198 @@
+"""Admission control for the scoring server: bounded queue, deadline shed.
+
+DLRM inference is embedding-bandwidth-bound (PAPERS.md, Dissecting
+Embedding Bag), so an overloaded scorer gains nothing by queuing deeper —
+every queued request only inflates the tail of every request behind it.
+The right overload response is to shed EARLY, at admission:
+
+  * concurrency is capped at ``max_concurrency`` in-flight scoring calls
+    (calibrated device batches; the device lock serializes anyway
+    single-chip, so the default is 1);
+  * at most ``max_queue`` requests wait for a slot, FIFO.  Arrival #
+    ``max_queue+1`` is rejected immediately (429, reason ``queue_full``)
+    — queue depth, and therefore worst-case admitted latency, is bounded
+    by construction;
+  * a request carrying a deadline is rejected up front when its
+    ESTIMATED wait (queue position x EWMA service time / concurrency)
+    already exceeds the deadline, and again if the deadline expires while
+    it is still queued (reason ``deadline``) — a client that would time
+    out anyway never occupies a slot.
+
+Every shed carries a ``retry_after_s`` hint (the current wait estimate)
+that the HTTP layer surfaces as ``Retry-After``.  Exported state:
+``serve.queue_depth`` (gauge), ``serve.shed_total`` (counter by reason)
+and ``serve.admission_wait_seconds`` (histogram of admitted waits).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Optional
+
+from paddlebox_tpu import telemetry
+
+_QUEUE_DEPTH = telemetry.gauge(
+    "serve.queue_depth",
+    help="scoring requests waiting for an admission slot",
+)
+_SHED = telemetry.counter(
+    "serve.shed_total",
+    help="scoring requests shed at admission, by reason",
+)
+_ADMIT_WAIT = telemetry.histogram(
+    "serve.admission_wait_seconds",
+    help="queue wait of ADMITTED scoring requests",
+)
+
+
+class ShedRequest(Exception):
+    """The gate refused this request; serve 429 with ``Retry-After``."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(f"shed ({reason}); retry after "
+                         f"{retry_after_s:.2f}s")
+        self.reason = reason
+        self.retry_after_s = max(retry_after_s, 0.0)
+
+    @property
+    def retry_after_header(self) -> str:
+        """Retry-After is delta-seconds, integral, and at least 1 — a
+        zero would invite an immediate identical retry."""
+        return str(max(1, math.ceil(self.retry_after_s)))
+
+
+class AdmissionGate:
+    """Bounded-FIFO admission for one server's scoring path.
+
+    Usage::
+
+        gate.admit(deadline_s)   # raises ShedRequest, else holds a slot
+        try:  ... score ...
+        finally: gate.release(service_s)
+
+    ``release`` feeds the EWMA service-time estimate the wait predictions
+    are built on; pass the measured scoring wall time.
+    """
+
+    def __init__(self, max_concurrency: int = 1, max_queue: int = 64,
+                 default_deadline_s: Optional[float] = None,
+                 initial_service_s: float = 0.05,
+                 ewma_alpha: float = 0.2):
+        if max_concurrency < 1:
+            raise ValueError("max_concurrency must be >= 1")
+        if max_queue < 0:
+            raise ValueError("max_queue must be >= 0")
+        self.max_concurrency = int(max_concurrency)
+        self.max_queue = int(max_queue)
+        self.default_deadline_s = default_deadline_s
+        self._alpha = float(ewma_alpha)
+        self._ewma_service_s = float(initial_service_s)
+        self._cv = threading.Condition()
+        self._active = 0
+        self._queue: collections.deque = collections.deque()  # ticket FIFO
+        self._next_ticket = 0
+
+    # -- introspection ------------------------------------------------------ #
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def active(self) -> int:
+        with self._cv:
+            return self._active
+
+    def service_estimate_s(self) -> float:
+        with self._cv:
+            return self._ewma_service_s
+
+    def estimated_wait_s(self, n_ahead: Optional[int] = None) -> float:
+        """Predicted queue wait for a request with ``n_ahead`` requests
+        (active + queued) in front of it; defaults to the current line."""
+        with self._cv:
+            if n_ahead is None:
+                n_ahead = self._active + len(self._queue)
+            return n_ahead * self._ewma_service_s / self.max_concurrency
+
+    # -- admit / release ----------------------------------------------------- #
+    def admit(self, deadline_s: Optional[float] = None) -> None:
+        """Block until a scoring slot is held, FIFO.  Raises
+        :class:`ShedRequest` instead of queuing when the queue is full or
+        the (estimated, then actual) wait exceeds the deadline."""
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        t0 = time.monotonic()
+        with self._cv:
+            ahead = self._active + len(self._queue)
+            est = ahead * self._ewma_service_s / self.max_concurrency
+            # the queue bound must hold even in the instant between a
+            # release and the head waiter waking (active is transiently
+            # below the cap while the queue is still full — admitting
+            # then would grow the queue without bound)
+            if len(self._queue) >= self.max_queue and (
+                self._queue or self._active >= self.max_concurrency
+            ):
+                _SHED.inc(reason="queue_full")
+                raise ShedRequest("queue_full", est)
+            if deadline_s is not None and deadline_s > 0 \
+                    and est > deadline_s:
+                _SHED.inc(reason="deadline")
+                raise ShedRequest("deadline", est)
+            if self._active < self.max_concurrency and not self._queue:
+                self._active += 1
+                _ADMIT_WAIT.observe(0.0)
+                return
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            self._queue.append(ticket)
+            _QUEUE_DEPTH.set(len(self._queue))
+            try:
+                while True:
+                    if self._queue and self._queue[0] == ticket \
+                            and self._active < self.max_concurrency:
+                        self._queue.popleft()
+                        self._active += 1
+                        _QUEUE_DEPTH.set(len(self._queue))
+                        _ADMIT_WAIT.observe(time.monotonic() - t0)
+                        # our departure may have made a successor eligible
+                        self._cv.notify_all()
+                        return
+                    remaining = None
+                    if deadline_s is not None and deadline_s > 0:
+                        remaining = deadline_s - (time.monotonic() - t0)
+                        if remaining <= 0:
+                            _SHED.inc(reason="deadline")
+                            raise ShedRequest(
+                                "deadline",
+                                self._position_wait_locked(ticket),
+                            )
+                    self._cv.wait(timeout=remaining)
+            except ShedRequest:
+                self._queue.remove(ticket)
+                _QUEUE_DEPTH.set(len(self._queue))
+                self._cv.notify_all()
+                raise
+
+    def _position_wait_locked(self, ticket) -> float:
+        """Wait estimate for a ticket still in line (cv held)."""
+        try:
+            pos = self._queue.index(ticket)
+        except ValueError:
+            pos = len(self._queue)
+        return (self._active + pos) * self._ewma_service_s \
+            / self.max_concurrency
+
+    def release(self, service_s: Optional[float] = None) -> None:
+        """Free the slot held by a completed (or failed) scoring call.
+        ``service_s`` (measured scoring wall time) feeds the EWMA the
+        shed decisions predict waits from."""
+        with self._cv:
+            self._active -= 1
+            assert self._active >= 0, "release() without admit()"
+            if service_s is not None and service_s >= 0:
+                self._ewma_service_s += self._alpha * (
+                    service_s - self._ewma_service_s
+                )
+            self._cv.notify_all()

@@ -32,7 +32,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from paddlebox_tpu import telemetry
-from paddlebox_tpu.config import DataFeedConfig
+from paddlebox_tpu.config import DataFeedConfig, flags
+from paddlebox_tpu.inference.admission import AdmissionGate, ShedRequest
 from paddlebox_tpu.inference.predictor import Predictor
 from paddlebox_tpu.utils.monitor import stats
 
@@ -61,10 +62,56 @@ _CLIPPED = telemetry.counter(
     "server.clipped_instances",
     help="scored instances with key-capacity-truncated features",
 )
+# request-parsing hardening: bodies beyond the size cap answer 413
+# without being read; a missing/garbage/negative Content-Length answers
+# 400 instead of reading unbounded input
+_OVERSIZED = telemetry.counter(
+    "server.oversized_body",
+    help="scoring requests rejected 413 for exceeding max_body_bytes",
+)
+_BAD_LENGTH = telemetry.counter(
+    "server.bad_content_length",
+    help="scoring requests rejected 400 for a missing/absurd "
+         "Content-Length",
+)
+# degraded-mode flag: 1 while any subsystem (e.g. the serving_sync
+# syncer falling behind or a broken delta chain) marked this replica
+# degraded — it KEEPS serving its pinned last-good model; the fleet
+# router reads the same flag from /healthz and deprioritizes it
+_DEGRADED = telemetry.gauge(
+    "serve.degraded",
+    help="1 while this server advertises degraded-mode serving",
+)
 
 
 def _status_class(code: int) -> str:
     return f"{code // 100}xx"
+
+
+def _entry_health(e) -> dict:
+    """One model's /healthz record.  Deliberately defensive: the probe
+    surface the whole fleet routes on must describe ANY registered entry
+    (including partially-stubbed ones in embedders' tests) rather than
+    500 on a missing attribute — a health endpoint that crashes is
+    itself an outage."""
+    age = e.age_seconds() if hasattr(e, "age_seconds") else None
+    version = getattr(e, "version", None) or {}
+    return {
+        "requests": e.requests,
+        "instances": e.instances,
+        "buckets": e.predictor.bucket_shapes,
+        "n_features": e.predictor.n_features,
+        "age_seconds": age,
+        "seq": version.get("seq"),
+    }
+
+
+class _Httpd(ThreadingHTTPServer):
+    # the ADMISSION GATE does the overload bounding (fast 429s), so the
+    # kernel listen backlog must not pre-empt it: socketserver's default
+    # backlog of 5 drops SYNs under a concurrency burst, and the client's
+    # 1s retransmit then masquerades as serving latency
+    request_queue_size = 128
 
 
 class ModelEntry:
@@ -98,11 +145,36 @@ class ScoringServer:
     serialized by a lock (one backend, one compiled program per shape
     bucket — concurrent device dispatch buys nothing single-chip)."""
 
-    def __init__(self) -> None:
+    def __init__(self, max_queue: Optional[int] = None,
+                 max_concurrency: Optional[int] = None,
+                 request_deadline_ms: Optional[float] = None,
+                 max_body_bytes: Optional[int] = None) -> None:
+        """Admission/parsing knobs default from the flag shim
+        (PBOX_SERVE_MAX_QUEUE / PBOX_SERVE_MAX_CONCURRENCY /
+        PBOX_REQUEST_DEADLINE_MS / PBOX_SERVE_MAX_BODY_BYTES) so a fleet
+        is tuned with env vars, no code changes."""
         self._models: dict[str, ModelEntry] = {}
         self._default: Optional[str] = None
         self._lock = threading.Lock()  # serializes scoring (device work)
         self._meta_lock = threading.Lock()  # registry/stats reads+writes
+        deadline_ms = (flags.request_deadline_ms
+                       if request_deadline_ms is None else request_deadline_ms)
+        self.max_body_bytes = int(
+            flags.serve_max_body_bytes if max_body_bytes is None
+            else max_body_bytes
+        )
+        self.gate = AdmissionGate(
+            max_concurrency=int(flags.serve_max_concurrency
+                                if max_concurrency is None
+                                else max_concurrency),
+            max_queue=int(flags.serve_max_queue
+                          if max_queue is None else max_queue),
+            default_deadline_s=(deadline_ms / 1e3 if deadline_ms else None),
+        )
+        # degraded-mode advertisements: reason -> detail.  The server
+        # keeps serving while any are set; /healthz carries them so the
+        # fleet router deprioritizes-but-keeps this replica.
+        self._degraded: dict[str, str] = {}
         # per-request scoring diagnostics (clipped-instance count): thread-
         # local so concurrent requests can't read each other's tallies, and
         # a monkeypatched/overridden score_lines simply leaves it at 0
@@ -198,6 +270,28 @@ class ScoringServer:
             entry = self._models[name or self._default]
             return dict(entry.version) if entry.version else None
 
+    # -- degraded-mode advertisement ----------------------------------------- #
+    def set_degraded(self, reason: str, detail: str = "") -> None:
+        """Advertise degraded-mode serving under ``reason`` (e.g. the
+        syncer fell behind, or its delta chain broke and the pinned
+        last-good model is what's serving).  The server keeps answering
+        /score — degrade, never 500 — but /healthz carries the flag so a
+        fleet router deprioritizes this replica until it clears."""
+        with self._meta_lock:
+            self._degraded[reason] = detail
+        _DEGRADED.set(1.0)
+
+    def clear_degraded(self, reason: str) -> None:
+        """Withdraw one degraded reason; the flag drops once none remain."""
+        with self._meta_lock:
+            self._degraded.pop(reason, None)
+            remaining = bool(self._degraded)
+        _DEGRADED.set(1.0 if remaining else 0.0)
+
+    def degraded_reasons(self) -> dict:
+        with self._meta_lock:
+            return dict(self._degraded)
+
     # -- scoring ------------------------------------------------------------ #
     def score_lines_detail(self, text: bytes,
                            name: Optional[str] = None) -> dict:
@@ -290,12 +384,15 @@ class ScoringServer:
         class Handler(BaseHTTPRequestHandler):
             _status = 0  # last code sent (per-request telemetry label)
 
-            def _send(self, code: int, payload: dict) -> None:
+            def _send(self, code: int, payload: dict,
+                      headers: Optional[dict] = None) -> None:
                 body = json.dumps(payload).encode()
                 self._status = code
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -314,21 +411,26 @@ class ScoringServer:
                     self.end_headers()
                     self.wfile.write(body)
                 elif self.path == "/healthz":
-                    # liveness + readiness: 200 only when at least one
-                    # model is registered and scorable — a rolling deploy
-                    # probes this before routing traffic
+                    # liveness + readiness + DEGRADATION: 200 only when at
+                    # least one model is registered and scorable — a
+                    # rolling deploy (and the fleet router's probe loop)
+                    # reads this before routing traffic.  Freshness
+                    # (per-model age/seq) and degraded reasons ride along
+                    # so one probe carries the whole routing decision.
                     with server._meta_lock:
                         models = {
-                            n: {"requests": e.requests,
-                                "instances": e.instances,
-                                "buckets": e.predictor.bucket_shapes,
-                                "n_features": e.predictor.n_features}
+                            n: _entry_health(e)
                             for n, e in server._models.items()
                         }
+                        degraded = dict(server._degraded)
                     ready = bool(models)
                     self._send(
                         200 if ready else 503,
-                        {"ok": ready, "ready": ready, "models": models},
+                        {"ok": ready, "ready": ready, "models": models,
+                         "degraded": bool(degraded),
+                         "degraded_reasons": degraded,
+                         "draining": server._draining,
+                         "queue_depth": server.gate.queue_depth()},
                     )
                 elif self.path == "/models":
                     # per-model version lineage + freshness: base tag,
@@ -388,12 +490,72 @@ class ScoringServer:
                     server._end_request()
                     server._record_request(name, self._status, t0)
 
+            def _read_body(self):
+                """Validated request body, or None after an error reply.
+
+                Refuses before reading: a missing / non-integer / negative
+                Content-Length is 400 (a scorer never reads unbounded
+                input on faith) and a body beyond ``max_body_bytes`` is
+                413 — both counted, neither touches the payload."""
+                raw = self.headers.get("Content-Length")
+                try:
+                    n = int(raw)
+                except (TypeError, ValueError):
+                    n = -1
+                if n < 0:
+                    _BAD_LENGTH.inc()
+                    self._send(400, {"error": "missing or invalid "
+                                              f"Content-Length {raw!r}"})
+                    return None
+                if n > server.max_body_bytes:
+                    _OVERSIZED.inc()
+                    self._send(413, {
+                        "error": f"body of {n} bytes exceeds this server's "
+                                 f"max_body_bytes={server.max_body_bytes}",
+                    })
+                    return None
+                return self.rfile.read(n)
+
+            def _deadline_s(self):
+                """Per-request deadline: X-Request-Deadline-Ms header
+                outranks the server's configured default.  Unparsable
+                header values fall back to the default (a malformed hint
+                must not turn a scorable request into an error)."""
+                raw = self.headers.get("X-Request-Deadline-Ms")
+                if raw is not None:
+                    try:
+                        ms = float(raw)
+                        if ms > 0:
+                            return ms / 1e3
+                    except ValueError:
+                        pass
+                return server.gate.default_deadline_s
+
             def _do_score(self, name):
                 try:
-                    n = int(self.headers.get("Content-Length", "0"))
-                    body = self.rfile.read(n)
-                    server._tls.clipped = 0
-                    scores = server.score_lines(body, name)
+                    body = self._read_body()
+                    if body is None:
+                        return
+                    try:
+                        server.gate.admit(self._deadline_s())
+                    except ShedRequest as shed:
+                        # overload: refuse LOUDLY and cheaply at admission
+                        # (429 + Retry-After) instead of queuing past the
+                        # client's patience — tail latency of admitted
+                        # requests stays bounded by the queue cap
+                        self._send(
+                            429,
+                            {"error": f"overloaded: {shed.reason}",
+                             "retry_after_s": round(shed.retry_after_s, 3)},
+                            headers={"Retry-After": shed.retry_after_header},
+                        )
+                        return
+                    t_score = time.perf_counter()
+                    try:
+                        server._tls.clipped = 0
+                        scores = server.score_lines(body, name)
+                    finally:
+                        server.gate.release(time.perf_counter() - t_score)
                     payload = {"scores": scores}
                     clipped = getattr(server._tls, "clipped", 0)
                     if clipped:
@@ -428,7 +590,7 @@ class ScoringServer:
             raise RuntimeError("server already started")
         if not self._models:
             raise RuntimeError("register at least one model first")
-        self._httpd = ThreadingHTTPServer((host, port), self._handler())
+        self._httpd = _Httpd((host, port), self._handler())
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="scoring-server",
             daemon=True,

@@ -93,6 +93,24 @@ def rank_env(
     return env
 
 
+def serve_fleet_argv(
+    publish_root: str,
+    replicas: int,
+    router_port: int,
+) -> list[str]:
+    """Command line of the auxiliary serving fleet a training launch can
+    co-run: N CPU-pinned replica scorers syncing from the job's publish
+    root behind a health-checked router (serving_fleet/) — one launcher
+    invocation runs the whole train→publish→serve loop."""
+    return [
+        sys.executable, "-m", "paddlebox_tpu.serve",
+        "--sync-root", publish_root,
+        "--replicas", str(replicas),
+        "--router-port", str(router_port),
+        "--cpu",  # serving must never contend for the training chips
+    ]
+
+
 def launch(
     script_args: list[str],
     nproc: int,
@@ -105,6 +123,8 @@ def launch(
     metrics_port: Optional[int] = None,
     trace_dir: Optional[str] = None,
     publish_root: Optional[str] = None,
+    serve_replicas: int = 0,
+    serve_router_port: Optional[int] = None,
 ) -> int:
     """Spawn nproc ranks of ``python script_args...``; return the first
     non-zero exit code (0 if all ranks succeed).  Any rank dying kills the
@@ -121,6 +141,28 @@ def launch(
     procs: list[subprocess.Popen] = []
     logs = []
     start_t = time.monotonic()
+    serve_proc: Optional[subprocess.Popen] = None
+    if serve_replicas > 0:
+        if not publish_root:
+            raise ValueError(
+                "--serve-replicas needs --publish-root: the fleet syncs "
+                "its models from the job's publish root"
+            )
+        from paddlebox_tpu.config import flags as _flags
+
+        argv = serve_fleet_argv(
+            publish_root, serve_replicas,
+            serve_router_port if serve_router_port is not None
+            else _flags.router_port,
+        )
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            out = open(os.path.join(log_dir, "serve-fleet.log"), "wb")
+            logs.append(out)
+            serve_proc = subprocess.Popen(argv, stdout=out,
+                                          stderr=subprocess.STDOUT)
+        else:
+            serve_proc = subprocess.Popen(argv)
     for rank in range(nproc):
         env = rank_env(
             rank, nproc, coordinator, devices_per_proc,
@@ -155,6 +197,15 @@ def launch(
                 rc = 124
                 for r in live:
                     procs[r].send_signal(signal.SIGTERM)
+            if serve_proc is not None and serve_proc.poll() is not None:
+                # serving is auxiliary: its death must never kill the
+                # training job — log once and train on
+                print(
+                    f"WARNING: auxiliary serving fleet exited rc="
+                    f"{serve_proc.returncode}; training continues",
+                    file=sys.stderr,
+                )
+                serve_proc = None
             for r in sorted(live):
                 code = procs[r].poll()
                 if code is None:
@@ -172,6 +223,12 @@ def launch(
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
     finally:
+        if serve_proc is not None and serve_proc.poll() is None:
+            serve_proc.terminate()
+            try:
+                serve_proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                serve_proc.kill()
         deadline = time.time() + 10.0
         for p in procs:
             if p.poll() is None:
@@ -214,6 +271,14 @@ def main(argv: Optional[list[str]] = None) -> int:
                     help="online model delivery publish root for the "
                          "fleet's serving_sync Publisher "
                          "(PBOX_PUBLISH_ROOT)")
+    ap.add_argument("--serve-replicas", type=int, default=0,
+                    help="co-run an auxiliary serving fleet: this many "
+                         "CPU-pinned replica scorers syncing from "
+                         "--publish-root behind a health-checked router "
+                         "(serving_fleet/; PBOX_SERVE_REPLICAS)")
+    ap.add_argument("--serve-router-port", type=int, default=None,
+                    help="port of the co-run fleet's router "
+                         "(default PBOX_ROUTER_PORT)")
     ap.add_argument("script", help="training script to run")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -228,6 +293,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         metrics_port=args.metrics_port,
         trace_dir=args.trace_dir,
         publish_root=args.publish_root,
+        serve_replicas=args.serve_replicas,
+        serve_router_port=args.serve_router_port,
     )
 
 

@@ -4,6 +4,8 @@
         [--port 8080] [--host 0.0.0.0] [--cpu]
     python -m paddlebox_tpu.serve --sync-root /publish/root \\
         [--sync-model live] [--sync-interval 10] [--cpu]
+    python -m paddlebox_tpu.serve --artifact ART --replicas 3 \\
+        [--router-port 8180] [--max-queue 64] [--request-deadline-ms 500]
 
 Each --artifact may be DIR or NAME=DIR (NAME defaults to the directory
 basename; the first one registered is the default model).  Artifacts must
@@ -17,6 +19,22 @@ any verification failure — the trainer keeps it minutes-fresh with no
 restart.  GET /models reports each model's version lineage (base tag,
 applied delta count, publish time) and freshness age.
 
+--replicas N switches to FLEET mode (serving_fleet/): a
+ReplicaSupervisor spawns N single-server replica processes of this same
+command (each with its own Syncer when --sync-root is given, its own
+admission queue always) and a FleetRouter front door on --router-port
+spreads /score traffic over them with health-checked membership,
+per-request failover and crash restarts — a killed replica is never
+client-visible.  Router endpoints: POST /score[/NAME], GET /healthz
+(fleet summary), GET /fleet (per-replica state + freshness), GET
+/metrics.
+
+Admission control (--max-queue / --request-deadline-ms, env
+PBOX_SERVE_MAX_QUEUE / PBOX_REQUEST_DEADLINE_MS) bounds every replica's
+queue: past the cap, or once the estimated wait exceeds the request
+deadline, the server sheds with 429 + Retry-After instead of queuing
+into saturation.
+
 The reference's serving story is the C++ AnalysisPredictor stack plus
 demo servers (/root/reference/paddle/fluid/inference/); this is the
 whole of it as one module over the StableHLO artifact.
@@ -26,9 +44,12 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 
 
-def main(argv=None) -> None:
+def _build_parser() -> argparse.ArgumentParser:
+    from paddlebox_tpu.config import flags
+
     ap = argparse.ArgumentParser(
         prog="python -m paddlebox_tpu.serve", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter,
@@ -54,9 +75,88 @@ def main(argv=None) -> None:
     ap.add_argument("--sync-timeout", type=float, default=300.0,
                     help="max seconds to wait for the first synced model "
                          "at startup")
+    # -- fleet mode + admission control (serving_fleet/) -------------------- #
+    ap.add_argument("--replicas", type=int, default=flags.serve_replicas,
+                    help="fleet mode: spawn this many replica server "
+                         "processes behind a health-checked router "
+                         "(PBOX_SERVE_REPLICAS; 0 = single server)")
+    ap.add_argument("--router-port", type=int, default=flags.router_port,
+                    help="port the fleet router front door binds "
+                         "(PBOX_ROUTER_PORT; fleet mode only)")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="admission queue bound per server: requests "
+                         "beyond it shed with 429 "
+                         "(default PBOX_SERVE_MAX_QUEUE)")
+    ap.add_argument("--request-deadline-ms", type=float, default=None,
+                    help="default per-request deadline: arrivals whose "
+                         "estimated queue wait exceeds it shed with 429 "
+                         "+ Retry-After (clients override via the "
+                         "X-Request-Deadline-Ms header; default "
+                         "PBOX_REQUEST_DEADLINE_MS, 0 = no deadline)")
+    ap.add_argument("--log-dir", default=None,
+                    help="fleet mode: write per-replica logs here")
+    return ap
+
+
+def _replica_argv(args, replica_id: int, port: int) -> list:
+    """The single-server command line one fleet replica runs: this same
+    module minus the fleet flags, plus its assigned port."""
+    argv = [sys.executable, "-m", "paddlebox_tpu.serve",
+            "--port", str(port), "--host", args.host]
+    for spec in args.artifact:
+        argv += ["--artifact", spec]
+    if args.cpu:
+        argv += ["--cpu"]
+    if args.sync_root:
+        argv += ["--sync-root", args.sync_root,
+                 "--sync-model", args.sync_model,
+                 "--sync-timeout", str(args.sync_timeout)]
+        if args.sync_interval is not None:
+            argv += ["--sync-interval", str(args.sync_interval)]
+        if args.sync_cache:
+            # one Syncer per replica: the fetch caches must not collide
+            argv += ["--sync-cache", f"{args.sync_cache}-r{replica_id}"]
+    if args.max_queue is not None:
+        argv += ["--max-queue", str(args.max_queue)]
+    if args.request_deadline_ms is not None:
+        argv += ["--request-deadline-ms", str(args.request_deadline_ms)]
+    return argv
+
+
+def _main_fleet(args) -> None:
+    from paddlebox_tpu.serving_fleet import FleetRouter, ReplicaSupervisor
+
+    supervisor = ReplicaSupervisor(
+        args.replicas,
+        lambda rid, port: _replica_argv(args, rid, port),
+        host=args.host if args.host != "0.0.0.0" else "127.0.0.1",
+        log_dir=args.log_dir,
+    )
+    supervisor.start()
+    router = FleetRouter(supervisor.endpoints())
+    port = router.start(port=args.router_port, host=args.host)
+    print(f"fleet router on http://{args.host}:{port}/score "
+          f"({args.replicas} replicas: "
+          f"{', '.join(supervisor.endpoints())})", flush=True)
+    try:
+        router.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+        supervisor.stop()
+
+
+def main(argv=None) -> None:
+    ap = _build_parser()
     args = ap.parse_args(argv)
     if not args.artifact and not args.sync_root:
         ap.error("pass at least one --artifact or a --sync-root")
+    if args.replicas and args.replicas > 0:
+        # fleet mode needs no device in THIS process: the router is pure
+        # host I/O; the replicas it spawns load the artifacts
+        _main_fleet(args)
+        return
 
     if args.cpu:
         import jax
@@ -65,7 +165,10 @@ def main(argv=None) -> None:
 
     from paddlebox_tpu.inference import ScoringServer
 
-    server = ScoringServer()
+    server = ScoringServer(
+        max_queue=args.max_queue,
+        request_deadline_ms=args.request_deadline_ms,
+    )
     for spec in args.artifact:
         name, sep, path = spec.partition("=")
         if not sep:
@@ -102,7 +205,7 @@ def main(argv=None) -> None:
 
     port = server.start(port=args.port, host=args.host)
     print(f"serving on http://{args.host}:{port}/score "
-          f"(models: {', '.join(server.model_names())})")
+          f"(models: {', '.join(server.model_names())})", flush=True)
     try:
         server.wait()
     except KeyboardInterrupt:

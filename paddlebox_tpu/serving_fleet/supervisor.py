@@ -1,0 +1,236 @@
+"""Replica supervisor: spawn, babysit, restart-with-backoff.
+
+The process-management half of the serving fleet: a
+:class:`ReplicaSupervisor` launches N replica server processes (each its
+own ``python -m paddlebox_tpu.serve`` by default — one ScoringServer +
+one PR-4 Syncer per process when a sync root is configured), watches
+them from a babysitter thread, and restarts any that crash with
+jittered exponential backoff (the same
+:class:`~paddlebox_tpu.utils.retry.RetryPolicy` curve every transient-
+failure site in the package uses — a replica crash IS a transient
+failure to the fleet).
+
+A replica that crash-loops backs off deeper each consecutive crash
+(``RetryPolicy.delay``); a replica that stays up for
+``stable_after_s`` resets its crash streak.  Respawns run through fault
+site ``fleet.restart`` so chaos plans can make restarts themselves fail
+(the attempt is counted and retried on the next babysit tick with a
+deeper delay).  Counter: ``fleet.restarts``.
+
+The supervisor owns the port plan: each replica gets a fixed local port
+at construction time (so the router's membership is stable across
+restarts — a respawned replica comes back at the SAME address and the
+router's half-open probes readmit it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import subprocess
+import threading
+import time
+from typing import Callable, List, Optional
+
+from paddlebox_tpu import telemetry
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.retry import RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+_RESTARTS = telemetry.counter(
+    "fleet.restarts", help="crashed serving replicas respawned"
+)
+_RESTART_FAILURES = telemetry.counter(
+    "fleet.restart_failures",
+    help="replica respawn attempts that themselves failed",
+)
+
+
+def find_free_port() -> int:
+    from paddlebox_tpu.launch import find_free_port as _f
+
+    return _f()
+
+
+@dataclasses.dataclass
+class ReplicaProc:
+    """One supervised replica: identity, address, live process, and the
+    crash-streak bookkeeping its backoff is computed from."""
+
+    replica_id: int
+    port: int
+    proc: Optional[subprocess.Popen] = None
+    restarts: int = 0  # lifetime respawns
+    crash_streak: int = 0  # consecutive crashes (resets when stable)
+    started_at: float = 0.0
+    next_restart_at: float = 0.0  # monotonic; 0 = not pending
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class ReplicaSupervisor:
+    def __init__(
+        self,
+        n_replicas: int,
+        argv_for: Callable[[int, int], List[str]],
+        *,
+        host: str = "127.0.0.1",
+        ports: Optional[List[int]] = None,
+        env: Optional[dict] = None,
+        log_dir: Optional[str] = None,
+        poll_interval_s: float = 0.2,
+        restart_policy: Optional[RetryPolicy] = None,
+        stable_after_s: float = 10.0,
+    ):
+        """argv_for(replica_id, port) -> the replica's command line.  The
+        supervisor execs it verbatim (tests pass a stub server script;
+        serve.py passes its own single-server invocation)."""
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.host = host
+        ports = list(ports) if ports else [
+            find_free_port() for _ in range(n_replicas)
+        ]
+        if len(ports) != n_replicas:
+            raise ValueError("ports must have one entry per replica")
+        self.argv_for = argv_for
+        self.env = env
+        self.log_dir = log_dir
+        self.poll_interval_s = poll_interval_s
+        # respawn backoff: many attempts, sub-second first delay — a
+        # fleet wants its replica back fast, but a crash LOOP must not
+        # spin (jitter from the shared per-(site, attempt) stream keeps
+        # replicas from thundering back in lockstep)
+        self.restart_policy = restart_policy or RetryPolicy(
+            max_attempts=1_000_000, base_delay_s=0.5, max_delay_s=15.0)
+        self.stable_after_s = stable_after_s
+        self.replicas = [
+            ReplicaProc(replica_id=i, port=p) for i, p in enumerate(ports)
+        ]
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._logs: list = []
+
+    # -- lifecycle ----------------------------------------------------------- #
+    def endpoints(self) -> List[str]:
+        return [f"{self.host}:{r.port}" for r in self.replicas]
+
+    def _spawn(self, r: ReplicaProc) -> None:
+        argv = self.argv_for(r.replica_id, r.port)
+        stdout = stderr = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            out = open(os.path.join(
+                self.log_dir, f"replica{r.replica_id}.log"), "ab")
+            self._logs.append(out)
+            stdout, stderr = out, subprocess.STDOUT
+        r.proc = subprocess.Popen(
+            argv, env=self.env, stdout=stdout, stderr=stderr)
+        r.started_at = time.monotonic()
+        r.next_restart_at = 0.0
+        logger.info("fleet: replica %d up (pid %d, port %d)",
+                    r.replica_id, r.proc.pid, r.port)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("supervisor already started")
+        for r in self.replicas:
+            self._spawn(r)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._babysit, name="replica-supervisor", daemon=True)
+        self._thread.start()
+
+    def poll_once(self) -> None:
+        """One babysit tick: detect crashed replicas, (re)spawn the ones
+        whose backoff has elapsed."""
+        now = time.monotonic()
+        with self._lock:
+            for r in self.replicas:
+                if r.alive():
+                    if r.crash_streak and \
+                            now - r.started_at >= self.stable_after_s:
+                        r.crash_streak = 0  # survived: streak forgiven
+                    continue
+                if r.proc is not None and r.next_restart_at == 0.0:
+                    # fresh crash: schedule the respawn with a jittered
+                    # backoff that deepens each consecutive crash
+                    r.crash_streak += 1
+                    delay = self.restart_policy.delay(
+                        min(r.crash_streak, 30), "fleet.restart")
+                    r.next_restart_at = now + delay
+                    logger.warning(
+                        "fleet: replica %d (pid %s) exited rc=%s; "
+                        "restart %d in %.2fs", r.replica_id, r.pid,
+                        r.proc.returncode, r.restarts + 1, delay)
+                if r.next_restart_at and now >= r.next_restart_at:
+                    try:
+                        faults.inject("fleet.restart")
+                        self._spawn(r)
+                        r.restarts += 1
+                        _RESTARTS.inc()
+                    except Exception as e:
+                        # the respawn itself failed (injected chaos, fork
+                        # limits): deepen the backoff and try again on a
+                        # later tick — the supervisor never gives up
+                        _RESTART_FAILURES.inc()
+                        r.crash_streak += 1
+                        r.next_restart_at = now + self.restart_policy.delay(
+                            min(r.crash_streak, 30), "fleet.restart")
+                        logger.warning(
+                            "fleet: respawn of replica %d failed (%r); "
+                            "next attempt in %.2fs", r.replica_id, e,
+                            r.next_restart_at - now)
+
+    def _babysit(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("supervisor tick failed; continuing")
+            self._stop.wait(self.poll_interval_s)
+
+    def restart_count(self) -> int:
+        with self._lock:
+            return sum(r.restarts for r in self.replicas)
+
+    def kill_replica(self, replica_id: int,
+                     sig: int = signal.SIGKILL) -> int:
+        """Chaos hook: signal one replica (default SIGKILL).  Returns the
+        pid signalled.  The babysitter restarts it like any crash."""
+        r = self.replicas[replica_id]
+        pid = r.pid
+        if pid is None:
+            raise RuntimeError(f"replica {replica_id} has no process")
+        os.kill(pid, sig)
+        return pid
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Stop babysitting, then terminate every replica (TERM, then
+        KILL past the deadline)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+        with self._lock:
+            procs = [r.proc for r in self.replicas if r.alive()]
+        for p in procs:
+            p.terminate()
+        deadline = time.monotonic() + timeout_s
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in self._logs:
+            f.close()
+        self._logs = []

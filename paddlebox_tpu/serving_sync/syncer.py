@@ -101,6 +101,11 @@ _RELOAD_FAILED = telemetry.counter(
 _POLL_ERRORS = telemetry.counter(
     "sync.poll_errors", help="syncer poll loops that raised"
 )
+_AGENT_RESTARTS = telemetry.counter(
+    "sync.agent_restarts",
+    help="background sync agent loops restarted after an escaped "
+         "exception (the loop must never die silently)",
+)
 
 
 class Syncer:
@@ -116,6 +121,8 @@ class Syncer:
         poll_interval_s: Optional[float] = None,
         registry: Optional[ModelRegistry] = None,
         keep_versions: int = 3,
+        degraded_after_failures: int = 3,
+        degraded_lag_entries: int = 10,
     ):
         """feed_conf: parser config for the served model; None reads the
         base artifact's own feed.json (export_model(feed_conf=...))."""
@@ -137,6 +144,15 @@ class Syncer:
         self._applied_seq = -1
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # degraded-mode thresholds: this many consecutive failed poll
+        # ticks (degraded_after_failures) or this many unapplied donefile
+        # entries (degraded_lag_entries; 0 disables) flip the server's
+        # /healthz to degraded — it keeps serving the pinned last-good
+        # model, the fleet router deprioritizes it, and the flag clears
+        # on the next clean/fresh tick.  Degrade, never fail.
+        self.degraded_after_failures = int(degraded_after_failures)
+        self.degraded_lag_entries = int(degraded_lag_entries)
+        self._consecutive_poll_failures = 0
 
     # -- poll --------------------------------------------------------------- #
     def _read_entries(self) -> List[PublishEntry]:
@@ -180,13 +196,37 @@ class Syncer:
 
     def _update_gauges(self, entries: List[PublishEntry]) -> None:
         newest = entries[-1].seq if entries else self._applied_seq
-        _LAG.set(max(0, newest - self._applied_seq), model=self.name)
+        lag = max(0, newest - self._applied_seq)
+        _LAG.set(lag, model=self.name)
         version = self.registry.current_version(self.name)
         if version is not None:
             _MODEL_AGE.set(
                 max(0.0, time.time() - version.published_at),
                 model=self.name,
             )
+        if self.degraded_lag_entries > 0:
+            if lag > self.degraded_lag_entries:
+                self._mark_degraded(
+                    "sync_lag",
+                    f"{lag} published entries behind (> "
+                    f"{self.degraded_lag_entries})",
+                )
+            else:
+                self._clear_degraded("sync_lag")
+
+    # -- degraded-mode advertisement ----------------------------------------- #
+    # The syncer is the authority on delivery health; the server is the
+    # surface it advertises through.  getattr-guarded so a bare server
+    # (or a test stub) without the degraded API still syncs fine.
+    def _mark_degraded(self, reason: str, detail: str = "") -> None:
+        fn = getattr(self.server, "set_degraded", None)
+        if fn is not None:
+            fn(f"{reason}:{self.name}", detail)
+
+    def _clear_degraded(self, reason: str) -> None:
+        fn = getattr(self.server, "clear_degraded", None)
+        if fn is not None:
+            fn(f"{reason}:{self.name}")
 
     # -- apply -------------------------------------------------------------- #
     def _apply_entry(self, entry: PublishEntry) -> None:
@@ -275,6 +315,8 @@ class Syncer:
         atomic; the server-side swap is one pointer write under its
         registry lock (in-flight scores keep their pinned predictor)."""
         self.registry.commit(self.name, version, predictor)
+        # a successful install proves the chain works again
+        self._clear_degraded("sync_chain")
         lineage = version.lineage()
         if self.name in self.server.model_names():
             self.server.swap_model(self.name, predictor, version=lineage)
@@ -352,6 +394,11 @@ class Syncer:
             "last-good model", self.root,
         )
         _RELOAD_FAILED.inc()
+        # the delta chain is broken AND no base loads: the pinned
+        # last-good model keeps serving, but the replica must say so —
+        # the router deprioritizes it until a reload lands
+        self._mark_degraded(
+            "sync_chain", f"no loadable base under {self.root}")
 
     def rollback(self) -> ModelVersion:
         """Swap the previous registry version back into the live server
@@ -364,23 +411,69 @@ class Syncer:
         return version
 
     # -- background agent ---------------------------------------------------- #
+    def _tick_failed(self, exc: BaseException) -> None:
+        """Per-tick failure bookkeeping: count, log, and — past the
+        threshold — advertise degraded (the last-good model keeps
+        serving; the router deprioritizes this replica)."""
+        _POLL_ERRORS.inc()
+        self._consecutive_poll_failures += 1
+        logger.exception("sync poll failed (%d consecutive); retrying",
+                         self._consecutive_poll_failures)
+        if self._consecutive_poll_failures >= self.degraded_after_failures:
+            self._mark_degraded(
+                "sync",
+                f"{self._consecutive_poll_failures} consecutive poll "
+                f"failures; last: {exc!r}"[:200],
+            )
+
+    def _agent_loop(self) -> None:
+        """The inner poll loop: one tick per interval, per-tick errors
+        absorbed with exponential backoff (a publish root that is down
+        for an hour must not be polled at full cadence for an hour)."""
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+                self._consecutive_poll_failures = 0
+                self._clear_degraded("sync")
+            except Exception as e:
+                self._tick_failed(e)
+            # consecutive failures stretch the next wait up to 16x
+            wait = self.poll_interval_s * min(
+                2 ** self._consecutive_poll_failures, 16)
+            self._stop.wait(wait)
+
+    def _agent(self) -> None:
+        """Outer guard: NOTHING may kill the background sync thread
+        silently.  An exception escaping the inner loop (including one
+        raised by its own error handling) logs, counts
+        ``sync.agent_restarts`` and restarts the loop with backoff —
+        a replica whose syncer died would otherwise serve an ever-staler
+        model while reporting nothing."""
+        restarts = 0
+        while not self._stop.is_set():
+            try:
+                self._agent_loop()
+            except BaseException:
+                if self._stop.is_set():
+                    break
+                restarts += 1
+                _AGENT_RESTARTS.inc()
+                logger.exception(
+                    "sync agent loop died (restart %d); restarting",
+                    restarts,
+                )
+                self._stop.wait(
+                    min(self.poll_interval_s * min(2 ** restarts, 16), 30.0)
+                )
+
     def start(self) -> None:
         """Run the poll loop on a daemon thread until stop()."""
         if self._thread is not None:
             raise RuntimeError("syncer already started")
         self._stop.clear()
-
-        def loop():
-            while not self._stop.is_set():
-                try:
-                    self.poll_once()
-                except Exception:
-                    _POLL_ERRORS.inc()
-                    logger.exception("sync poll failed; retrying")
-                self._stop.wait(self.poll_interval_s)
-
         self._thread = threading.Thread(
-            target=loop, name=f"model-syncer-{self.name}", daemon=True
+            target=self._agent, name=f"model-syncer-{self.name}",
+            daemon=True,
         )
         self._thread.start()
 

@@ -82,6 +82,12 @@ KNOWN_SITES = {
     "shuffle.connect", "watchdog.heartbeat",
     # online model delivery (serving_sync/syncer)
     "sync.poll", "sync.fetch", "sync.apply",
+    # serving fleet (serving_fleet/): the router's replica health probe
+    # (failure => probe counted against the replica's state machine), the
+    # per-request forward to a replica (failure => failover retry onto the
+    # next candidate) and the supervisor's crashed-replica respawn
+    # (failure => retried on the next babysit tick with deeper backoff)
+    "fleet.probe", "fleet.route", "fleet.restart",
 }
 
 

@@ -1,0 +1,85 @@
+"""Fault-site drift check (tools/check_fault_sites.py): the KNOWN_SITES
+catalog and the inject()/fire()/retry_call(site=) call sites must agree
+in both directions — the tier-1 guard that keeps chaos plans typo-proof."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "check_fault_sites.py",
+)
+
+
+def _tool():
+    sys.path.insert(0, os.path.dirname(TOOL))
+    try:
+        import importlib
+
+        return importlib.import_module("check_fault_sites")
+    finally:
+        sys.path.pop(0)
+
+
+def test_tree_has_no_drift():
+    mod = _tool()
+    unknown, orphaned = mod.check()
+    assert unknown == [] and orphaned == []
+    assert mod.main([]) == 0
+
+
+def test_scanner_finds_known_shapes():
+    mod = _tool()
+    used, prefixes, _registered = mod.scan_sources()
+    # inject() literals, retry_call(site=) literals, fire() literals
+    assert "sync.poll" in used
+    assert "publish.donefile" in used
+    assert "train.nan" in used
+    # the new fleet sites are instrumented from day one
+    assert "fleet.probe" in used
+    assert "fleet.route" in used
+    assert "fleet.restart" in used
+    # fs.py's "fs." + cmd construction is a dynamic prefix, covering the
+    # hadoop-command sites that never appear as full literals
+    assert "fs." in prefixes
+
+
+def test_known_sites_parse_matches_runtime():
+    mod = _tool()
+    from paddlebox_tpu.utils.faults import KNOWN_SITES
+
+    assert mod.known_sites() == set(KNOWN_SITES)
+
+
+def test_unknown_site_fixture_fails(tmp_path):
+    fixture = tmp_path / "bad_site.py"
+    fixture.write_text('faults.inject("nope.unknown_site")\n')
+    mod = _tool()
+    unknown, _ = mod.check(extra=[str(fixture)])
+    assert ("nope.unknown_site", f"../{fixture.relative_to('/')}") \
+        in unknown or any(s == "nope.unknown_site" for s, _ in unknown)
+    assert mod.main(["--also", str(fixture)]) == 1
+
+
+def test_orphaned_site_fixture_fails(tmp_path, monkeypatch):
+    """A KNOWN_SITES entry nothing references must fail the check: fake
+    one by parsing a doctored faults.py copy."""
+    mod = _tool()
+    real = mod.known_sites()
+    monkeypatch.setattr(mod, "known_sites",
+                        lambda: real | {"ghost.site"})
+    unknown, orphaned = mod.check()
+    assert unknown == []
+    assert any(s == "ghost.site" for s, _ in orphaned)
+
+
+@pytest.mark.parametrize("args,rc", [([], 0), (["--list"], 0)])
+def test_cli_exit_codes(args, rc):
+    r = subprocess.run(
+        [sys.executable, TOOL] + args,
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == rc, r.stderr

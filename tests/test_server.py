@@ -553,3 +553,107 @@ def test_draining_rejects_new_requests():
         srv._draining = False
     assert srv._begin_request()
     srv._end_request()
+
+
+# --------------------------------------------------------------------------- #
+# request-parsing hardening: bounded bodies, validated Content-Length
+# (stub scoring — the refusals happen before any model runs)
+# --------------------------------------------------------------------------- #
+def _hardening_server(max_body_bytes=None):
+    from paddlebox_tpu.config import DataFeedConfig, SlotConfig
+    from paddlebox_tpu.inference.server import ScoringServer
+
+    class _Stub:
+        meta = {"n_tasks": 1, "row_width": 4}
+        bucket_shapes = [(8, 64)]
+        n_features = 1
+
+    conf = DataFeedConfig(
+        slots=(SlotConfig("click", type="float", is_dense=True),
+               SlotConfig("s0")),
+        batch_size=8,
+    )
+    srv = ScoringServer(max_body_bytes=max_body_bytes)
+    srv.register_predictor("stub", _Stub(), conf)
+    srv.score_lines = lambda text, name=None: [
+        0.5 for ln in text.decode().splitlines() if ln.strip()
+    ]
+    return srv
+
+
+def _raw_post(port, headers, body=b""):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.putrequest("POST", "/score", skip_host=False)
+        for k, v in headers.items():
+            conn.putheader(k, v)
+        conn.endheaders()
+        if body:
+            conn.send(body)
+        r = conn.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        conn.close()
+
+
+def test_oversized_body_413_without_reading():
+    from paddlebox_tpu import telemetry
+
+    srv = _hardening_server(max_body_bytes=64)
+    port = srv.start(port=0)
+    counter = telemetry.counter("server.oversized_body")
+    base = counter.value()
+    try:
+        st, out = _raw_post(port, {"Content-Length": "100000"})
+        assert st == 413 and "max_body_bytes" in out["error"]
+        assert counter.value() == base + 1
+        # an in-bounds request still serves
+        body = b"x\ny\n"
+        st, out = _raw_post(
+            port, {"Content-Length": str(len(body))}, body)
+        assert st == 200 and len(out["scores"]) == 2
+    finally:
+        srv.stop()
+
+
+def test_missing_and_absurd_content_length_400():
+    from paddlebox_tpu import telemetry
+
+    srv = _hardening_server()
+    port = srv.start(port=0)
+    counter = telemetry.counter("server.bad_content_length")
+    base = counter.value()
+    try:
+        st, out = _raw_post(port, {})  # no Content-Length at all
+        assert st == 400 and "Content-Length" in out["error"]
+        st, out = _raw_post(port, {"Content-Length": "-5"})
+        assert st == 400
+        st, out = _raw_post(port, {"Content-Length": "banana"})
+        assert st == 400
+        assert counter.value() == base + 3
+    finally:
+        srv.stop()
+
+
+def test_healthz_reports_degraded_and_freshness():
+    """The enriched probe surface the fleet router routes on: degraded
+    reasons, per-model age/seq and queue depth in ONE /healthz read."""
+    srv = _hardening_server()
+    port = srv.start(port=0)
+    try:
+        st, h = _get(port, "/healthz")
+        assert st == 200 and h["ok"] and not h["degraded"]
+        assert h["queue_depth"] == 0
+        assert h["models"]["stub"]["age_seconds"] >= 0
+        srv.set_degraded("sync:live", "5 entries behind")
+        st, h = _get(port, "/healthz")
+        assert st == 200  # degraded still SERVES (degrade, don't fail)
+        assert h["degraded"] and \
+            h["degraded_reasons"] == {"sync:live": "5 entries behind"}
+        srv.clear_degraded("sync:live")
+        st, h = _get(port, "/healthz")
+        assert not h["degraded"]
+    finally:
+        srv.stop()

@@ -472,3 +472,103 @@ def test_version_extend_rejects_base():
         v.extend(PublishEntry(seq=1, kind="base", tag="b1", dir="x",
                               base_tag="b1", prev_tag="b0",
                               published_at=1.0))
+
+
+# --------------------------------------------------------------------------- #
+# background agent resilience: the sync thread must never die silently
+# (PR-7 satellite: an escaped exception restarts the loop with backoff)
+# --------------------------------------------------------------------------- #
+def _wait_until(cond, timeout_s=10.0, interval_s=0.01):
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval_s)
+    return cond()
+
+
+def test_agent_survives_poll_exhaustion_and_marks_degraded(
+        tmp_path, monkeypatch):
+    """A sync.poll fault that exhausts the retry budget on EVERY tick:
+    the agent thread must stay alive (counting sync.poll_errors, backing
+    off), advertise degraded on the server past the threshold, then
+    recover and clear the flag once the fault lifts."""
+    monkeypatch.setenv("PBOX_RETRY_BASE_DELAY_S", "0.001")
+    monkeypatch.setenv("PBOX_RETRY_MAX_ATTEMPTS", "2")
+    srv = ScoringServer()
+    sync = Syncer(str(tmp_path / "pub"), srv, "live",
+                  cache_dir=str(tmp_path / "cache"),
+                  poll_interval_s=0.01, degraded_after_failures=2)
+    errors = telemetry.counter("sync.poll_errors")
+    exhausted_base = errors.value()
+    plan_cm = fault_plan({"sync.poll": "first:100000"})
+    plan_cm.__enter__()
+    try:
+        sync.start()
+        assert _wait_until(lambda: errors.value() >= exhausted_base + 3)
+        assert sync._thread.is_alive()  # the loop absorbed every failure
+        assert _wait_until(
+            lambda: "sync:live" in srv.degraded_reasons())
+    finally:
+        plan_cm.__exit__(None, None, None)
+    # fault lifted: the next clean tick clears the degraded flag and the
+    # agent is still the SAME thread — it never died, never restarted
+    restarts = telemetry.counter("sync.agent_restarts")
+    r_base = restarts.value()
+    assert _wait_until(
+        lambda: "sync:live" not in srv.degraded_reasons(), timeout_s=20)
+    assert sync._thread.is_alive()
+    assert restarts.value() == r_base
+    sync.stop()
+
+
+def test_agent_outer_guard_restarts_dead_loop(tmp_path, monkeypatch):
+    """Even an exception ESCAPING the inner loop (its own error handling
+    raising, a BaseException) must not kill background sync: the outer
+    guard logs, counts sync.agent_restarts and restarts the loop."""
+    srv = ScoringServer()
+    sync = Syncer(str(tmp_path / "pub"), srv, "live",
+                  cache_dir=str(tmp_path / "cache"), poll_interval_s=0.01)
+    real_loop = sync._agent_loop
+    calls = {"n": 0}
+
+    def flaky_loop():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise SystemExit("escaped the inner loop")  # worst case
+        real_loop()
+
+    monkeypatch.setattr(sync, "_agent_loop", flaky_loop)
+    restarts = telemetry.counter("sync.agent_restarts")
+    base = restarts.value()
+    sync.start()
+    assert _wait_until(lambda: restarts.value() >= base + 2)
+    # third incarnation runs the REAL loop: polls tick cleanly (empty
+    # root => 0 entries) and the thread stays up
+    assert _wait_until(lambda: calls["n"] >= 3)
+    assert sync._thread.is_alive()
+    sync.stop()
+    assert not sync._thread  # stop() joined and cleared it
+
+
+def test_syncer_lag_marks_degraded(tmp_path):
+    """A syncer that falls behind the donefile (lag > threshold) must
+    advertise degraded while still serving, and clear on catch-up."""
+    from paddlebox_tpu.serving_sync.registry import PublishEntry as PE
+
+    srv = ScoringServer()
+    sync = Syncer(str(tmp_path / "pub"), srv, "live",
+                  cache_dir=str(tmp_path / "cache"),
+                  degraded_lag_entries=2)
+    entries = [
+        PE(seq=i, kind="delta", tag=f"t{i}", dir=f"d{i}", base_tag="b",
+           prev_tag=f"t{i - 1}", published_at=1.0)
+        for i in range(5)
+    ]
+    sync._update_gauges(entries)  # applied_seq=-1 -> lag 5 > 2
+    assert "sync_lag:live" in srv.degraded_reasons()
+    sync._applied_seq = 4
+    sync._update_gauges(entries)  # caught up -> lag 0
+    assert "sync_lag:live" not in srv.degraded_reasons()

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Fault-site drift check: KNOWN_SITES and the call sites must agree.
+
+The chaos machinery is only as good as its site catalog
+(``utils.faults.KNOWN_SITES``): a fault plan naming a site no
+``inject()``/``fire()`` call uses silently never fires, and an
+instrumented call site missing from the catalog draws the unknown-site
+warning on every legitimate plan.  This tool statically cross-checks the
+two directions:
+
+  * **unknown** — a literal site name used at a call site
+    (``faults.inject("x")`` / ``faults.fire("x")`` /
+    ``retry_call(..., site="x")``) that is not in KNOWN_SITES (nor
+    registered via a literal ``register_site("x")``) fails the check;
+  * **orphaned** — a KNOWN_SITES entry no call site references fails
+    too.  Sites built dynamically by prefix concatenation
+    (``faults.inject("fs." + cmd)``) are recognized: the literal prefix
+    is collected and any catalog entry under it counts as referenced.
+
+Wired into tier-1 via tests/test_fault_sites.py, exactly like
+tools/check_metric_names.py keeps the metric catalog honest.
+
+Usage:
+    python tools/check_fault_sites.py            # check, exit 1 on drift
+    python tools/check_fault_sites.py --list     # dump what was found
+    python tools/check_fault_sites.py --also F   # scan extra file(s) too
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAULTS_PY = os.path.join(REPO, "paddlebox_tpu", "utils", "faults.py")
+
+# literal site uses: inject("x") / fire("x") / site="x".  The name must
+# be the WHOLE first argument (followed by ',' or ')') — a literal that
+# continues with '+' is a dynamic-prefix construction, collected
+# separately below.
+_USE_RE = re.compile(
+    r"""\b(?:faults\.)?(?:inject|fire)\(\s*(["'])([^"']+)\1\s*[,)]
+      | \bsite\s*=\s*(["'])([^"']+)\3\s*[,)\n]""",
+    re.VERBOSE,
+)
+# dynamic construction: inject("prefix" + expr) — the prefix marks every
+# catalog entry under it as reachable
+_DYN_RE = re.compile(
+    r"""\b(?:faults\.)?(?:inject|fire)\(\s*(["'])([^"']+)\1\s*\+""",
+    re.VERBOSE,
+)
+_REGISTER_RE = re.compile(
+    r"""\bregister_site\(\s*(["'])([^"']+)\1\s*\)""",
+    re.VERBOSE,
+)
+
+
+def known_sites() -> set:
+    """KNOWN_SITES parsed statically out of utils/faults.py (no package
+    import: the tool must run on a bare checkout)."""
+    tree = ast.parse(open(FAULTS_PY).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "KNOWN_SITES":
+                    return set(ast.literal_eval(node.value))
+    raise SystemExit(f"ERROR: no KNOWN_SITES literal found in {FAULTS_PY}")
+
+
+def _source_files(extra=()) -> list:
+    roots = [os.path.join(REPO, "paddlebox_tpu"),
+             os.path.join(REPO, "bench.py")]
+    files: list = []
+    for root in roots:
+        if root.endswith(".py"):
+            files.append(root)
+            continue
+        for d, _, fs in os.walk(root):
+            files += [os.path.join(d, f) for f in fs if f.endswith(".py")]
+    return sorted(files) + [os.path.abspath(p) for p in extra]
+
+
+def scan_sources(extra=()):
+    """(used, dynamic_prefixes, registered): literal site names at call
+    sites, literal prefixes of dynamically-built names, and literal
+    register_site() additions — each mapped to first 'file:line' seen."""
+    used: dict = {}
+    prefixes: dict = {}
+    registered: dict = {}
+    for path in _source_files(extra):
+        text = open(path).read()
+        rel = os.path.relpath(path, REPO)
+
+        def note(out, name, start):
+            line = text.count("\n", 0, start) + 1
+            out.setdefault(name, f"{rel}:{line}")
+
+        for m in _USE_RE.finditer(text):
+            note(used, m.group(2) or m.group(4), m.start())
+        for m in _DYN_RE.finditer(text):
+            note(prefixes, m.group(2), m.start())
+        for m in _REGISTER_RE.finditer(text):
+            note(registered, m.group(2), m.start())
+    return used, prefixes, registered
+
+
+def check(extra=()) -> tuple:
+    """(unknown, orphaned) drift lists: [(site, where), ...]."""
+    known = known_sites()
+    used, prefixes, registered = scan_sources(extra)
+    unknown = sorted(
+        (site, where) for site, where in used.items()
+        if site not in known and site not in registered
+    )
+    reachable = set(used) | set(registered)
+    orphaned = sorted(
+        (site, "utils/faults.py KNOWN_SITES") for site in known
+        if site not in reachable
+        and not any(site.startswith(p) for p in prefixes)
+    )
+    return unknown, orphaned
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--list", action="store_true",
+                    help="print every discovered site use and exit 0")
+    ap.add_argument("--also", action="append", default=[],
+                    metavar="FILE",
+                    help="additionally scan FILE (repeatable; the "
+                         "synthetic-fixture hook the self-test uses)")
+    args = ap.parse_args(argv)
+    if args.list:
+        used, prefixes, registered = scan_sources(args.also)
+        for name, where in sorted(used.items()):
+            print(f"{name:32s} {where}")
+        for name, where in sorted(prefixes.items()):
+            print(f"{name + '*':32s} {where} (dynamic prefix)")
+        for name, where in sorted(registered.items()):
+            print(f"{name:32s} {where} (register_site)")
+        return 0
+    unknown, orphaned = check(args.also)
+    rc = 0
+    if unknown:
+        print("fault sites used at call sites but missing from "
+              "utils.faults.KNOWN_SITES:", file=sys.stderr)
+        for site, where in unknown:
+            print(f"  {site}  ({where})", file=sys.stderr)
+        rc = 1
+    if orphaned:
+        print("KNOWN_SITES entries no call site references (stale "
+              "catalog rows — plans naming them can never fire):",
+              file=sys.stderr)
+        for site, where in orphaned:
+            print(f"  {site}  ({where})", file=sys.stderr)
+        rc = 1
+    if rc:
+        print(f"{len(unknown)} unknown + {len(orphaned)} orphaned; fix "
+              "the catalog or the call site.", file=sys.stderr)
+    else:
+        used, prefixes, _ = scan_sources(args.also)
+        print(f"fault-site catalog OK: {len(known_sites())} known sites, "
+              f"{len(used)} literal call-site name(s), "
+              f"{len(prefixes)} dynamic prefix(es)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
