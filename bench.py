@@ -1343,7 +1343,11 @@ def bench_fleet(n_replicas: int = 3, qps: float = 25.0,
             body = b"\n".join(f.read().splitlines()[:8]) + b"\n"
 
         def argv_for(rid, port):
+            # --replicas 0 pins single-server mode: the children inherit
+            # this process's env, so a PBOX_SERVE_REPLICAS setting would
+            # otherwise flip every replica into its own nested fleet
             return [sys.executable, "-m", "paddlebox_tpu.serve",
+                    "--replicas", "0",
                     "--artifact", art, "--port", str(port), "--cpu",
                     "--max-queue", "64"]
 
@@ -1356,8 +1360,10 @@ def bench_fleet(n_replicas: int = 3, qps: float = 25.0,
         count_lock = threading.Lock()
         try:
             # replica startup = a full jax import + artifact load each
+            # (simultaneous, so a 1-core box serializes them — the
+            # allowance must cover the SUM of the imports, not one)
             t0 = time.monotonic()
-            while time.monotonic() - t0 < 300:
+            while time.monotonic() - t0 < 600:
                 router.probe_once()
                 if all(r.state != EJECTED for r in router.replicas):
                     break

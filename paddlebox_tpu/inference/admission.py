@@ -169,7 +169,11 @@ class AdmissionGate:
                                 self._position_wait_locked(ticket),
                             )
                     self._cv.wait(timeout=remaining)
-            except ShedRequest:
+            except BaseException:
+                # ANY exit while queued (shed, KeyboardInterrupt into a
+                # worker thread, ...) must remove the ticket: a dead
+                # ticket left at the head would starve every successor
+                # into deadline sheds forever
                 self._queue.remove(ticket)
                 _QUEUE_DEPTH.set(len(self._queue))
                 self._cv.notify_all()

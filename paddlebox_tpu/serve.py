@@ -100,8 +100,13 @@ def _build_parser() -> argparse.ArgumentParser:
 
 def _replica_argv(args, replica_id: int, port: int) -> list:
     """The single-server command line one fleet replica runs: this same
-    module minus the fleet flags, plus its assigned port."""
+    module minus the fleet flags, plus its assigned port.  --replicas 0
+    is explicit because the flag's DEFAULT follows PBOX_SERVE_REPLICAS
+    and the children inherit the parent environment: without it, a fleet
+    started via the env var would make every replica re-enter fleet mode
+    and recursively spawn its own supervisor+router."""
     argv = [sys.executable, "-m", "paddlebox_tpu.serve",
+            "--replicas", "0",
             "--port", str(port), "--host", args.host]
     for spec in args.artifact:
         argv += ["--artifact", spec]

@@ -26,6 +26,9 @@ loop (``GET /healthz`` every ``probe_interval_s``, fault site
 **Requests fail over**: the request body is buffered in the router, so a
 forward that dies mid-flight (replica SIGKILLed, connection reset, 5xx)
 is retried verbatim on the next candidate (scoring is idempotent) —
+and because the router is what buffers, it enforces ``max_body_bytes``
+itself (413 before reading, counter ``fleet.oversized_body``) rather
+than trusting the replicas' identical bound to fire after the fact —
 site ``fleet.route``, counter ``fleet.failovers``.  Client-errors (4xx
 except 429) pass through: a malformed line is malformed on every
 replica.  A 429 shed is retried on the next replica (another may have
@@ -86,6 +89,14 @@ _ROUTE_SECONDS = telemetry.histogram(
     "fleet.route_seconds",
     help="router request latency (s) by outcome, failovers included",
 )
+# the router buffers the full body for failover retries, so the
+# max_body_bytes bound must hold HERE at the front door — not only on
+# the replicas, after the router has already read an oversized payload
+_OVERSIZED = telemetry.counter(
+    "fleet.oversized_body",
+    help="routed requests rejected 413 at the front door for exceeding "
+         "max_body_bytes",
+)
 
 
 class ReplicaHandle:
@@ -130,6 +141,7 @@ class FleetRouter:
         recover_after: int = 2,
         degraded_max_age_s: Optional[float] = None,
         request_timeout_s: float = 60.0,
+        max_body_bytes: Optional[int] = None,
     ):
         """replicas: "host:port" (or bare-port) strings.  degraded_max_age_s:
         additionally treat a replica whose FRESHEST model is older than
@@ -158,6 +170,10 @@ class FleetRouter:
         self.recover_after = int(recover_after)
         self.degraded_max_age_s = degraded_max_age_s
         self.request_timeout_s = request_timeout_s
+        self.max_body_bytes = int(
+            flags.serve_max_body_bytes if max_body_bytes is None
+            else max_body_bytes
+        )
         self._lock = threading.Lock()
         self._rr = 0  # round-robin cursor
         self._stop = threading.Event()
@@ -390,6 +406,13 @@ class FleetRouter:
                 if n < 0:
                     self._send_json(
                         400, {"error": "missing or invalid Content-Length"})
+                    return
+                if n > router.max_body_bytes:
+                    _OVERSIZED.inc()
+                    self._send_json(413, {
+                        "error": f"body of {n} bytes exceeds this router's "
+                                 f"max_body_bytes={router.max_body_bytes}",
+                    })
                     return
                 body = self.rfile.read(n)
                 fwd = {"Content-Length": str(len(body))}

@@ -118,7 +118,10 @@ class ReplicaSupervisor:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._logs: list = []
+        # one persistent append handle per replica, reused across
+        # respawns — a crash-looping replica must not leak an FD per
+        # restart (the policy allows a million of them)
+        self._logs: dict = {}
 
     # -- lifecycle ----------------------------------------------------------- #
     def endpoints(self) -> List[str]:
@@ -126,15 +129,29 @@ class ReplicaSupervisor:
 
     def _spawn(self, r: ReplicaProc) -> None:
         argv = self.argv_for(r.replica_id, r.port)
+        # replica children import the package by name, but the package
+        # is not installed — it resolves only from its parent dir.  The
+        # supervisor's own import already found it, so pin that dir onto
+        # the child's PYTHONPATH: spawning must not silently depend on
+        # the supervisor's cwd being the repo root.
+        env = dict(self.env if self.env is not None else os.environ)
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        pp = env.get("PYTHONPATH", "")
+        if pkg_parent not in pp.split(os.pathsep):
+            env["PYTHONPATH"] = \
+                pkg_parent + os.pathsep + pp if pp else pkg_parent
         stdout = stderr = None
         if self.log_dir:
-            os.makedirs(self.log_dir, exist_ok=True)
-            out = open(os.path.join(
-                self.log_dir, f"replica{r.replica_id}.log"), "ab")
-            self._logs.append(out)
+            out = self._logs.get(r.replica_id)
+            if out is None:
+                os.makedirs(self.log_dir, exist_ok=True)
+                out = open(os.path.join(
+                    self.log_dir, f"replica{r.replica_id}.log"), "ab")
+                self._logs[r.replica_id] = out
             stdout, stderr = out, subprocess.STDOUT
         r.proc = subprocess.Popen(
-            argv, env=self.env, stdout=stdout, stderr=stderr)
+            argv, env=env, stdout=stdout, stderr=stderr)
         r.started_at = time.monotonic()
         r.next_restart_at = 0.0
         logger.info("fleet: replica %d up (pid %d, port %d)",
@@ -231,6 +248,6 @@ class ReplicaSupervisor:
                 p.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 p.kill()
-        for f in self._logs:
+        for f in self._logs.values():
             f.close()
-        self._logs = []
+        self._logs = {}

@@ -159,6 +159,29 @@ def test_gate_release_updates_service_estimate():
     assert abs(gate.service_estimate_s() - 0.10) < 1e-9
 
 
+def test_gate_cleans_ticket_on_foreign_exception():
+    """Regression: a NON-shed exception escaping cv.wait (e.g. a
+    KeyboardInterrupt delivered to a worker thread) must still remove the
+    waiter's ticket — a dead ticket reaching the head would starve every
+    later request into permanent 429s."""
+    gate = AdmissionGate(max_concurrency=1, max_queue=4,
+                         initial_service_s=0.0001)
+    gate.admit()  # hold the only slot so the next admit queues
+    orig_wait = gate._cv.wait
+
+    def interrupted_wait(timeout=None):
+        gate._cv.wait = orig_wait  # only the first wait blows up
+        raise KeyboardInterrupt
+
+    gate._cv.wait = interrupted_wait
+    with pytest.raises(KeyboardInterrupt):
+        gate.admit()
+    assert gate.queue_depth() == 0  # no ghost ticket left behind
+    gate.release(0.001)
+    gate.admit(deadline_s=1.0)  # a live waiter still admits
+    gate.release(0.001)
+
+
 # --------------------------------------------------------------------------- #
 # HTTP overload: 2x capacity -> 429s rise, admitted p99 stays bounded
 # --------------------------------------------------------------------------- #
@@ -366,6 +389,46 @@ def test_router_http_front_door_and_fleet_view():
         srv_b.stop()
 
 
+def test_router_caps_body_at_front_door():
+    """Regression: the router buffers the full body for failover retries,
+    so max_body_bytes must be enforced at the front door itself — an
+    oversized payload answers 413 before any bytes are read or
+    forwarded."""
+    srv = _stub_server()
+    p = srv.start(port=0)
+    router = FleetRouter([f"127.0.0.1:{p}"], probe_interval_s=0.1,
+                         max_body_bytes=64)
+    oversized = telemetry.counter("fleet.oversized_body")
+    base = oversized.value()
+    try:
+        port = router.start(port=0)
+        st, out, _ = _post(port, body=b"x" * 65)
+        assert st == 413 and "max_body_bytes" in out["error"]
+        assert oversized.value() == base + 1
+        st, out, _ = _post(port)  # within the cap: routed normally
+        assert st == 200 and len(out["scores"]) == 2
+    finally:
+        router.stop()
+        srv.stop()
+
+
+def test_replica_argv_never_reenters_fleet_mode(monkeypatch):
+    """Regression: replica children inherit the parent environment, so
+    with fleet mode enabled via PBOX_SERVE_REPLICAS the child command
+    line must pin --replicas 0 — otherwise every replica would re-enter
+    fleet mode and recursively spawn its own supervisor + router."""
+    from paddlebox_tpu import serve
+
+    monkeypatch.setenv("PBOX_SERVE_REPLICAS", "3")
+    ap = serve._build_parser()
+    args = ap.parse_args(["--artifact", "m=/tmp/art"])
+    assert args.replicas == 3  # the parent IS in fleet mode via env
+    child = serve._replica_argv(args, replica_id=0, port=18080)
+    # strip "python -m paddlebox_tpu.serve"; reparse under the same env
+    child_args = ap.parse_args(child[3:])
+    assert child_args.replicas == 0
+
+
 def test_router_zero_failures_while_replica_dies_midstream():
     """Tier-1 kill test (in-process replicas; the subprocess SIGKILL
     variant is the chaos-marked e2e below): one of three replicas goes
@@ -464,6 +527,26 @@ def test_supervisor_backoff_deepens_on_crash_loop():
         assert sup.restart_count() >= 1
     finally:
         sup.stop()
+
+
+def test_supervisor_reuses_log_handle_across_respawns(tmp_path):
+    """Regression: a crash-looping replica must not open (and leak) a new
+    log FD per respawn — one persistent append handle per replica,
+    closed once at stop()."""
+    crashy = [sys.executable, "-c", "print('boom'); raise SystemExit(1)"]
+    sup = ReplicaSupervisor(
+        1, lambda rid, port: crashy, poll_interval_s=0.02,
+        restart_policy=RetryPolicy(max_attempts=1_000_000,
+                                   base_delay_s=0.01, max_delay_s=0.05),
+        stable_after_s=60.0, log_dir=str(tmp_path),
+    )
+    sup.start()
+    try:
+        assert _wait_until(lambda: sup.restart_count() >= 3, timeout_s=20)
+        assert len(sup._logs) == 1  # one handle, however many respawns
+    finally:
+        sup.stop()
+    assert not sup._logs
 
 
 def test_supervisor_restart_fault_injected_then_recovers():
