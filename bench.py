@@ -1480,6 +1480,179 @@ def stage_fleet(backend, args) -> None:
           **res})
 
 
+def bench_streaming(duration_s: float = 10.0, rate: float = 500.0,
+                    max_staleness_s: float = 1.5, n_slots: int = 2,
+                    dense: int = 2, bsz: int = 16) -> dict:
+    """Streaming online-learning loop (ISSUE 8): a synthetic append-rate
+    stream tailed by a TailingFileSource, trained in mini-pass windows by
+    StreamingTrainer, published on the max-staleness deadline, hot-applied
+    by a real Syncer into a live ScoringServer, with a probe scoring the
+    served model throughout.  Reports the freshness distribution the loop
+    actually delivered (event-time -> served-score p50/p99 from
+    ``stream.freshness_seconds``), the mini-pass device-idle gap, the
+    deadline-miss count and the trained samples/s — CPU-admissible (the
+    loop is host/IO-bound; the ROADMAP bench caveat applies)."""
+    import threading
+    import urllib.request
+
+    from paddlebox_tpu import telemetry
+    from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+    from paddlebox_tpu.data.feed import BatchBuilder
+    from paddlebox_tpu.data.slot_parser import SlotParser
+    from paddlebox_tpu.data.synth import make_synth_config, stream_line
+    from paddlebox_tpu.inference import ScoringServer
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.serving_sync import Publisher, Syncer
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.streaming import (
+        DeadlinePublishPolicy,
+        MiniPassScheduler,
+        StreamingTrainer,
+        TailingFileSource,
+    )
+    from paddlebox_tpu.streaming.minipass import MiniPassWindow, WindowDataset
+    from paddlebox_tpu.train.trainer import Trainer
+
+    rng = np.random.default_rng(0)
+    conf = make_synth_config(n_sparse_slots=n_slots, dense_dim=dense,
+                             batch_size=bsz, max_feasigns_per_ins=8)
+    tconf = SparseTableConfig(embedding_dim=4, learning_rate=0.3,
+                              store_buckets=8, plan_scratch_rows=64)
+    model = CtrDnn(n_slots, tconf.row_width, dense_dim=dense, hidden=(8,))
+    table = SparseTable(tconf, seed=0)
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 12),
+                      seed=0)
+
+    def synth_line() -> str:
+        return stream_line(rng, int(rng.integers(0, 2)),
+                           n_sparse_slots=n_slots, dense_dim=dense,
+                           vocab_per_slot=50)
+
+    res: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        root = os.path.join(td, "publish")
+        stream = os.path.join(td, "stream")
+        os.makedirs(stream)
+
+        # warm pass anchors the delta chain; jit/export warmup off-clock
+        warm = [synth_line() for _ in range(4 * bsz)]
+        block = SlotParser(conf).parse_lines(warm)
+        w0 = MiniPassWindow(0, block, np.unique(block.keys), len(warm),
+                            time.time(), time.time(), "warm", time.time())
+        table.begin_pass(w0.census)
+        trainer.train_from_dataset(WindowDataset(w0, BatchBuilder(conf)),
+                                   table)
+        table.end_pass()
+        pub = Publisher(root, staging_dir=os.path.join(td, "staging"))
+        pub.publish_base("base", model, trainer.params, table,
+                         batch_size=bsz,
+                         key_capacity=bsz * conf.max_feasigns_per_ins,
+                         dense_dim=dense, feed_conf=conf)
+
+        server = ScoringServer()
+        syncer = Syncer(root, server, "live",
+                        cache_dir=os.path.join(td, "cache"),
+                        poll_interval_s=0.05)
+        syncer.poll_once()
+        syncer.start()
+        port = server.start(port=0)
+        probe = synth_line().encode()
+
+        source = TailingFileSource(stream, poll_interval_s=0.02)
+        sched = MiniPassScheduler(source, conf, window_records=4 * bsz,
+                                  window_seconds=0.5)
+        policy = DeadlinePublishPolicy(pub, max_staleness_s,
+                                       scheduler=sched)
+        runner = StreamingTrainer(
+            trainer, table, sched, policy=policy, model=model,
+            served_seq_fn=lambda: (server.model_version("live")
+                                   or {}).get("seq"),
+        )
+        source.start()
+        sched.start()
+
+        scores_ok = [0]
+
+        def writer():
+            t0 = time.monotonic()
+            with open(os.path.join(stream, "part-000"), "w",
+                      buffering=1) as fh:
+                while time.monotonic() - t0 < duration_s:
+                    fh.write(synth_line())
+                    time.sleep(1.0 / rate)
+            runner.stop()
+
+        def prober():
+            while not runner._stop_evt.is_set():
+                try:
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/score/live", data=probe,
+                        method="POST")
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        r.read()
+                    scores_ok[0] += 1
+                except Exception:
+                    pass
+                time.sleep(0.2)
+
+        threading.Thread(target=writer, daemon=True).start()
+        threading.Thread(target=prober, daemon=True).start()
+        t0 = time.perf_counter()
+        summary = runner.run()
+        dt = time.perf_counter() - t0
+        syncer.stop()
+        server.stop()
+
+    from paddlebox_tpu.telemetry.metrics import Histogram
+
+    def _hist_ms(name):
+        m = telemetry.registry.get(name)
+        if not isinstance(m, Histogram):
+            return {}
+        s = m.summary()
+        if not s["count"]:
+            return {}
+        return {"count": s["count"],
+                "p50_ms": round((s["p50"] or 0) * 1e3, 2),
+                "p99_ms": round((s["p99"] or 0) * 1e3, 2)}
+
+    fresh = _hist_ms("stream.freshness_seconds")
+    gap = _hist_ms("pass.boundary_gap_seconds")
+    res.update(
+        windows=summary["windows"],
+        records=summary["records"],
+        publishes=summary["publishes"],
+        deadline_misses=summary["deadline_misses"],
+        backpressure_widenings=summary["backpressure_widenings"],
+        samples_per_sec=round(summary["records"] / max(dt, 1e-9), 1),
+        freshness_p50_ms=fresh.get("p50_ms"),
+        freshness_p99_ms=fresh.get("p99_ms"),
+        freshness_confirms=fresh.get("count", 0),
+        minipass_gap_p50_ms=gap.get("p50_ms"),
+        minipass_gap_p99_ms=gap.get("p99_ms"),
+        served_probe_ok=scores_ok[0],
+        auc=summary.get("auc"),
+    )
+    log(f"streaming: {res['windows']} windows / {res['records']} records "
+        f"@ {res['samples_per_sec']} samples/s, freshness p50 "
+        f"{res['freshness_p50_ms']} ms p99 {res['freshness_p99_ms']} ms "
+        f"({res['freshness_confirms']} served confirms), gap p50 "
+        f"{res['minipass_gap_p50_ms']} ms, {res['deadline_misses']} "
+        f"deadline misses, {res['served_probe_ok']} probe scores ok")
+    return res
+
+
+def stage_streaming(backend, args) -> None:
+    res = bench_streaming(duration_s=args.stream_seconds,
+                          rate=args.stream_rate,
+                          max_staleness_s=args.stream_staleness)
+    emit({"metric": "streaming_freshness_p99_ms",
+          "value": res.get("freshness_p99_ms"),
+          "unit": "ms p99 (event-time -> served score)",
+          "vs_baseline": None, "backend": backend,
+          "telemetry": telemetry_summary(), **res})
+
+
 def step_cost_for_config(tconf, trconf, n_slots, dense, bsz, hidden,
                          vocab) -> dict:
     """XLA cost analysis (FLOPs / bytes per CALL) of the jitted step at an
@@ -1855,6 +2028,19 @@ def main() -> None:
                     help="open-loop target QPS for --fleet")
     ap.add_argument("--fleet-seconds", type=float, default=12.0,
                     help="load duration for --fleet")
+    ap.add_argument("--streaming", action="store_true",
+                    help="streaming online-learning loop: synthetic "
+                         "append-rate stream -> StreamingTrainer -> "
+                         "deadline publish_delta -> Syncer'd "
+                         "ScoringServer; freshness p50/p99 (event-time "
+                         "-> served score), mini-pass gap, deadline "
+                         "misses, samples/s")
+    ap.add_argument("--stream-seconds", type=float, default=10.0,
+                    help="live-stream duration for --streaming")
+    ap.add_argument("--stream-rate", type=float, default=500.0,
+                    help="append rate (records/s) for --streaming")
+    ap.add_argument("--stream-staleness", type=float, default=1.5,
+                    help="freshness budget (s) for --streaming")
     ap.add_argument("--all", action="store_true",
                     help="one process, every measurement: headline (plain "
                          "AND scan trainer path) + naive, device profile, "
@@ -1894,6 +2080,9 @@ def main() -> None:
     elif args.fleet:
         fail_metric = "fleet_router_p99_ms"
         fail_unit = "ms p99 (8-instance request)"
+    elif args.streaming:
+        fail_metric = "streaming_freshness_p99_ms"
+        fail_unit = "ms p99 (event-time -> served score)"
     elif args.pallas:
         fail_metric, fail_unit = "pallas_vs_xla_gather_scatter", "ms"
     elif args.device_profile:
@@ -1942,6 +2131,10 @@ def main() -> None:
 
     if args.fleet:
         stage_fleet(backend, args)
+        return
+
+    if args.streaming:
+        stage_streaming(backend, args)
         return
 
     if args.all:

@@ -127,6 +127,14 @@ class _Flags:
         # every pass then round-trips its full working set through the
         # host store again (the pre-engine lifecycle, bit-exact by test)
         "hbm_cache": True,
+        # streaming online learning (streaming/): the tail-source root a
+        # StreamingTrainer follows ("" = streaming off; launch.py
+        # --stream-root sets it fleet-wide), the freshness budget that
+        # triggers publish_delta on a max-staleness DEADLINE rather than
+        # pass cadence, and the mini-pass window size in records
+        "stream_root": "",
+        "max_staleness_s": 10.0,
+        "stream_window_records": 1024,
     }
 
     def __getattr__(self, name: str):
@@ -574,6 +582,81 @@ class TelemetryConfig:
             raise ValueError(
                 f"metrics_port must be in [0, 65535], got {self.metrics_port}"
             )
+
+
+# --------------------------------------------------------------------------- #
+# Streaming online learning — the policy object of paddlebox_tpu/streaming/:
+# how records arrive (tail root / buffer bound), how mini-pass windows are
+# cut (record count and/or wall-clock age), and the freshness budget the
+# deadline publisher must honor.  The reference's production loop is
+# continuous at PASS cadence (BoxHelper day/pass chains); this config is
+# the second-level-freshness contract layered on top of it.
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass
+class StreamingConfig:
+    """Knobs for the streaming plane (source → mini-pass → deadline publish).
+
+    max_staleness_s is the end-to-end freshness budget: the deadline
+    publisher aims to have every event's effect PUBLISHED (and, with a
+    serving confirmation wired, served) within this many seconds of the
+    event entering the stream; misses are counted, never hidden
+    (``stream.deadline_misses``).
+    """
+
+    # tailing file-set source root ("" = the caller supplies a source)
+    stream_root: str = ""
+    # freshness budget (s): publish_delta fires on this deadline
+    max_staleness_s: float = 10.0
+    # mini-pass window size in records (the scheduler may widen it under
+    # publish backpressure, up to max_window_records)
+    window_records: int = 1024
+    # additionally cut a non-empty window once its oldest record is this
+    # old (s); 0 = cut by record count only
+    window_seconds: float = 1.0
+    # bounded source buffer: past it the producer blocks (backpressure to
+    # the tail poll / socket reader), nothing is dropped
+    buffer_records: int = 1 << 16
+    # tail-source poll cadence (s)
+    tail_poll_interval_s: float = 0.05
+    # windows staged ahead of training (census pre-computed); small — the
+    # whole point is bounded lag, not deep pipelines
+    max_pending_windows: int = 2
+    # backpressure: window growth factor when publish lags/fails, and the
+    # cap it may never exceed
+    widen_factor: float = 2.0
+    max_window_records: int = 1 << 20
+    # fraction of the staleness budget spent accumulating before the
+    # publisher triggers (the rest is headroom for publish + sync)
+    trigger_fraction: float = 0.5
+    # drain-and-checkpoint shutdown + periodic persistence: write an
+    # AutoCheckpointer pass record every N windows (0 = only at shutdown)
+    checkpoint_every_windows: int = 0
+
+    @staticmethod
+    def from_flags() -> "StreamingConfig":
+        return StreamingConfig(
+            stream_root=flags.stream_root,
+            max_staleness_s=flags.max_staleness_s,
+            window_records=flags.stream_window_records,
+        )
+
+    def __post_init__(self):
+        if self.max_staleness_s <= 0:
+            raise ValueError("max_staleness_s must be positive")
+        if self.window_records < 1:
+            raise ValueError("window_records must be >= 1")
+        if self.window_seconds < 0:
+            raise ValueError("window_seconds must be >= 0")
+        if not 0 < self.trigger_fraction <= 1.0:
+            raise ValueError("trigger_fraction must be in (0, 1]")
+        if self.widen_factor < 1.0:
+            raise ValueError("widen_factor must be >= 1")
+        if self.max_window_records < self.window_records:
+            raise ValueError(
+                "max_window_records must be >= window_records"
+            )
+        if self.max_pending_windows < 1:
+            raise ValueError("max_pending_windows must be >= 1")
 
 
 # --------------------------------------------------------------------------- #

@@ -45,6 +45,36 @@ def make_synth_config(
     )
 
 
+def stream_line(
+    rng: np.random.Generator,
+    label: int,
+    n_sparse_slots: int = 2,
+    dense_dim: int = 2,
+    hot_keys: Optional[Sequence[int]] = None,
+    vocab_per_slot: int = 40,
+) -> str:
+    """One slot-text record for a synthetic LIVE stream (newline-terminated).
+
+    hot_keys: one key per slot that appears in EVERY record (plus one
+    noise key drawn per slot) — the controllable signal a streaming test
+    flips the label of to watch the served score move.  None = noise
+    keys only (an uncorrelated stream, the bench's append-rate filler).
+    """
+    parts = [f"1 {label}"]
+    for s in range(n_sparse_slots):
+        noise = int(rng.integers(1, vocab_per_slot)) + s * 1000
+        if hot_keys is not None:
+            parts.append(f"2 {hot_keys[s]} {noise}")
+        else:
+            parts.append(f"2 {noise} {noise + 1}")
+    if dense_dim:
+        parts.append(
+            f"{dense_dim} "
+            + " ".join(f"{v:.3f}" for v in rng.normal(size=dense_dim))
+        )
+    return " ".join(parts) + "\n"
+
+
 def write_synth_files(
     out_dir: str,
     n_files: int = 2,

@@ -51,6 +51,8 @@ def rank_env(
     metrics_port: Optional[int] = None,
     trace_dir: Optional[str] = None,
     publish_root: Optional[str] = None,
+    stream_root: Optional[str] = None,
+    max_staleness_s: Optional[float] = None,
 ) -> dict:
     """Child environment for one rank (exported for tests/embedders)."""
     env = dict(base_env if base_env is not None else os.environ)
@@ -74,6 +76,14 @@ def rank_env(
         # Publisher ships base/delta model units here each pass — one
         # launcher knob points the whole fleet at the serving plane
         env["PBOX_PUBLISH_ROOT"] = publish_root
+    if stream_root:
+        # streaming online learning (streaming/): the training script's
+        # StreamingTrainer tails this root for live records
+        # (StreamingConfig.from_flags consumes it)
+        env["PBOX_STREAM_ROOT"] = stream_root
+    if max_staleness_s is not None:
+        # the freshness budget the deadline publisher must honor
+        env["PBOX_MAX_STALENESS_S"] = str(max_staleness_s)
     if devices_per_proc:
         import re
 
@@ -125,6 +135,8 @@ def launch(
     publish_root: Optional[str] = None,
     serve_replicas: int = 0,
     serve_router_port: Optional[int] = None,
+    stream_root: Optional[str] = None,
+    max_staleness_s: Optional[float] = None,
 ) -> int:
     """Spawn nproc ranks of ``python script_args...``; return the first
     non-zero exit code (0 if all ranks succeed).  Any rank dying kills the
@@ -169,6 +181,7 @@ def launch(
             liveness_deadline_s=liveness_deadline_s,
             metrics_port=metrics_port, trace_dir=trace_dir,
             publish_root=publish_root,
+            stream_root=stream_root, max_staleness_s=max_staleness_s,
         )
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
@@ -279,6 +292,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("--serve-router-port", type=int, default=None,
                     help="port of the co-run fleet's router "
                          "(default PBOX_ROUTER_PORT)")
+    ap.add_argument("--stream-root", default=None,
+                    help="streaming online learning: the tail-source "
+                         "root the job's StreamingTrainer follows "
+                         "(PBOX_STREAM_ROOT)")
+    ap.add_argument("--max-staleness-s", type=float, default=None,
+                    help="streaming freshness budget: publish_delta "
+                         "fires on this deadline rather than pass "
+                         "cadence (PBOX_MAX_STALENESS_S)")
     ap.add_argument("script", help="training script to run")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -295,6 +316,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         publish_root=args.publish_root,
         serve_replicas=args.serve_replicas,
         serve_router_port=args.serve_router_port,
+        stream_root=args.stream_root,
+        max_staleness_s=args.max_staleness_s,
     )
 
 

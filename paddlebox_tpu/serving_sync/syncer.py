@@ -154,6 +154,16 @@ class Syncer:
         self.degraded_lag_entries = int(degraded_lag_entries)
         self._consecutive_poll_failures = 0
 
+    @property
+    def applied_seq(self) -> int:
+        """Newest donefile seq applied into the live server (-1 before the
+        first model).  The serving-side freshness confirmation the
+        streaming plane's event→served tracker polls
+        (``StreamingTrainer(served_seq_fn=lambda: syncer.applied_seq)``):
+        by install order the swap into the server happens BEFORE this
+        advances, so a reported seq is always actually servable."""
+        return self._applied_seq
+
     # -- poll --------------------------------------------------------------- #
     def _read_entries(self) -> List[PublishEntry]:
         donefile = os.path.join(self.root, DONEFILE_NAME)

@@ -1,0 +1,49 @@
+"""Streaming online learning plane: second-level freshness, feed to scores.
+
+The continuous half of the reference's production loop (PAPER.md
+§Production loop) rebuilt on the parts the batch system already grew:
+
+  * :mod:`~paddlebox_tpu.streaming.source` — watermarked record sources
+    over a bounded backpressured buffer: a tailing file-set source
+    (follows growing part files + newly appearing shards, torn-tail
+    tolerant), a TCP socket source, and a replayable iterable source;
+  * :mod:`~paddlebox_tpu.streaming.minipass` — the sliding mini-pass
+    scheduler: cut windows by record count and/or wall-clock, parse and
+    census them on the source thread so ``SparseTable.prepare_pass``
+    overlaps the current window's training;
+  * :mod:`~paddlebox_tpu.streaming.freshness` — the deadline publisher:
+    ``publish_delta`` fires on a max-staleness deadline rather than pass
+    cadence, health-gated, with backpressure (window widening) when
+    publish or sync lags, and an event→served freshness tracker;
+  * :mod:`~paddlebox_tpu.streaming.runner` — ``StreamingTrainer``, the
+    loop wiring trainer + source + policy + the existing watchdog /
+    NaN-rollback guards, with drain-and-checkpoint shutdown.
+"""
+
+from paddlebox_tpu.streaming.freshness import DeadlinePublishPolicy
+from paddlebox_tpu.streaming.minipass import (
+    MiniPassScheduler,
+    MiniPassWindow,
+    WindowDataset,
+)
+from paddlebox_tpu.streaming.runner import StreamingTrainer
+from paddlebox_tpu.streaming.source import (
+    IterableSource,
+    SocketSource,
+    StreamRecord,
+    StreamSource,
+    TailingFileSource,
+)
+
+__all__ = [
+    "DeadlinePublishPolicy",
+    "IterableSource",
+    "MiniPassScheduler",
+    "MiniPassWindow",
+    "SocketSource",
+    "StreamRecord",
+    "StreamSource",
+    "StreamingTrainer",
+    "TailingFileSource",
+    "WindowDataset",
+]

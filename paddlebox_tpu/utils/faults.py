@@ -88,6 +88,13 @@ KNOWN_SITES = {
     # next candidate) and the supervisor's crashed-replica respawn
     # (failure => retried on the next babysit tick with deeper backoff)
     "fleet.probe", "fleet.route", "fleet.restart",
+    # streaming online learning (streaming/): the tail source's poll
+    # (failure => counted + retried next poll; a hang wedges the feed and
+    # the watchdog's `feed` stage must catch it), the mini-pass window cut
+    # (failure => cut deferred, records merge into the next window) and
+    # the deadline-triggered publish (failure => rows stay in the delta
+    # tracker and the next window retries — at-least-once delivery)
+    "stream.tail", "stream.cut", "stream.publish_deadline",
 }
 
 

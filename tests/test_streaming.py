@@ -1,0 +1,734 @@
+"""Streaming online learning plane (ARCHITECTURE.md "Streaming online
+learning"): sources (tailing/socket, torn-tail discipline), the
+mini-pass scheduler, the deadline publish policy, the watchdog guard
+over a wedged feed, the mini-pass determinism pin on both trainer
+paths, and the headline e2e — a label flip appended to the live stream
+measurably moves the SERVED score (through a real Syncer'd
+ScoringServer) within a bounded number of seconds, with
+``stream.freshness_seconds`` recording the event→served latency."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from paddlebox_tpu import telemetry
+from paddlebox_tpu.config import (
+    LivenessConfig,
+    SparseTableConfig,
+    StreamingConfig,
+    TrainerConfig,
+)
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.streaming import (
+    DeadlinePublishPolicy,
+    IterableSource,
+    MiniPassScheduler,
+    SocketSource,
+    StreamingTrainer,
+    TailingFileSource,
+)
+from paddlebox_tpu.train.trainer import Trainer
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.faults import fault_plan
+from paddlebox_tpu.utils.monitor import stats
+
+
+def _drain(source, n, timeout=5.0):
+    """Collect up to n records from a source (test helper)."""
+    out = []
+    deadline = time.monotonic() + timeout
+    while len(out) < n and time.monotonic() < deadline:
+        rec = source.get(timeout=0.05)
+        if rec is not None:
+            out.append(rec)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# sources
+# --------------------------------------------------------------------------- #
+class TestTailingSource:
+    def test_follows_growth_and_new_shards(self, tmp_path):
+        src = TailingFileSource(str(tmp_path), poll_interval_s=0.01).start()
+        try:
+            p0 = tmp_path / "part-000"
+            p0.write_text("a 1\nb 2\n")
+            got = _drain(src, 2)
+            assert [r.line for r in got] == ["a 1", "b 2"]
+            # growth of an existing file + a newly appearing shard
+            with open(p0, "a") as fh:
+                fh.write("c 3\n")
+            (tmp_path / "part-001").write_text("d 4\ne 5\n")
+            got = _drain(src, 3)
+            assert sorted(r.line for r in got) == ["c 3", "d 4", "e 5"]
+            assert src.watermark() is not None
+        finally:
+            src.close()
+        assert src.drained
+
+    def test_tmp_and_hidden_files_skipped(self, tmp_path):
+        (tmp_path / "part-000.tmp").write_text("staging 1\n")
+        (tmp_path / ".hidden").write_text("hidden 1\n")
+        (tmp_path / "part-001").write_text("real 1\n")
+        src = TailingFileSource(str(tmp_path), poll_interval_s=0.01).start()
+        try:
+            got = _drain(src, 1)
+            assert [r.line for r in got] == ["real 1"]
+            assert src.get(timeout=0.2) is None
+        finally:
+            src.close()
+
+    def test_torn_tail_held_back_and_reread_whole(self, tmp_path):
+        """The satellite pin: a partially written last line is NEVER
+        emitted torn — it is held back and re-read whole once the writer
+        finishes it — and parsing the stream quarantines nothing."""
+        conf = make_synth_config(n_sparse_slots=2, dense_dim=2,
+                                 batch_size=8, max_feasigns_per_ins=8)
+        p = tmp_path / "part-000"
+        full = "1 1 2 5 9 2 105 3 2 0.1 0.2"
+        with open(p, "w") as fh:
+            for _ in range(3):
+                fh.write(full + "\n")
+            fh.write("1 0 2 7 11 2 10")  # torn mid-append: no newline
+        src = TailingFileSource(str(tmp_path), poll_interval_s=0.01).start()
+        try:
+            got = _drain(src, 3)
+            assert len(got) == 3
+            # the torn fragment is held, not emitted
+            assert src.get(timeout=0.3) is None
+            assert src.torn_tails_held > 0
+            # writer finishes the line: it must arrive WHOLE
+            with open(p, "a") as fh:
+                fh.write("8 9 2 0.3 0.4\n")
+            got2 = _drain(src, 1)
+            assert [r.line for r in got2] == ["1 0 2 7 11 2 108 9 2 0.3 0.4"]
+        finally:
+            src.close()
+        # the quarantine counter stays at zero: nothing ever parsed torn
+        q0 = stats.get("data.quarantined_lines")
+        from paddlebox_tpu.data.slot_parser import SlotParser
+
+        block = SlotParser(conf).parse_lines(
+            [r.line for r in got + got2]
+        )
+        assert block.n_ins == 4
+        assert stats.get("data.quarantined_lines") == q0
+        assert {7, 11, 108}.issubset(set(int(k) for k in block.keys))
+
+    def test_backpressure_blocks_producer_without_loss(self, tmp_path):
+        (tmp_path / "part-000").write_text(
+            "".join(f"r {i}\n" for i in range(50))
+        )
+        src = TailingFileSource(str(tmp_path), poll_interval_s=0.01,
+                                buffer_records=8).start()
+        try:
+            time.sleep(0.3)  # producer fills the bounded buffer and blocks
+            assert src.depth() <= 8
+            got = _drain(src, 50)
+            assert [r.line for r in got] == [f"r {i}" for i in range(50)]
+        finally:
+            src.close()
+
+
+class TestSocketSource:
+    def test_lines_across_sends_and_torn_final(self):
+        import socket as socketlib
+
+        src = SocketSource().start()
+        try:
+            c = socketlib.create_connection(("127.0.0.1", src.port))
+            c.sendall(b"one 1\ntwo")
+            time.sleep(0.1)
+            c.sendall(b" 2\nthree 3\n")
+            c.sendall(b"torn-fragment")  # no newline, then the sender dies
+            c.close()
+            got = _drain(src, 3)
+            assert [r.line for r in got] == ["one 1", "two 2", "three 3"]
+            assert src.get(timeout=0.3) is None  # fragment never emitted
+        finally:
+            src.close()
+
+
+# --------------------------------------------------------------------------- #
+# mini-pass scheduler
+# --------------------------------------------------------------------------- #
+def _lines(n, label=1, seed=0, n_slots=2, dense=2):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        parts = [f"1 {label}"]
+        for s in range(n_slots):
+            k = int(rng.integers(1, 40)) + s * 1000
+            parts.append(f"2 {k} {k + 1}")
+        parts.append(
+            f"{dense} " + " ".join(f"{v:.3f}" for v in rng.normal(size=dense))
+        )
+        out.append(" ".join(parts))
+    return out
+
+
+class TestMiniPassScheduler:
+    CONF = make_synth_config(n_sparse_slots=2, dense_dim=2, batch_size=8,
+                             max_feasigns_per_ins=8)
+
+    def test_cut_by_count_then_drain(self):
+        src = IterableSource(_lines(25)).start()
+        sched = MiniPassScheduler(src, self.CONF, window_records=10,
+                                  window_seconds=0.0).start()
+        try:
+            wins = []
+            while True:
+                w = sched.next_window(timeout=1.0)
+                if w is None and sched.done:
+                    break
+                if w is not None:
+                    wins.append(w)
+            assert [w.n_records for w in wins] == [10, 10, 5]
+            assert [w.cut_reason for w in wins] == ["count", "count", "drain"]
+            for w in wins:
+                assert np.array_equal(w.census, np.unique(w.block.keys))
+                assert w.first_event_ts <= w.last_event_ts
+            assert [w.index for w in wins] == [0, 1, 2]
+        finally:
+            sched.close()
+            src.close()
+
+    def test_cut_by_wall_clock(self):
+        src = IterableSource(_lines(3)).start()
+        # huge record bound: only the age trigger (or drain) can cut
+        sched = MiniPassScheduler(src, self.CONF, window_records=10_000,
+                                  window_seconds=0.2).start()
+        try:
+            w = sched.next_window(timeout=3.0)
+            assert w is not None and w.n_records == 3
+            assert w.cut_reason in ("time", "drain")
+        finally:
+            sched.close()
+            src.close()
+
+    def test_window_dataset_batches(self):
+        src = IterableSource(_lines(20)).start()
+        sched = MiniPassScheduler(src, self.CONF, window_records=20).start()
+        try:
+            w = sched.next_window(timeout=3.0)
+            ds = sched.dataset(w)
+            assert ds.unique_keys() is w.census
+            batches = list(ds.batches())
+            assert [b.n_real_ins for b in batches] == [8, 8, 4]
+        finally:
+            sched.close()
+            src.close()
+
+    def test_injected_cut_fault_defers_never_drops(self):
+        with fault_plan({"stream.cut": "first:1"}):
+            src = IterableSource(_lines(10)).start()
+            sched = MiniPassScheduler(src, self.CONF,
+                                      window_records=5).start()
+            try:
+                wins = []
+                while True:
+                    w = sched.next_window(timeout=1.0)
+                    if w is None and sched.done:
+                        break
+                    if w is not None:
+                        wins.append(w)
+                # the first cut was deferred: its records merged into the
+                # next window — total preserved, nothing dropped
+                assert sched.cut_deferrals >= 1
+                assert sum(w.n_records for w in wins) == 10
+            finally:
+                sched.close()
+                src.close()
+
+    def test_wait_census_matches_next_window(self):
+        src = IterableSource(_lines(16)).start()
+        sched = MiniPassScheduler(src, self.CONF, window_records=8).start()
+        try:
+            census = sched.wait_census(timeout=3.0)
+            w = sched.next_window(timeout=3.0)
+            assert np.array_equal(census, w.census)
+        finally:
+            sched.close()
+            src.close()
+
+
+# --------------------------------------------------------------------------- #
+# watchdog guard: a wedged tail source must be caught, not hung on
+# --------------------------------------------------------------------------- #
+def test_wedged_tail_source_caught_by_watchdog_feed_stage(tmp_path):
+    """The satellite chaos pin: a hang injected at ``stream.tail`` wedges
+    the feed; the runner's liveness watchdog names the ``feed`` stage in
+    a structured DistributedStallError instead of stalling silently."""
+    from paddlebox_tpu.parallel.watchdog import DistributedStallError
+
+    conf = make_synth_config(n_sparse_slots=2, dense_dim=2, batch_size=8,
+                             max_feasigns_per_ins=8)
+    tconf = SparseTableConfig(embedding_dim=4, store_buckets=4,
+                              plan_scratch_rows=32)
+    model = CtrDnn(2, tconf.row_width, dense_dim=2, hidden=(4,))
+    table = SparseTable(tconf, seed=0)
+    trainer = Trainer(
+        model, tconf,
+        TrainerConfig(
+            auc_buckets=1 << 10,
+            liveness=LivenessConfig(
+                deadline_s=1.0, heartbeat_interval_s=0.2,
+                poll_interval_s=0.05,
+            ),
+        ),
+        seed=0,
+    )
+    with fault_plan({"stream.tail": "hang:first:1"}):
+        src = TailingFileSource(str(tmp_path), poll_interval_s=0.02).start()
+        sched = MiniPassScheduler(src, conf, window_records=8).start()
+        runner = StreamingTrainer(trainer, table, sched)
+        t0 = time.monotonic()
+        with pytest.raises(DistributedStallError) as ei:
+            runner.run(max_seconds=30.0)
+        assert ei.value.stage == "feed"
+        assert ei.value.kind == "local"
+        # caught promptly: ~deadline, nowhere near the 30s cap
+        assert time.monotonic() - t0 < 15.0
+    assert stats.get("faults.hung.stream.tail") >= 1
+
+
+# --------------------------------------------------------------------------- #
+# determinism pin: N mini-passes == one batch pass, both trainer paths
+# --------------------------------------------------------------------------- #
+N_SLOTS, DENSE, B = 3, 2, 16
+N_INS = 384  # 3 windows of 128 = 8 batches of 16
+
+
+def _det_tconf():
+    return SparseTableConfig(
+        embedding_dim=4, learning_rate=0.4, initial_range=0.05,
+        store_buckets=16, plan_scratch_rows=64,
+    )
+
+
+@pytest.fixture(scope="module")
+def det_records(tmp_path_factory):
+    """A fixed record sequence, both as files (the batch baseline) and as
+    the ordered line list (the stream replay)."""
+    conf = make_synth_config(
+        n_sparse_slots=N_SLOTS, dense_dim=DENSE, batch_size=B,
+        max_feasigns_per_ins=16,
+    )
+    d = tmp_path_factory.mktemp("det")
+    files = write_synth_files(
+        str(d), n_files=2, ins_per_file=N_INS // 2, n_sparse_slots=N_SLOTS,
+        vocab_per_slot=40, dense_dim=DENSE, seed=17,
+    )
+    lines = []
+    for f in files:
+        with open(f) as fh:
+            lines += [ln for ln in fh.read().splitlines() if ln.strip()]
+    assert len(lines) == N_INS
+    return conf, files, lines
+
+
+def _fresh_single(seed=3):
+    tconf = _det_tconf()
+    table = SparseTable(tconf, seed=seed)
+    model = CtrDnn(N_SLOTS, tconf.row_width, dense_dim=DENSE, hidden=(16, 8))
+    trainer = Trainer(
+        model, tconf, TrainerConfig(dense_lr=3e-3, auc_buckets=1 << 12),
+        seed=seed,
+    )
+    return table, trainer
+
+
+def _assert_state_equal(a, b):
+    assert np.array_equal(a["keys"], b["keys"])
+    # values carry [show, clk, embed..., g2sum]: exact equality pins the
+    # counters, the embeddings AND the optimizer state bit-for-bit
+    assert np.array_equal(a["values"], b["values"])
+
+
+class TestMiniPassDeterminism:
+    def test_single_chip_minipasses_match_one_pass(self, det_records):
+        conf, files, lines = det_records
+        # batch baseline: ONE pass over the whole record set
+        table, trainer = _fresh_single()
+        ds = PadBoxSlotDataset(conf, read_threads=2)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        table.begin_pass(ds.unique_keys())
+        m_batch = trainer.train_from_dataset(ds, table)
+        table.end_pass()
+        sd_batch, delta_batch = table.state_dict(), table.pop_delta()
+        ds.close()
+
+        # streaming: the SAME records replayed through the mini-pass loop
+        # (window = 8 batches, so batch boundaries are preserved)
+        table2, trainer2 = _fresh_single()
+        src = IterableSource(lines).start()
+        sched = MiniPassScheduler(src, conf, window_records=8 * B,
+                                  window_seconds=0.0).start()
+        runner = StreamingTrainer(trainer2, table2, sched)
+        summary = runner.run()
+        assert summary["windows"] == 3
+        assert summary["records"] == N_INS
+        sd_stream, delta_stream = table2.state_dict(), table2.pop_delta()
+
+        _assert_state_equal(sd_batch, sd_stream)
+        _assert_state_equal(delta_batch, delta_stream)
+        # the metric stream carried across windows equals the single pass
+        assert summary["auc"] == m_batch["auc"]
+
+    def test_multichip_minipasses_match_one_pass(self, det_records):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the conftest 8-device CPU mesh")
+        from paddlebox_tpu.parallel import (
+            MultiChipTrainer,
+            ShardedSparseTable,
+            make_mesh,
+        )
+
+        conf, files, lines = det_records
+
+        def fresh():
+            mesh = make_mesh(8)
+            tconf = _det_tconf()
+            table = ShardedSparseTable(tconf, mesh, seed=3)
+            model = CtrDnn(N_SLOTS, tconf.row_width, dense_dim=DENSE,
+                           hidden=(16, 8))
+            trainer = MultiChipTrainer(
+                model, tconf, mesh,
+                TrainerConfig(dense_lr=3e-3, auc_buckets=1 << 12), seed=3,
+            )
+            return table, trainer
+
+        table, trainer = fresh()
+        ds = PadBoxSlotDataset(conf, read_threads=2)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        table.begin_pass(ds.unique_keys())
+        m_batch = trainer.train_from_dataset(ds, table)
+        table.end_pass()
+        sd_batch = table.state_dict()
+        ds.close()
+
+        # window = n_local * B records = exactly one device group, so the
+        # group composition (which batch lands on which device) is
+        # identical and cross-device update merge order is preserved
+        table2, trainer2 = fresh()
+        src = IterableSource(lines).start()
+        sched = MiniPassScheduler(src, conf, window_records=8 * B,
+                                  window_seconds=0.0).start()
+        runner = StreamingTrainer(trainer2, table2, sched)
+        summary = runner.run()
+        assert summary["windows"] == 3
+        _assert_state_equal(sd_batch, table2.state_dict())
+        assert summary["auc"] == m_batch["auc"]
+
+
+# --------------------------------------------------------------------------- #
+# deadline publish policy
+# --------------------------------------------------------------------------- #
+class _StubEntry:
+    def __init__(self, seq):
+        self.seq = seq
+
+
+class _StubPublisher:
+    def __init__(self, fail=0):
+        self.seqs = []
+        self.fail = fail
+
+    @property
+    def next_seq(self):
+        return len(self.seqs)
+
+    def publish_delta(self, tag, table, model=None, params=None,
+                      metrics=None, **kw):
+        if self.fail > 0:
+            self.fail -= 1
+            raise RuntimeError("publish root down")
+        e = _StubEntry(self.next_seq)
+        self.seqs.append(tag)
+        return e
+
+
+class _StubWindow:
+    def __init__(self, age_s):
+        now = time.time()
+        self.first_event_ts = now - age_s
+        self.last_event_ts = now
+
+
+class _StubScheduler:
+    def __init__(self, window_records=100):
+        self.window_records = window_records
+
+
+class TestDeadlinePublishPolicy:
+    def test_due_on_deadline_not_cadence(self):
+        pol = DeadlinePublishPolicy(_StubPublisher(), max_staleness_s=10.0,
+                                    trigger_fraction=0.5)
+        assert not pol.due()  # nothing unpublished
+        pol.observe_window(_StubWindow(age_s=1.0))
+        assert not pol.due()  # fresh: 1s < 5s trigger
+        pol2 = DeadlinePublishPolicy(_StubPublisher(), max_staleness_s=10.0,
+                                     trigger_fraction=0.5)
+        pol2.observe_window(_StubWindow(age_s=6.0))
+        assert pol2.due()  # 6s >= 5s trigger
+
+    def test_publish_resets_and_counts_misses(self):
+        pub = _StubPublisher()
+        pol = DeadlinePublishPolicy(pub, max_staleness_s=0.5)
+        pol.observe_window(_StubWindow(age_s=2.0))  # already past budget
+        entry = pol.maybe_publish(table=None)
+        assert entry is not None and pub.seqs
+        assert pol.deadline_misses == 1  # 2s > 0.5s budget at publish
+        assert not pol.due()  # the unpublished-window tracker reset
+
+    def test_failure_widens_and_retries_at_least_once(self):
+        sched = _StubScheduler(window_records=100)
+        pub = _StubPublisher(fail=1)
+        pol = DeadlinePublishPolicy(pub, max_staleness_s=10.0,
+                                    scheduler=sched, widen_factor=2.0)
+        pol.observe_window(_StubWindow(age_s=20.0))
+        assert pol.maybe_publish(table=None) is None  # first attempt dies
+        assert pol.publish_failures == 1
+        assert sched.window_records == 200  # backpressure widened
+        assert pol.due()  # the window is STILL unpublished
+        assert pol.maybe_publish(table=None) is not None  # retried ok
+        assert pol.widenings == 1
+
+    def test_injected_publish_deadline_fault(self):
+        sched = _StubScheduler()
+        pub = _StubPublisher()
+        pol = DeadlinePublishPolicy(pub, max_staleness_s=10.0,
+                                    scheduler=sched)
+        pol.observe_window(_StubWindow(age_s=20.0))
+        with fault_plan({"stream.publish_deadline": "first:1"}):
+            assert pol.maybe_publish(table=None) is None
+            assert pub.seqs == []  # the fault fired BEFORE the publisher
+            assert pol.maybe_publish(table=None) is not None
+        assert stats.get("faults.injected.stream.publish_deadline") >= 1
+
+    def test_served_confirmation_records_freshness(self):
+        pub = _StubPublisher()
+        pol = DeadlinePublishPolicy(pub, max_staleness_s=1.0)
+        pol.track_served()
+        pol.observe_window(_StubWindow(age_s=0.2))
+        pol.maybe_publish(table=None, force=True)
+        assert pol.outstanding == 1
+        assert pol.deadline_misses == 0  # judged at serve time now
+        # serving confirms seq 0 late: freshness > budget => miss
+        assert pol.confirm_served(0, now=time.time() + 2.0) == 1
+        assert pol.outstanding == 0
+        assert pol.deadline_misses == 1
+        assert pol.last_freshness_s > 1.0
+
+
+def test_streaming_config_from_flags(monkeypatch):
+    monkeypatch.setenv("PBOX_STREAM_ROOT", "/tmp/sroot")
+    monkeypatch.setenv("PBOX_MAX_STALENESS_S", "3.5")
+    monkeypatch.setenv("PBOX_STREAM_WINDOW_RECORDS", "256")
+    sc = StreamingConfig.from_flags()
+    assert sc.stream_root == "/tmp/sroot"
+    assert sc.max_staleness_s == 3.5
+    assert sc.window_records == 256
+
+
+def test_from_config_builds_and_trains(tmp_path, monkeypatch):
+    """The flags→config→plane wiring: PBOX_STREAM_ROOT + friends (what
+    ``launch.py --stream-root/--max-staleness-s`` export fleet-wide) are
+    enough to build and run the whole plane via
+    StreamingTrainer.from_config — no hand wiring."""
+    stream = tmp_path / "stream"
+    stream.mkdir()
+    monkeypatch.setenv("PBOX_STREAM_ROOT", str(stream))
+    monkeypatch.setenv("PBOX_MAX_STALENESS_S", "5.0")
+    monkeypatch.setenv("PBOX_STREAM_WINDOW_RECORDS", "16")
+
+    conf = make_synth_config(n_sparse_slots=2, dense_dim=2, batch_size=8,
+                             max_feasigns_per_ins=8)
+    tconf = SparseTableConfig(embedding_dim=4, store_buckets=4,
+                              plan_scratch_rows=32)
+    model = CtrDnn(2, tconf.row_width, dense_dim=2, hidden=(4,))
+    table = SparseTable(tconf, seed=0)
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10),
+                      seed=0)
+    runner = StreamingTrainer.from_config(trainer, table, conf)
+    assert runner.scheduler.window_records == 16
+    (stream / "part-000").write_text("\n".join(_lines(32)) + "\n")
+
+    def write_then_stop():
+        time.sleep(0.6)
+        runner.stop()
+
+    threading.Thread(target=write_then_stop, daemon=True).start()
+    summary = runner.run(max_seconds=20.0)
+    assert summary["windows"] == 2
+    assert summary["records"] == 32
+    assert table.n_features > 0
+
+
+def test_from_config_requires_a_root():
+    conf = make_synth_config(n_sparse_slots=2, dense_dim=2, batch_size=8)
+    with pytest.raises(ValueError, match="stream_root is empty"):
+        StreamingTrainer.from_config(
+            trainer=None, table=None, feed_conf=conf,
+            stream_conf=StreamingConfig(stream_root=""),
+        )
+
+
+def test_launch_env_carries_stream_flags():
+    from paddlebox_tpu.launch import rank_env
+
+    env = rank_env(0, 1, "127.0.0.1:1234", stream_root="/data/stream",
+                   max_staleness_s=2.0)
+    assert env["PBOX_STREAM_ROOT"] == "/data/stream"
+    assert env["PBOX_MAX_STALENESS_S"] == "2.0"
+
+
+# --------------------------------------------------------------------------- #
+# the headline e2e: label flip -> served score moves within seconds
+# --------------------------------------------------------------------------- #
+def _fresh_count(name="stream.freshness_seconds"):
+    from paddlebox_tpu.telemetry.metrics import Histogram
+
+    m = telemetry.registry.get(name)
+    return m.summary()["count"] if isinstance(m, Histogram) else 0
+
+
+def test_e2e_label_flip_moves_served_score(tmp_path):
+    """The acceptance pin: a label flip appended to the LIVE stream moves
+    the served score (through a real Publisher → donefile → Syncer →
+    ScoringServer chain) within a bounded number of seconds on CPU, and
+    ``stream.freshness_seconds`` records the event→served latency."""
+    from paddlebox_tpu.data.feed import BatchBuilder
+    from paddlebox_tpu.data.slot_parser import SlotParser
+    from paddlebox_tpu.inference import ScoringServer
+    from paddlebox_tpu.serving_sync import Publisher, Syncer
+    from paddlebox_tpu.streaming.minipass import MiniPassWindow, WindowDataset
+
+    S, D, Bsz = 2, 2, 16
+    conf = make_synth_config(n_sparse_slots=S, dense_dim=D, batch_size=Bsz,
+                             max_feasigns_per_ins=8)
+    tconf = SparseTableConfig(embedding_dim=4, learning_rate=0.3,
+                              store_buckets=8, plan_scratch_rows=64)
+    model = CtrDnn(S, tconf.row_width, dense_dim=D, hidden=(8,))
+    table = SparseTable(tconf, seed=0)
+    trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 12),
+                      seed=0)
+    rng = np.random.default_rng(0)
+
+    from paddlebox_tpu.data.synth import stream_line
+
+    def line(label):
+        # every record carries the hot pair (5, 1005) + one noise key each
+        return stream_line(rng, label, n_sparse_slots=S, dense_dim=D,
+                           hot_keys=(5, 1005))
+
+    # warm pass anchors the delta chain (and pays jit/export off-clock)
+    warm = [line(1) for _ in range(4 * Bsz)]
+    block = SlotParser(conf).parse_lines(warm)
+    w0 = MiniPassWindow(0, block, np.unique(block.keys), len(warm),
+                        time.time(), time.time(), "warm", time.time())
+    table.begin_pass(w0.census)
+    trainer.train_from_dataset(WindowDataset(w0, BatchBuilder(conf)), table)
+    table.end_pass()
+
+    root = str(tmp_path / "publish")
+    stream = str(tmp_path / "stream")
+    os.makedirs(stream)
+    pub = Publisher(root, staging_dir=str(tmp_path / "staging"))
+    pub.publish_base("base", model, trainer.params, table,
+                     batch_size=Bsz,
+                     key_capacity=Bsz * conf.max_feasigns_per_ins,
+                     dense_dim=D, feed_conf=conf)
+
+    server = ScoringServer()
+    syncer = Syncer(root, server, "live", cache_dir=str(tmp_path / "cache"),
+                    poll_interval_s=0.05)
+    syncer.poll_once()
+    syncer.start()
+    port = server.start(port=0)
+    probe = b"1 0 2 5 30 2 1005 1030 2 0.0 0.0\n"
+
+    def score():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/score/live", data=probe, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())["scores"][0]
+
+    source = TailingFileSource(stream, poll_interval_s=0.02)
+    sched = MiniPassScheduler(source, conf, window_records=2 * Bsz,
+                              window_seconds=0.2)
+    policy = DeadlinePublishPolicy(pub, max_staleness_s=1.0,
+                                   scheduler=sched)
+    runner = StreamingTrainer(
+        trainer, table, sched, policy=policy, model=model,
+        served_seq_fn=lambda: (server.model_version("live") or {}).get("seq"),
+    )
+    source.start()
+    sched.start()
+    fresh0 = _fresh_count()
+    run_err = []
+
+    def run():
+        try:
+            runner.run()
+        except BaseException as e:  # surfaced after the join
+            run_err.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    feed = open(os.path.join(stream, "part-000"), "w", buffering=1)
+    try:
+        # phase 1: label-1 traffic until the served score has clearly
+        # learned it (publish + sync happen continuously underneath)
+        high = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            for _ in range(2 * Bsz):
+                feed.write(line(1))
+            time.sleep(0.4)
+            s = score()
+            if s > 0.55:
+                high = s
+                break
+        assert high is not None, "served score never learned label=1"
+
+        # phase 2: THE FLIP — the same hot keys now stream label=0
+        flip_ts = time.monotonic()
+        moved = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            for _ in range(2 * Bsz):
+                feed.write(line(0))
+            time.sleep(0.4)
+            s = score()
+            if s < high - 0.2:
+                moved = time.monotonic() - flip_ts
+                break
+        assert moved is not None, "served score never moved after the flip"
+        # bounded freshness: the flip reached the SERVED model in seconds
+        assert moved < 45.0
+    finally:
+        feed.close()
+        runner.stop()
+        t.join(timeout=60.0)
+        syncer.stop()
+        server.stop()
+    assert not run_err, f"streaming loop died: {run_err!r}"
+    summary = runner.summary()
+    assert summary["publishes"] >= 2
+    # the syncer's public confirmation surface tracked the chain
+    assert syncer.applied_seq >= 1
+    # the event->served freshness histogram recorded the loop
+    assert _fresh_count() > fresh0
+    assert policy.last_freshness_s is not None

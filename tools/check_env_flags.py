@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Env-flag drift check: every PBOX_* var the package reads must be
+documented, and every documented PBOX_* var must still exist.
+
+The env surface is the ops contract: a flag the code reads but no doc
+names is undiscoverable (operators grep ARCHITECTURE.md, not the
+source), and a doc naming a removed flag sends operators chasing knobs
+that do nothing.  This tool cross-checks the two in both directions:
+
+  * **referenced** — the union of (a) the flag-shim entries
+    (``config.py`` ``_Flags._DEFAULTS`` keys, read from the environment
+    as ``PBOX_<NAME>`` — parsed via AST, so dynamically-constructed
+    names are still caught) and (b) literal ``PBOX_*`` tokens anywhere
+    in the package source + bench.py (direct ``os.environ`` reads, and
+    comments naming flags — a comment citing a stale name fails too,
+    which keeps prose honest);
+  * **documented** — every ``PBOX_*`` token in ARCHITECTURE.md and
+    README.md (the "Environment flags" catalog table plus inline
+    mentions).
+
+referenced − documented = undocumented flags (fail); documented −
+referenced = stale docs (fail).  Wired into tier-1 via
+tests/test_env_flags.py, exactly like the metric-name and fault-site
+guards.
+
+Usage:
+    python tools/check_env_flags.py            # check, exit 1 on drift
+    python tools/check_env_flags.py --list     # dump what was found
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG_PY = os.path.join(REPO, "paddlebox_tpu", "config.py")
+DOCS = [os.path.join(REPO, "ARCHITECTURE.md"), os.path.join(REPO, "README.md")]
+
+# a real var name: PBOX_ + at least one more segment ("PBOX_<NAME>"-style
+# placeholder prose matches nothing)
+_VAR_RE = re.compile(r"PBOX_[A-Z][A-Z0-9_]*")
+
+
+def flag_vars() -> dict:
+    """{PBOX_<NAME>: 'config.py:_Flags._DEFAULTS'} parsed statically out
+    of the flag shim (no package import: must run on a bare checkout)."""
+    tree = ast.parse(open(CONFIG_PY).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "_DEFAULTS":
+                    return {
+                        "PBOX_" + ast.literal_eval(k).upper():
+                            "paddlebox_tpu/config.py:_Flags._DEFAULTS"
+                        for k in node.value.keys
+                    }
+    raise SystemExit(f"ERROR: no _DEFAULTS literal found in {CONFIG_PY}")
+
+
+def _source_files() -> list:
+    roots = [os.path.join(REPO, "paddlebox_tpu"),
+             os.path.join(REPO, "bench.py")]
+    files: list = []
+    for root in roots:
+        if root.endswith(".py"):
+            files.append(root)
+            continue
+        for d, _, fs in os.walk(root):
+            files += [os.path.join(d, f) for f in fs if f.endswith(".py")]
+    return sorted(files)
+
+
+def referenced_vars() -> dict:
+    """{var: first 'file:line' seen}: flag-shim entries + every literal
+    PBOX_* token in the package source and bench.py."""
+    found = dict(flag_vars())
+    for path in _source_files():
+        text = open(path).read()
+        rel = os.path.relpath(path, REPO)
+        for m in _VAR_RE.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            found.setdefault(m.group(0), f"{rel}:{line}")
+    return found
+
+
+def documented_vars() -> dict:
+    """{var: first 'doc:line' seen} across ARCHITECTURE.md + README.md."""
+    found: dict = {}
+    for path in DOCS:
+        if not os.path.exists(path):
+            continue
+        text = open(path).read()
+        rel = os.path.relpath(path, REPO)
+        for m in _VAR_RE.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            found.setdefault(m.group(0), f"{rel}:{line}")
+    return found
+
+
+def check() -> tuple:
+    """(undocumented, stale) drift lists: [(var, where), ...]."""
+    referenced = referenced_vars()
+    documented = documented_vars()
+    undocumented = sorted(
+        (var, where) for var, where in referenced.items()
+        if var not in documented
+    )
+    stale = sorted(
+        (var, where) for var, where in documented.items()
+        if var not in referenced
+    )
+    return undocumented, stale
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--list", action="store_true",
+                    help="print every discovered env var and exit 0")
+    args = ap.parse_args(argv)
+    if args.list:
+        documented = documented_vars()
+        for var, where in sorted(referenced_vars().items()):
+            mark = " " if var in documented else "!"
+            print(f"{mark} {var:36s} {where}")
+        return 0
+    undocumented, stale = check()
+    rc = 0
+    if undocumented:
+        print("PBOX_* env vars the package reads but no doc names "
+              "(add a row to ARCHITECTURE.md '## Environment flags'):",
+              file=sys.stderr)
+        for var, where in undocumented:
+            print(f"  {var}  ({where})", file=sys.stderr)
+        rc = 1
+    if stale:
+        print("PBOX_* env vars documented but referenced nowhere "
+              "(stale docs — operators would chase dead knobs):",
+              file=sys.stderr)
+        for var, where in stale:
+            print(f"  {var}  ({where})", file=sys.stderr)
+        rc = 1
+    if rc:
+        print(f"{len(undocumented)} undocumented + {len(stale)} stale; "
+              "fix the catalog or the code.", file=sys.stderr)
+    else:
+        print(f"env-flag catalog OK: {len(referenced_vars())} referenced "
+              f"var(s), all documented, no stale doc entries")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
