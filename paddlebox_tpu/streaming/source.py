@@ -8,9 +8,13 @@ the newest record handed downstream.  ``stream.watermark_lag_seconds``
 the training loop is running.
 
 Backpressure, not loss: when the consumer lags, the producer blocks on
-the bounded buffer (``bounded_put`` re-checks stop, so shutdown never
-deadlocks).  Nothing is ever dropped — the watermark lag grows instead,
-and the freshness policy reacts by widening windows.
+the bounded buffer.  Nothing is ever dropped — the watermark lag grows
+instead, and the freshness policy reacts by widening windows.  Shutdown
+is two-phase so the two properties compose: ``stop()`` is the GRACEFUL
+drain request (the producer performs one final sweep that ignores the
+stop flag, so everything already written still lands in the buffer),
+while ``close()`` escalates to a hard kill only if that drain cannot
+finish within its timeout (consumer gone, buffer full).
 
 Three concrete sources:
 
@@ -83,16 +87,25 @@ class StreamSource:
             maxsize=max(int(buffer_records), 1)
         )
         self._stop_evt = threading.Event()
+        self._kill_evt = threading.Event()  # hard kill: abandon the drain
         self._eof = threading.Event()
         self._wm_lock = threading.Lock()
         self._watermark: Optional[float] = None
 
     # -- producer side ---------------------------------------------------- #
-    def _emit(self, line: str, event_ts: Optional[float] = None) -> bool:
-        """Enqueue one record, blocking under backpressure.  Returns False
-        when the source was stopped before the record fit."""
+    def _emit(self, line: str, event_ts: Optional[float] = None,
+              abort=None) -> bool:
+        """Enqueue one record, blocking under backpressure.  ``abort`` is
+        the predicate that gives up the wait (default: the graceful stop
+        flag; the final drain passes the kill flag instead so stop()
+        does not abort its own drain).  Returns False when aborted before
+        the record fit."""
         rec = StreamRecord(line, time.time() if event_ts is None else event_ts)
-        ok = bounded_put(self._buf, rec, self._stop_evt.is_set, poll_s=0.05)
+        ok = bounded_put(
+            self._buf, rec,
+            self._stop_evt.is_set if abort is None else abort,
+            poll_s=0.05,
+        )
         if ok:
             _INGESTED.inc()
         return ok
@@ -143,8 +156,14 @@ class StreamSource:
         self._stop_evt.set()
 
     def close(self, timeout_s: float = 10.0) -> None:
+        """stop() + wait for the producer (drain included) to retire.  A
+        drain that cannot finish within ``timeout_s`` — consumer gone,
+        buffer full — is hard-killed so close() always returns."""
         self.stop()
         self._join(timeout_s)
+        if not self._eof.is_set():
+            self._kill_evt.set()
+            self._join(min(timeout_s, 2.0))
 
     def _join(self, timeout_s: float) -> None:  # subclass threads
         pass
@@ -233,11 +252,17 @@ class TailingFileSource(StreamSource):
                 out.append(p)
         return out
 
-    def _poll_once(self) -> int:
-        """One sweep over the file set; returns records emitted."""
+    def _poll_once(self, draining: bool = False) -> int:
+        """One sweep over the file set; returns records emitted.
+
+        ``draining=True`` is the final post-stop sweep: the graceful stop
+        flag is IGNORED (it is already set — honouring it would make the
+        drain a no-op) and only ``close()``'s hard kill aborts, so
+        everything already written actually reaches the buffer."""
+        halt = self._kill_evt.is_set if draining else self._stop_evt.is_set
         emitted = 0
         for path in self._files():
-            if self._stop_evt.is_set():
+            if halt():
                 break
             off = self._offsets.get(path, 0)
             try:
@@ -270,15 +295,19 @@ class TailingFileSource(StreamSource):
                 # complete ones, hold the fragment (re-read whole next poll)
                 self.torn_tails_held += 1
                 _TORN_HELD.inc()
-            self._offsets[path] = off + nl + 1
             now = time.time()
+            consumed = off  # bytes actually handed downstream
             for raw in data[:nl].split(b"\n"):
                 line = raw.decode("utf-8", errors="replace")
-                if not line.strip():
-                    continue
-                if not self._emit(line, event_ts=now):
-                    return emitted
-                emitted += 1
+                if line.strip():
+                    if not self._emit(line, event_ts=now, abort=halt):
+                        # aborted mid-chunk: record only what was emitted
+                        # so the rest is re-read (not skipped) next poll
+                        self._offsets[path] = consumed
+                        return emitted
+                    emitted += 1
+                consumed += len(raw) + 1
+            self._offsets[path] = consumed
         return emitted
 
     def _run(self) -> None:
@@ -296,9 +325,11 @@ class TailingFileSource(StreamSource):
                     stats.add("stream.tail_errors")
                 self._stop_evt.wait(self.poll_interval_s)
             # final drain poll: pick up everything already written (a
-            # held torn tail stays held — it never became a full line)
+            # held torn tail stays held — it never became a full line).
+            # Runs in draining mode — stop is already set; only close()'s
+            # hard kill aborts — so stop() honours its drain contract.
             try:
-                self._poll_once()
+                self._poll_once(draining=True)
             except Exception:
                 pass
         except BaseException:
@@ -357,6 +388,15 @@ class SocketSource(StreamSource):
                 except OSError:
                     break
                 with self._conn_lock:
+                    # re-check under the lock: a connection accepted after
+                    # stop() swept _conns would otherwise never be shut
+                    # down and its reader could block _eof forever
+                    if self._stop_evt.is_set():
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                        continue
                     self._conns.append(conn)
                     self._active += 1
                 t = threading.Thread(

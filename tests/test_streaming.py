@@ -138,6 +138,42 @@ class TestTailingSource:
         finally:
             src.close()
 
+    def test_stop_drains_writes_landed_after_last_poll(self, tmp_path):
+        """The stop() contract: everything already written when stop()
+        is called is picked up by the final drain sweep — even records
+        the poll loop never saw because they landed while it slept."""
+        src = TailingFileSource(str(tmp_path), poll_interval_s=30.0).start()
+        try:
+            time.sleep(0.2)  # first (empty) poll done; producer sleeping
+            (tmp_path / "part-000").write_text("a 1\nb 2\nc 3\n")
+            src.stop()
+            got = _drain(src, 3)
+            assert [r.line for r in got] == ["a 1", "b 2", "c 3"]
+            deadline = time.monotonic() + 5.0
+            while not src.drained and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert src.drained
+        finally:
+            src.close()
+
+    def test_stop_under_backpressure_loses_nothing(self, tmp_path):
+        """stop() while the producer is blocked mid-chunk on a full
+        buffer: the aborted chunk's unemitted lines are re-read by the
+        drain sweep — nothing skipped, nothing duplicated."""
+        (tmp_path / "part-000").write_text(
+            "".join(f"r {i}\n" for i in range(50))
+        )
+        src = TailingFileSource(str(tmp_path), poll_interval_s=0.01,
+                                buffer_records=4).start()
+        try:
+            time.sleep(0.3)  # producer blocked mid-chunk on the buffer
+            src.stop()
+            got = _drain(src, 50)
+            assert [r.line for r in got] == [f"r {i}" for i in range(50)]
+            assert src.get(timeout=0.3) is None  # drain re-emitted nothing twice
+        finally:
+            src.close()
+
 
 class TestSocketSource:
     def test_lines_across_sends_and_torn_final(self):
