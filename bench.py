@@ -1293,7 +1293,11 @@ def bench_fleet(n_replicas: int = 3, qps: float = 25.0,
     chaos runs: a probabilistic fleet.probe fault plan plus a REAL
     SIGKILL of one replica mid-stream.  Reports p50/p99/achieved-QPS,
     shed and failed counts, the supervisor restart count, fleet-view
-    convergence, and the hard zero-failed-requests check."""
+    convergence, and the hard zero-failed-requests check.  The whole run
+    records into the postmortem plane (PBOX_FLIGHT_DIR; parent +
+    replicas dump flight rings) and the emitted row carries
+    pbox_doctor's parsed verdict — crash attribution + failover-traced
+    request count."""
     import http.client
     import signal as _signal
     import subprocess
@@ -1313,10 +1317,18 @@ def bench_fleet(n_replicas: int = 3, qps: float = 25.0,
     from paddlebox_tpu.train.trainer import Trainer
     from paddlebox_tpu.utils.faults import fault_plan
 
+    from paddlebox_tpu import telemetry
+
     B = 64
     res: dict = {"n_replicas": n_replicas, "target_qps": qps,
                  "duration_s": duration_s}
     with tempfile.TemporaryDirectory() as td:
+        # postmortem plane: the parent (router+supervisor) and every
+        # replica child dump their flight rings here; pbox_doctor's
+        # verdict on the run rides the emitted row
+        flight_dir = os.path.join(td, "postmortem")
+        os.environ["PBOX_FLIGHT_DIR"] = flight_dir
+        telemetry.set_process_name("bench-fleet")
         conf = make_synth_config(n_sparse_slots=n_slots, dense_dim=dense,
                                  batch_size=B, max_feasigns_per_ins=8)
         files = write_synth_files(td, n_files=1, ins_per_file=2 * B,
@@ -1449,6 +1461,37 @@ def bench_fleet(n_replicas: int = 3, qps: float = 25.0,
         finally:
             router.stop()
             sup.stop()
+            os.environ.pop("PBOX_FLIGHT_DIR", None)
+
+        # offline correlation before the tempdir vanishes: the doctor's
+        # parsed verdict (who crashed, which traces failed over) is part
+        # of the bench evidence
+        telemetry.dump_flight("fleet_run_end", {
+            "requests": len(lat_ok) + shed + failed,
+        }, dump_dir=flight_dir)
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import pbox_doctor
+
+            doc = pbox_doctor.analyze(td)
+            res["postmortem"] = {
+                "flight_dumps": doc["sources"]["dumps"],
+                "dump_reasons": doc["dump_reasons"],
+                "crashed_replicas": [
+                    {"replica_id": c["replica_id"], "pid": c["pid"]}
+                    for c in doc["crashes"]
+                ],
+                "traces": len(doc["traces"]),
+                "traces_with_failover": sum(
+                    1 for recs in doc["traces"].values()
+                    if any(r["name"] == "fleet.failover" for r in recs)
+                ),
+            }
+        except Exception as e:  # the doctor must never sink the bench
+            res["postmortem"] = {"error": repr(e)[:200]}
+        finally:
+            sys.path.pop(0)
 
     lat_ok.sort()
     n_ok = len(lat_ok)
@@ -1545,7 +1588,7 @@ def bench_streaming(duration_s: float = 10.0, rate: float = 500.0,
         table.end_pass()
         pub = Publisher(root, staging_dir=os.path.join(td, "staging"))
         pub.publish_base("base", model, trainer.params, table,
-                         batch_size=bsz,
+                         lineage="warmup", batch_size=bsz,
                          key_capacity=bsz * conf.max_feasigns_per_ins,
                          dense_dim=dense, feed_conf=conf)
 

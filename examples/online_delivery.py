@@ -79,6 +79,7 @@ def main():
     pub = Publisher(root, staging_dir=os.path.join(work, "staging"))
     m = train_pass(0)
     pub.publish_base("pass0", model, trainer.params, table,
+                     lineage="pass0",
                      batch_size=B, key_capacity=kcap, dense_dim=DENSE,
                      feed_conf=conf)
     print(f"pass 0: auc={m['auc']:.4f} -> published base "
@@ -109,7 +110,8 @@ def main():
     # -- the freshness loop: train, publish a delta, watch it hot-apply ----- #
     for i in range(1, args.passes + 1):
         m = train_pass(i)
-        entry = pub.publish_delta(f"pass{i}", table, model, trainer.params)
+        entry = pub.publish_delta(f"pass{i}", table, model,
+                                  trainer.params, lineage=f"pass{i}")
         applied = syncer.poll_once()  # in production the agent thread polls
         info = models()
         print(

@@ -101,8 +101,8 @@ def main():
     pub = Publisher(root, staging_dir=os.path.join(work, "staging"))
     kcap = B * conf.max_feasigns_per_ins
     pub.publish_base("base", model, trainer.params, table,
-                     batch_size=B, key_capacity=kcap, dense_dim=DENSE,
-                     feed_conf=conf)
+                     lineage="warmup", batch_size=B, key_capacity=kcap,
+                     dense_dim=DENSE, feed_conf=conf)
 
     # -- serving side -------------------------------------------------------- #
     server = ScoringServer()

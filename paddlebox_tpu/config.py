@@ -83,6 +83,17 @@ class _Flags:
         "metrics_port": 0,
         "trace_dir": "",
         "events_path": "",
+        # JSONL event-file rotation threshold in MB (streaming mode
+        # appends forever; past this size the file shift-rotates to
+        # .1/.2/... keeping the last few generations; 0 = never rotate)
+        "events_max_mb": 64.0,
+        # postmortem plane (telemetry/flight.py + tools/pbox_doctor.py):
+        # flight_dir is where crash-time flight-recorder dumps land
+        # ("" = fall back to the events_path directory, else no dumps;
+        # the in-memory ring records regardless); flight_ring bounds the
+        # per-process ring (recent spans/events kept for a dump)
+        "flight_dir": "",
+        "flight_ring": 512,
         # online model delivery (serving_sync/): the publish root a
         # trainer ships base/delta model units to (""= publishing off;
         # launch.py --publish-root sets it fleet-wide), and the serving-

@@ -32,6 +32,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from paddlebox_tpu import telemetry
+from paddlebox_tpu.telemetry import context as trace_context
 from paddlebox_tpu.config import DataFeedConfig, flags
 from paddlebox_tpu.inference.admission import AdmissionGate, ShedRequest
 from paddlebox_tpu.inference.predictor import Predictor
@@ -103,6 +104,7 @@ def _entry_health(e) -> dict:
         "n_features": e.predictor.n_features,
         "age_seconds": age,
         "seq": version.get("seq"),
+        "lineage": version.get("lineage"),
     }
 
 
@@ -383,6 +385,7 @@ class ScoringServer:
 
         class Handler(BaseHTTPRequestHandler):
             _status = 0  # last code sent (per-request telemetry label)
+            _trace_id: Optional[str] = None  # active request's trace
 
             def _send(self, code: int, payload: dict,
                       headers: Optional[dict] = None) -> None:
@@ -391,6 +394,14 @@ class ScoringServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if self._trace_id:
+                    # echo the request's trace ID on EVERY outcome, so a
+                    # client (or the fleet router's bench) can correlate
+                    # any response — 200 or 500 — with server-side spans
+                    self.send_header(
+                        trace_context.TRACE_ID_RESPONSE_HEADER,
+                        self._trace_id,
+                    )
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
@@ -453,6 +464,7 @@ class ScoringServer:
                             "seq": v.get("seq"),
                             "published_at": v.get("published_at"),
                             "age_seconds": age,
+                            "lineage": v.get("lineage"),
                         }
                     self._send(200, {"models": models,
                                      "default": server._default})
@@ -463,7 +475,20 @@ class ScoringServer:
                 # strict routing: exactly /score or /score/<name>.  Every
                 # outcome — routing 404, drain 503, parse 400, scoring 200,
                 # internal 500 — lands in the request counter/latency
-                # histogram split by status class
+                # histogram split by status class.  The whole request runs
+                # under a trace context — the router's forwarded
+                # traceparent when one arrives (server-side spans then
+                # chain under the router's attempt span), a freshly-minted
+                # trace for direct hits — and every response echoes
+                # X-PBox-Trace-Id.
+                ctx = trace_context.from_headers(self.headers) \
+                    or trace_context.new_root()
+                self._trace_id = ctx.trace_id
+                with trace_context.activate(ctx), \
+                        telemetry.span("server.request", path=self.path):
+                    self._do_post_traced()
+
+            def _do_post_traced(self):
                 t0 = time.perf_counter()
                 if self.path == "/score":
                     name = None

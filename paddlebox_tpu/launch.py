@@ -53,6 +53,7 @@ def rank_env(
     publish_root: Optional[str] = None,
     stream_root: Optional[str] = None,
     max_staleness_s: Optional[float] = None,
+    flight_dir: Optional[str] = None,
 ) -> dict:
     """Child environment for one rank (exported for tests/embedders)."""
     env = dict(base_env if base_env is not None else os.environ)
@@ -84,6 +85,11 @@ def rank_env(
     if max_staleness_s is not None:
         # the freshness budget the deadline publisher must honor
         env["PBOX_MAX_STALENESS_S"] = str(max_staleness_s)
+    if flight_dir:
+        # one shared postmortem dir: every rank's flight-recorder dumps
+        # (stall/rollback/sigterm capture) land here, file names carry
+        # rank+pid, and tools/pbox_doctor.py correlates them offline
+        env["PBOX_FLIGHT_DIR"] = flight_dir
     if devices_per_proc:
         import re
 
@@ -137,6 +143,7 @@ def launch(
     serve_router_port: Optional[int] = None,
     stream_root: Optional[str] = None,
     max_staleness_s: Optional[float] = None,
+    flight_dir: Optional[str] = None,
 ) -> int:
     """Spawn nproc ranks of ``python script_args...``; return the first
     non-zero exit code (0 if all ranks succeed).  Any rank dying kills the
@@ -182,6 +189,7 @@ def launch(
             metrics_port=metrics_port, trace_dir=trace_dir,
             publish_root=publish_root,
             stream_root=stream_root, max_staleness_s=max_staleness_s,
+            flight_dir=flight_dir,
         )
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
@@ -300,6 +308,10 @@ def main(argv: Optional[list[str]] = None) -> int:
                     help="streaming freshness budget: publish_delta "
                          "fires on this deadline rather than pass "
                          "cadence (PBOX_MAX_STALENESS_S)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="shared postmortem dir: every rank's "
+                         "flight-recorder dumps land here for "
+                         "tools/pbox_doctor.py (PBOX_FLIGHT_DIR)")
     ap.add_argument("script", help="training script to run")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -318,6 +330,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         serve_router_port=args.serve_router_port,
         stream_root=args.stream_root,
         max_staleness_s=args.max_staleness_s,
+        flight_dir=args.flight_dir,
     )
 
 

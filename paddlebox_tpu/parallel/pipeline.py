@@ -179,13 +179,20 @@ class PipelineTrainer:
         lr: float = 1e-3,
         seed: int = 0,
         params: Optional[dict] = None,
+        optimizer=None,
     ):
+        """optimizer: any optax transform (default ``optax.adam(lr)``);
+        the grads-equivalence test injects plain SGD here, which is
+        linear in the gradient, so reduction-order float noise stays
+        noise-sized instead of being amplified through adam's
+        first-step normalization."""
         if PIPE_AXIS not in mesh.axis_names:
             raise ValueError(f"mesh needs a {PIPE_AXIS!r} axis, has {mesh.axis_names}")
         self.mesh = mesh
         self.n_stages = int(mesh.shape[PIPE_AXIS])
         self.d_in, self.width = d_in, width
-        self.optimizer = optax.adam(lr)
+        self.optimizer = optimizer if optimizer is not None \
+            else optax.adam(lr)
         self._sharding = NamedSharding(mesh, P(PIPE_AXIS))
         if params is None:
             params = init_pipeline_params(
@@ -219,6 +226,16 @@ class PipelineTrainer:
             loss, grads = jax.value_and_grad(pipeline_forward_loss)(
                 p, x, y, mask
             )
+            # value_and_grad runs INSIDE the shard_map body, so every
+            # stage differentiates its own copy of the SAME replicated
+            # psum'd scalar: the psum transpose sums all P cotangent
+            # seeds and the per-device grad comes out exactly P x the
+            # true gradient (measured: uniform x n_stages).  Normalize
+            # once.  (models/pipelined_ctr.py doesn't need this — its
+            # shard_map is differentiated as a whole, one output, one
+            # seed.)
+            p_axis = axis_size(PIPE_AXIS)
+            grads = jax.tree.map(lambda g: g / p_axis, grads)
             updates, o = optimizer.update(grads, o, p)
             p = optax.apply_updates(p, updates)
             restack = lambda t: jax.tree.map(lambda l: l[None], t)

@@ -371,6 +371,15 @@ class Watchdog:
         self._aborted.set()
         stats.add("watchdog.aborts")
         logger.error("watchdog abort: %s", err)
+        # crash-time capture: every rank dumps its OWN flight ring as the
+        # abort latch trips — the culprit's dump shows what it was doing
+        # when it froze, the peers' dumps show what the stall blocked
+        # (pbox_doctor merges them and names who stalled first)
+        telemetry.dump_flight("stall", {
+            "culprit": err.culprit, "stage": err.stage, "kind": err.kind,
+            "age_s": err.age_s, "progress": err.progress,
+            "detected_by": err.detected_by, "rank": self.rank,
+        })
         if self._hard_exit_grace_s is not None:
             threading.Thread(
                 target=self._hard_exit_reaper,

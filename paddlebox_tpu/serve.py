@@ -129,8 +129,13 @@ def _replica_argv(args, replica_id: int, port: int) -> list:
 
 
 def _main_fleet(args) -> None:
+    from paddlebox_tpu import telemetry
     from paddlebox_tpu.serving_fleet import FleetRouter, ReplicaSupervisor
 
+    # the router process's flight dumps read as "router" in pbox_doctor
+    # timelines; SIGTERM (pod teardown) dumps the ring on the way out
+    telemetry.set_process_name("router")
+    telemetry.install_signal_dump()
     supervisor = ReplicaSupervisor(
         args.replicas,
         lambda rid, port: _replica_argv(args, rid, port),
@@ -168,7 +173,14 @@ def main(argv=None) -> None:
 
         jax.config.update("jax_platforms", "cpu")
 
+    from paddlebox_tpu import telemetry
     from paddlebox_tpu.inference import ScoringServer
+
+    # a single server IS one fleet replica when spawned by the
+    # supervisor: label its dumps and capture the ring on SIGTERM (the
+    # supervisor's stop() delivers exactly that)
+    telemetry.set_process_name("replica")
+    telemetry.install_signal_dump()
 
     server = ScoringServer(
         max_queue=args.max_queue,

@@ -36,6 +36,19 @@ queue room); only when EVERY candidate sheds does the client see 429,
 with the smallest Retry-After observed.  With no serving-capable replica
 at all the router answers 503.
 
+**Every request is traced end to end**: the router adopts the client's
+W3C ``traceparent`` (or mints a fresh trace ID), wraps the whole routed
+request in a ``fleet.request`` span, gives each forward attempt its own
+``fleet.attempt`` child span (failed attempts leave a ``fleet.failover``
+marker naming the replica and error), and carries the context to the
+replica in the forwarded ``traceparent`` header — so the replica's
+server-side spans land under the SAME trace ID.  Responses carry the
+debug headers ``X-PBox-Trace-Id`` (correlate client-side tail latency
+with server logs without log-diving) and ``X-PBox-Replica`` (which
+replica actually served, after failover).  All of it lands in the
+always-on flight ring, which ``tools/pbox_doctor.py --trace <id>``
+reconstructs into one cross-process request path.
+
 Endpoints: ``POST /score[/name]`` (proxied), ``GET /healthz`` (fleet
 summary: 200 while any replica can serve), ``GET /fleet`` (the full
 freshness/state view), ``GET /metrics`` (router-process Prometheus).
@@ -52,6 +65,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
 
 from paddlebox_tpu import telemetry
+from paddlebox_tpu.telemetry import context as trace_context
 from paddlebox_tpu.utils import faults
 
 logger = logging.getLogger(__name__)
@@ -124,7 +138,8 @@ class ReplicaHandle:
             "degraded_reasons": self.health.get("degraded_reasons") or {},
             "queue_depth": self.health.get("queue_depth"),
             "models": {
-                n: {"seq": m.get("seq"), "age_seconds": m.get("age_seconds")}
+                n: {"seq": m.get("seq"), "age_seconds": m.get("age_seconds"),
+                    "lineage": m.get("lineage")}
                 for n, m in models.items()
             },
         }
@@ -298,7 +313,15 @@ class FleetRouter:
     def route_request(self, method: str, path: str, body: bytes,
                       headers: dict) -> Tuple[int, bytes, dict]:
         """Forward one client request with failover.  Returns (status,
-        body, headers) for the handler to relay."""
+        body, headers) for the handler to relay.
+
+        Tracing: each forward attempt runs under its own ``fleet.attempt``
+        child span of the active trace context, and the forwarded
+        ``traceparent`` header carries that attempt's span — the replica's
+        server-side spans parent under the attempt that reached it, so a
+        failover shows up as sibling attempts (one dead, one served)
+        under ONE trace ID.  The response names the replica that actually
+        served in ``X-PBox-Replica``."""
         t0 = time.perf_counter()
         candidates = self.route_candidates()
         shed: Optional[Tuple[int, bytes, dict]] = None
@@ -306,8 +329,18 @@ class FleetRouter:
         for r in candidates:
             tried += 1
             try:
-                status, data, hdrs = self._forward(
-                    r, method, path, body, headers)
+                with telemetry.span("fleet.attempt", replica=r.addr,
+                                    attempt=tried):
+                    # inside the span: current() IS the attempt's span,
+                    # so the replica's server-side spans parent under
+                    # the exact attempt that reached it
+                    attempt_ctx = trace_context.current()
+                    fwd = dict(headers)
+                    if attempt_ctx is not None:
+                        fwd[trace_context.TRACEPARENT_HEADER] = \
+                            attempt_ctx.to_traceparent()
+                    status, data, hdrs = self._forward(
+                        r, method, path, body, fwd)
             except Exception as e:
                 # replica died under us (SIGKILL, reset, timeout): feeds
                 # the same state machine as a failed probe, and the
@@ -315,6 +348,8 @@ class FleetRouter:
                 # never sees this
                 self._note_failure(r, repr(e))
                 _FAILOVERS.inc()
+                telemetry.instant("fleet.failover", replica=r.addr,
+                                  attempt=tried, error=repr(e)[:120])
                 continue
             if status == 429:
                 # this replica is shedding; another may have queue room.
@@ -331,6 +366,9 @@ class FleetRouter:
             _REQUESTS.inc(outcome=outcome)
             _ROUTE_SECONDS.observe(time.perf_counter() - t0,
                                    outcome=outcome)
+            # which replica actually served, after any failover: clients
+            # and the bench attribute tail latency without log-diving
+            hdrs[trace_context.REPLICA_RESPONSE_HEADER] = r.addr
             return status, data, hdrs
         if shed is not None:
             _REQUESTS.inc(outcome="shed")
@@ -420,8 +458,17 @@ class FleetRouter:
                     v = self.headers.get(k)
                     if v:
                         fwd[k] = v
-                status, data, hdrs = router.route_request(
-                    "POST", self.path, body, fwd)
+                # adopt the client's traceparent or mint a fresh trace:
+                # every attempt span, failover marker and replica-side
+                # span of this request now shares one trace ID, and the
+                # client gets it back for its own latency attribution
+                ctx = trace_context.from_headers(self.headers) \
+                    or trace_context.new_root()
+                with trace_context.activate(ctx), \
+                        telemetry.span("fleet.request", path=self.path):
+                    status, data, hdrs = router.route_request(
+                        "POST", self.path, body, fwd)
+                hdrs[trace_context.TRACE_ID_RESPONSE_HEADER] = ctx.trace_id
                 self._send_raw(status, data, hdrs)
 
             def log_message(self, *a):  # quiet by default

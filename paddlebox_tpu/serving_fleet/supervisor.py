@@ -189,6 +189,7 @@ class ReplicaSupervisor:
                         "fleet: replica %d (pid %s) exited rc=%s; "
                         "restart %d in %.2fs", r.replica_id, r.pid,
                         r.proc.returncode, r.restarts + 1, delay)
+                    self._dump_crash(r)
                 if r.next_restart_at and now >= r.next_restart_at:
                     try:
                         faults.inject("fleet.restart")
@@ -207,6 +208,35 @@ class ReplicaSupervisor:
                             "fleet: respawn of replica %d failed (%r); "
                             "next attempt in %.2fs", r.replica_id, e,
                             r.next_restart_at - now)
+
+    def _dump_crash(self, r: ReplicaProc) -> None:
+        """Postmortem capture for a dead replica: dump the supervisor's
+        own flight ring naming the child (replica id, pid, rc, port) and
+        collect — by path — any dump files the child itself left in the
+        shared flight dir (a SIGTERM'd replica dumps on the way out; a
+        SIGKILLed one can't, which is exactly why the supervisor's dump
+        must name it)."""
+        from paddlebox_tpu.telemetry import flight
+
+        child_dumps: List[str] = []
+        try:
+            d = flight.resolve_flight_dir()
+            if d and os.path.isdir(d) and r.pid is not None:
+                needle = f"-pid{r.pid}-"
+                child_dumps = sorted(
+                    os.path.join(d, f) for f in os.listdir(d)
+                    if f.startswith("flight-") and needle in f
+                )
+        except OSError:
+            pass
+        telemetry.dump_flight("replica_crash", {
+            "replica_id": r.replica_id,
+            "pid": r.pid,
+            "returncode": r.proc.returncode if r.proc else None,
+            "port": r.port,
+            "crash_streak": r.crash_streak,
+            "child_dumps": child_dumps,
+        })
 
     def _babysit(self) -> None:
         while not self._stop.is_set():

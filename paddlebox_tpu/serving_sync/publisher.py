@@ -156,12 +156,22 @@ class Publisher:
         batch_buckets=None,
         metrics: Optional[dict] = None,
         meta: Optional[dict] = None,
+        lineage: Optional[str] = None,
     ) -> Optional[PublishEntry]:
         """Export + publish a full serving artifact; restarts the delta
         chain.  Returns the donefile entry, or None when the health gate
-        held it back."""
+        held it back.
+
+        lineage: the producing pass/window identity (``pass12``, ``w3-7``)
+        — carried through the donefile into the syncer's applied version
+        and the ``/fleet`` freshness view, so a served score is
+        attributable to the training window that produced it and
+        ``pbox_doctor`` can report publish→apply lag per lineage."""
         if self._gated(metrics):
             return None
+        meta = dict(meta or {})
+        if lineage is not None:
+            meta["lineage"] = str(lineage)
         from paddlebox_tpu.inference.export import export_model
 
         with telemetry.span("publish.base", tag=tag), \
@@ -197,6 +207,10 @@ class Publisher:
             # would drop rows from the chain
             table.clear_delta()
             _PUBLISHED.inc(kind="base")
+            telemetry.emit_event(
+                "published", kind="base", tag=tag, seq=entry.seq,
+                lineage=meta.get("lineage"), n_rows=entry.n_rows,
+            )
             return entry
 
     def publish_delta(
@@ -208,6 +222,7 @@ class Publisher:
         *,
         metrics: Optional[dict] = None,
         meta: Optional[dict] = None,
+        lineage: Optional[str] = None,
         **export_overrides,
     ) -> Optional[PublishEntry]:
         """Publish the rows touched since the last publish, plus (with
@@ -218,9 +233,14 @@ class Publisher:
         The delta tracker is only cleared after the verified upload and
         donefile append — a failed publish leaves the rows tracked, and
         the next publish ships them again (at-least-once delivery of
-        every touched row)."""
+        every touched row).
+
+        lineage: producing pass/window identity (see publish_base)."""
         if self._gated(metrics):
             return None
+        meta = dict(meta or {})
+        if lineage is not None:
+            meta["lineage"] = str(lineage)
         if self.base_tag is None:
             raise PublishError(
                 "publish_base first: a delta chain needs a base anchor"
@@ -281,6 +301,10 @@ class Publisher:
             self._append_donefile(entry)
             table.clear_delta()  # only once the entry is visible
             _PUBLISHED.inc(kind="delta")
+            telemetry.emit_event(
+                "published", kind="delta", tag=tag, seq=entry.seq,
+                lineage=meta.get("lineage"), n_rows=entry.n_rows,
+            )
             return entry
 
     # -- transport ---------------------------------------------------------- #

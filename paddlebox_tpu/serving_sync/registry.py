@@ -117,6 +117,10 @@ class ModelVersion:
     seq: int = 0  # donefile seq of the newest applied entry
     published_at: float = 0.0  # publish time of that entry
     applied_at: float = 0.0
+    # the producing pass/window ID the newest applied entry carried
+    # (PublishEntry.meta["lineage"]): the attribution hook — which
+    # training window is this served model made of?
+    lineage_id: Optional[str] = None
 
     @property
     def tag(self) -> str:
@@ -137,6 +141,7 @@ class ModelVersion:
             seq=entry.seq,
             published_at=entry.published_at,
             applied_at=time.time(),
+            lineage_id=entry.meta.get("lineage", self.lineage_id),
         )
 
     def lineage(self) -> dict:
@@ -148,6 +153,7 @@ class ModelVersion:
             "seq": self.seq,
             "published_at": self.published_at,
             "applied_at": self.applied_at,
+            "lineage": self.lineage_id,
         }
 
 

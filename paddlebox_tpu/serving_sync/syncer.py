@@ -291,6 +291,7 @@ class Syncer:
         version = ModelVersion(
             name=self.name, base_tag=entry.tag, seq=entry.seq,
             published_at=entry.published_at, applied_at=time.time(),
+            lineage_id=entry.meta.get("lineage"),
         )
         self._install(version, predictor, feed_conf=feed_conf)
         _APPLIED.inc(kind="base")
@@ -340,6 +341,14 @@ class Syncer:
                 self.name, predictor, feed_conf, version=lineage
             )
         self._applied_seq = version.seq
+        # the apply-side half of the publish→apply lag record: pairs with
+        # the publisher's "published" event by lineage/seq across
+        # processes (pbox_doctor joins them into per-lineage lag)
+        telemetry.emit_event(
+            "sync_applied", model=self.name, seq=version.seq,
+            tag=version.tag, lineage=version.lineage_id,
+            published_at=version.published_at,
+        )
 
     # -- fetch -------------------------------------------------------------- #
     def _fetch(self, entry: PublishEntry) -> str:
@@ -371,6 +380,14 @@ class Syncer:
         before); when NO base loads, the last-good version keeps serving
         and the next poll retries."""
         _FULL_RELOAD.inc()
+        # a fallback-ladder transition is a postmortem moment: dump the
+        # flight ring NOW, while it still holds the chain-break/apply
+        # failure history that got us here
+        telemetry.dump_flight("sync_fallback", {
+            "model": self.name, "root": self.root,
+            "applied_seq": self._applied_seq,
+            "entries": len(entries),
+        })
         bases = [e for e in entries if e.kind == "base"]
         for base in reversed(bases):
             try:
@@ -407,6 +424,10 @@ class Syncer:
         # the delta chain is broken AND no base loads: the pinned
         # last-good model keeps serving, but the replica must say so —
         # the router deprioritizes it until a reload lands
+        telemetry.dump_flight("sync_last_good", {
+            "model": self.name, "root": self.root,
+            "applied_seq": self._applied_seq,
+        })
         self._mark_degraded(
             "sync_chain", f"no loadable base under {self.root}")
 

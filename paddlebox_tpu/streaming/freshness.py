@@ -89,6 +89,11 @@ class DeadlinePublishPolicy:
         self.publish_programs = publish_programs
         self._oldest_unpublished: Optional[float] = None
         self._newest_unpublished: Optional[float] = None
+        # window-index bounds of the unpublished accumulation: becomes
+        # the publish's lineage ID ("w3" / "w3-7"), so a served score is
+        # attributable to the exact training windows inside it
+        self._first_unpub_window: Optional[int] = None
+        self._last_unpub_window: Optional[int] = None
         self._publish_ewma = 0.0
         self._outstanding = collections.deque()  # (seq, oldest_event_ts)
         self._track_served = False
@@ -104,6 +109,21 @@ class DeadlinePublishPolicy:
         if self._oldest_unpublished is None:
             self._oldest_unpublished = window.first_event_ts
         self._newest_unpublished = window.last_event_ts
+        idx = getattr(window, "index", None)
+        if idx is not None:
+            if self._first_unpub_window is None:
+                self._first_unpub_window = int(idx)
+            self._last_unpub_window = int(idx)
+
+    @property
+    def pending_lineage(self) -> Optional[str]:
+        """Lineage ID the next publish will carry: the unpublished
+        window-index range ("w3", or "w3-7" when publishes skipped
+        windows under backpressure)."""
+        lo, hi = self._first_unpub_window, self._last_unpub_window
+        if lo is None:
+            return None
+        return f"w{lo}" if (hi is None or hi == lo) else f"w{lo}-{hi}"
 
     @property
     def oldest_unpublished_age(self) -> float:
@@ -144,7 +164,8 @@ class DeadlinePublishPolicy:
             if self.publish_programs and model is not None:
                 kw = {"model": model, "params": params}
             entry = self.publisher.publish_delta(
-                tag, table, metrics=metrics, **kw
+                tag, table, metrics=metrics,
+                lineage=self.pending_lineage, **kw
             )
         except Exception as e:
             self.publish_failures += 1
@@ -176,6 +197,8 @@ class DeadlinePublishPolicy:
             self._backpressure()
         self._oldest_unpublished = None
         self._newest_unpublished = None
+        self._first_unpub_window = None
+        self._last_unpub_window = None
         return entry
 
     # -- serve-side confirmation -------------------------------------------- #

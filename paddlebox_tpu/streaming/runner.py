@@ -289,10 +289,18 @@ class StreamingTrainer:
         for attempt in (0, 1):
             self.table.begin_pass(window.census)
             try:
-                metrics = self.trainer.train_from_dataset(
-                    ds, self.table, auc_state=self._mstate,
-                    next_pass_keys=lambda: sched.wait_census(census_wait),
-                )
+                # the window's lineage ID ("w<idx>") names this span AND
+                # the publish entry the window lands in — the doctor
+                # joins trained-window, published-entry and applied-model
+                # records on it
+                with telemetry.span("stream.window", window=window.index,
+                                    lineage=f"w{window.index}",
+                                    n_records=window.n_records):
+                    metrics = self.trainer.train_from_dataset(
+                        ds, self.table, auc_state=self._mstate,
+                        next_pass_keys=lambda: sched.wait_census(
+                            census_wait),
+                    )
             except BaseException as e:
                 from paddlebox_tpu.train.trainer import PassRolledBack
 

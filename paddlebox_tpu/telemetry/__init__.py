@@ -10,9 +10,15 @@ PrintSyncTimer + log_for_profile + CUPTI timeline, rebuilt TPU-native):
     (``utils/monitor.stats`` forwards here unchanged);
   * :mod:`export` — Prometheus text exposition (``render_prometheus``),
     the standalone :class:`MetricsExporter` ``/metrics`` listener;
-  * :mod:`events` — rank-tagged JSONL event/metrics log;
+  * :mod:`events` — rank-tagged JSONL event/metrics log (size-rotated);
   * :mod:`trace` — ``span("name")`` -> Chrome-trace JSON (Perfetto);
-  * :mod:`fleet` — pass-boundary cross-rank snapshot gather + merge.
+  * :mod:`fleet` — pass-boundary cross-rank snapshot gather + merge;
+  * :mod:`context` — W3C-style trace-context propagation (one trace ID
+    across router -> replica -> syncer, ``traceparent`` carriage);
+  * :mod:`flight` — the always-on flight recorder: a bounded ring of
+    recent spans/events dumped to JSON on stalls, rollbacks, sync
+    fallbacks, replica crashes and SIGTERM (``tools/pbox_doctor.py``
+    correlates the dumps offline).
 """
 
 from paddlebox_tpu.telemetry.metrics import (  # noqa: F401
@@ -56,4 +62,17 @@ from paddlebox_tpu.telemetry.fleet import (  # noqa: F401
     gather_fleet_snapshot,
     log_fleet_view,
     merge_snapshots,
+)
+from paddlebox_tpu.telemetry import context  # noqa: F401
+from paddlebox_tpu.telemetry.context import (  # noqa: F401
+    REPLICA_RESPONSE_HEADER,
+    TRACE_ID_RESPONSE_HEADER,
+    TRACEPARENT_HEADER,
+    TraceContext,
+)
+from paddlebox_tpu.telemetry.flight import (  # noqa: F401
+    FlightRecorder,
+    dump_flight,
+    install_signal_dump,
+    set_process_name,
 )

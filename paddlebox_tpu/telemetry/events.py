@@ -12,6 +12,18 @@ machine-readable).  Schema:
 The per-pass record the trainers emit is ``event="pass_end"`` carrying the
 pass metrics plus the registry's DELTA snapshot (this pass's counts, not
 job-cumulative ones).
+
+**Rotation.** Streaming mode appends a record per mini-pass window,
+forever; an unbounded JSONL would eventually be the thing that fills the
+disk.  When the live file crosses ``PBOX_EVENTS_MAX_MB`` (0 disables) it
+rotates shift-style — ``events.jsonl`` -> ``events.jsonl.1`` -> ``.2``
+... keeping the last ``keep_files`` rotated generations — after a
+completed record, so no line is ever torn by the rotation itself.
+``tools/pbox_doctor.py`` reads the rotated generations too.
+
+Every event also lands in the always-on flight ring (scalar fields only
+— the ring is for post-mortems, not bulk payloads), so a crash dump
+carries recent event history even when no JSONL path is configured.
 """
 
 from __future__ import annotations
@@ -22,7 +34,19 @@ import threading
 import time
 from typing import Optional
 
+from paddlebox_tpu.telemetry import flight
 from paddlebox_tpu.telemetry.metrics import registry
+
+DEFAULT_KEEP_FILES = 5
+
+
+def _flight_fields(fields: dict) -> dict:
+    """Scalar projection of an event for the flight ring (dict/list
+    payloads like pass metrics stay in the JSONL, not the ring)."""
+    return {
+        k: v for k, v in fields.items()
+        if isinstance(v, (str, int, float, bool))
+    }
 
 
 def _default_rank() -> int:
@@ -38,23 +62,51 @@ class EventLog:
     """Append-only JSONL writer; every ``log`` line is flushed (a killed
     rank's artifact stays readable up to its last event)."""
 
-    def __init__(self, path: str, rank: Optional[int] = None):
+    def __init__(self, path: str, rank: Optional[int] = None,
+                 max_mb: Optional[float] = None,
+                 keep_files: int = DEFAULT_KEEP_FILES):
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         self.path = path
         self.rank = _default_rank() if rank is None else int(rank)
+        if max_mb is None:
+            from paddlebox_tpu.config import flags
+
+            max_mb = flags.events_max_mb
+        self.max_bytes = int(float(max_mb) * 1e6)  # <= 0 disables rotation
+        self.keep_files = max(int(keep_files), 1)
         self._lock = threading.Lock()
         self._f = open(path, "a")
 
     def log(self, event: str, **fields) -> None:
         rec = {"t": time.time(), "rank": self.rank, "event": event, **fields}
         line = json.dumps(rec, default=_json_default)
+        flight.record("event", event, **_flight_fields(fields))
         with self._lock:
             if self._f.closed:
                 return
             self._f.write(line + "\n")
             self._f.flush()
+            if self.max_bytes > 0 and self._f.tell() >= self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Shift-rotate under the lock, after a completed record: the
+        live file always ends on a whole line, and a reader following
+        ``path`` only ever misses history, never sees a torn tail."""
+        try:
+            self._f.close()
+            for i in range(self.keep_files - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:
+            # rotation is best-effort: a rename failure must not kill the
+            # event stream — keep appending to whatever we can open
+            pass
+        self._f = open(self.path, "a")
 
     def log_pass(self, pass_metrics: dict, **fields) -> None:
         """The per-pass record: pass metrics + this pass's metric deltas."""
@@ -112,7 +164,11 @@ def close_event_log() -> None:
 
 
 def emit_event(event: str, **fields) -> None:
-    """Log to the process event log if one is open (no-op otherwise)."""
+    """Log to the process event log if one is open; the flight ring gets
+    the (scalar) record either way — post-mortems must not depend on
+    PBOX_EVENTS_PATH having been set."""
     el = _event_log
     if el is not None:
         el.log(event, **fields)
+    else:
+        flight.record("event", event, **_flight_fields(fields))

@@ -10,10 +10,19 @@ which Perfetto / chrome://tracing open directly; the reference's CUPTI
 timeline (platform/device_tracer.cc) served the same role for its CUDA
 stack.
 
-Tracing is off by default and a disabled ``span()`` costs one global read,
-so call-sites stay unconditionally instrumented.  Nesting is tracked with
-a per-thread span stack: children carry their parent's name in ``args``
-and Perfetto nests same-tid "X" events by time containment.
+Tracing to FILES is off by default; the always-on flight ring
+(:mod:`flight`) still receives every span, so a crash dump carries the
+recent span history even in a process that never wrote a trace file.
+Nesting is tracked with a per-thread span stack: children carry their
+parent's name in ``args`` and Perfetto nests same-tid "X" events by time
+containment.  When a distributed :mod:`context` is active (a routed
+score request, a traced publish), each span also allocates a child span
+ID under it, so spans recorded in DIFFERENT processes chain into one
+trace for ``tools/pbox_doctor.py --trace <id>``.
+
+Trace files carry a wall-clock anchor (``pboxWallT0``) next to the
+perf-counter timestamps, so the doctor can merge spans from many
+processes onto one wall-time axis.
 """
 
 from __future__ import annotations
@@ -25,6 +34,9 @@ import threading
 import time
 from typing import Iterator, Optional
 
+from paddlebox_tpu.telemetry import context as _context
+from paddlebox_tpu.telemetry import flight as _flight
+
 
 class Tracer:
     """Collects span events; ``write(path)`` emits one Chrome-trace JSON."""
@@ -33,6 +45,9 @@ class Tracer:
         self._lock = threading.Lock()
         self._events: list = []
         self._t0 = time.perf_counter()
+        # wall instant matching _t0: lets an offline reader place these
+        # perf-counter timestamps on the same axis as other processes'
+        self._wall_t0 = time.time()
         self._tls = threading.local()
         self.pid = int(pid)  # rank, so multi-rank traces merge cleanly
         self.process_name = process_name
@@ -116,7 +131,16 @@ class Tracer:
             "name": "process_name", "ph": "M", "pid": self.pid, "tid": 0,
             "args": {"name": f"{self.process_name}-r{self.pid}"},
         }]
-        return {"traceEvents": meta + evs, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": meta + evs,
+            "displayTimeUnit": "ms",
+            # extra top-level keys are ignored by Perfetto/chrome://tracing
+            # but give pbox_doctor the wall-clock anchor + identity it
+            # needs to merge traces across processes
+            "pboxWallT0": self._wall_t0,
+            "pboxRank": self.pid,
+            "pboxProcess": self.process_name,
+        }
 
     def write(self, path: str) -> str:
         """Flush collected spans to ``path`` (Perfetto-loadable) and clear
@@ -155,18 +179,55 @@ def get_tracer() -> Optional[Tracer]:
     return _tracer
 
 
+@contextlib.contextmanager
+def _recorded_span(t: Optional[Tracer], name: str, meta: dict):
+    """One span, recorded everywhere it belongs: the tracer (when file
+    tracing is on), the always-on flight ring, and — when a distributed
+    trace context is active — under a freshly-allocated child span ID so
+    cross-process parentage survives into the dump files."""
+    ctx = _context.current()
+    child = ctx.child() if ctx is not None else None
+    tf: dict = {}
+    if child is not None:
+        tf = {"trace_id": child.trace_id, "span_id": child.span_id}
+        if child.parent_span_id:
+            tf["parent_span_id"] = child.parent_span_id
+    start_wall = time.time()
+    t0 = time.perf_counter()
+    try:
+        with _context.activate(child):
+            if t is not None:
+                with t.span(name, **{**meta, **tf}):
+                    yield
+            else:
+                yield
+    finally:
+        flat = {
+            k: v for k, v in meta.items()
+            if isinstance(v, (str, int, float, bool))
+        }
+        _flight.record(
+            "span", name, t=start_wall,
+            dur_s=time.perf_counter() - t0, **flat, **tf,
+        )
+
+
 def span(name: str, **meta):
-    """Record a span on the active tracer (no-op context when disabled)."""
-    t = _tracer
-    if t is None:
-        return contextlib.nullcontext()
-    return t.span(name, **meta)
+    """Record a span: always into the flight ring, into the Chrome-trace
+    tracer when one is enabled, and under the active distributed trace
+    context when one is installed."""
+    return _recorded_span(_tracer, name, meta)
 
 
 def instant(name: str, **meta) -> None:
+    flat = {
+        k: v for k, v in meta.items()
+        if isinstance(v, (str, int, float, bool))
+    }
+    _flight.record("instant", name, **flat)
     t = _tracer
     if t is not None:
-        t.instant(name, **meta)
+        t.instant(name, **{**meta, **_context.trace_fields()})
 
 
 def flush_trace(path: str) -> Optional[str]:

@@ -599,6 +599,15 @@ class Trainer:
         table.abort_pass()
         status, _ = acp.resume(table, self)
         stats.add("train.nan_rollback")
+        # postmortem capture before the raise: the flight ring still
+        # holds the spans/events leading into the poisoned pass
+        from paddlebox_tpu import telemetry
+
+        telemetry.dump_flight("pass_rollback", {
+            "restored_pass": (status or {}).get("pass_idx")
+            if isinstance(status, dict) else None,
+            "pass_idx": self._pass_idx,
+        })
         raise PassRolledBack(status)
 
     # -- public API --------------------------------------------------------- #

@@ -41,8 +41,15 @@ def main() -> None:
                          "(crash-loop simulation; 0 = never)")
     args = ap.parse_args()
 
+    from paddlebox_tpu import telemetry
     from paddlebox_tpu.config import DataFeedConfig, SlotConfig
     from paddlebox_tpu.inference.server import ScoringServer
+
+    # full postmortem participation: labeled flight dumps (PBOX_FLIGHT_DIR
+    # inherited from the spawning test) + SIGTERM ring capture, exactly
+    # like a real serve.py replica
+    telemetry.set_process_name("replica")
+    telemetry.install_signal_dump()
 
     conf = DataFeedConfig(
         slots=(

@@ -53,21 +53,28 @@ def _train(model, ds, passes=6, metric_group=None):
 WIDTH = SparseTableConfig(embedding_dim=4).row_width
 
 
+# Pass budgets are per-model: wide_deep and deepfm spike for ~5 passes
+# before converging on this synthetic set (their linear/FM terms
+# overshoot early at the shared sparse learning rate — measured: loss
+# 0.81 -> 1.06 by pass 3, then monotonically down through 0.64 and AUC
+# 0.73 by pass 20, 0.90 by pass 30), so a 6-pass budget judged the
+# transient, not the model.  dcn/xdeepfm clear the bar in 6.
 @pytest.mark.parametrize(
-    "model_fn",
+    "model_fn,passes",
     [
-        lambda: WideDeep(S, WIDTH, dense_dim=DENSE, hidden=(16,)),
-        lambda: DeepFM(S, WIDTH, dense_dim=DENSE, hidden=(16,)),
-        lambda: DCN(S, WIDTH, dense_dim=DENSE, hidden=(16,), n_cross=2),
-        lambda: XDeepFM(
+        (lambda: WideDeep(S, WIDTH, dense_dim=DENSE, hidden=(16,)), 20),
+        (lambda: DeepFM(S, WIDTH, dense_dim=DENSE, hidden=(16,)), 20),
+        (lambda: DCN(S, WIDTH, dense_dim=DENSE, hidden=(16,), n_cross=2),
+         6),
+        (lambda: XDeepFM(
             S, WIDTH, dense_dim=DENSE, hidden=(16,), cin_layers=(8, 8)
-        ),
+        ), 6),
     ],
     ids=["wide_deep", "deepfm", "dcn", "xdeepfm"],
 )
-def test_model_learns(tmp_path, model_fn):
+def test_model_learns(tmp_path, model_fn, passes):
     _, ds = _dataset(tmp_path)
-    losses, metrics = _train(model_fn(), ds)
+    losses, metrics = _train(model_fn(), ds, passes=passes)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
     assert metrics["auc"] > 0.5
@@ -161,7 +168,9 @@ def test_mmoe_multitask(tmp_path):
         S, WIDTH, dense_dim=DENSE, n_tasks=3, n_experts=2,
         expert_hidden=(16,), expert_dim=8, tower_hidden=(8,),
     )
-    losses, metrics = _train(model, ds)
+    # 12 passes: MMoE shares wide_deep's early transient on this set
+    # (loss dips below its start at pass ~8; see test_model_learns note)
+    losses, metrics = _train(model, ds, passes=12)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
     for t in range(3):

@@ -60,24 +60,32 @@ def test_forward_matches_sequential():
 
 
 def test_train_step_matches_sequential_grads():
-    """One pipelined adam step == one sequential adam step on the same
-    stacked params (grads flow correctly through scan + ppermute)."""
+    """One pipelined SGD step == one sequential SGD step on the same
+    stacked params (grads flow correctly through scan + ppermute).
+
+    SGD, deliberately: the update is LINEAR in the gradient, so the
+    comparison is a direct gradient-equivalence check.  Adam's first
+    step normalizes (update ≈ lr·g/|g|), which amplifies reduction-order
+    float noise at near-zero-gradient coordinates into O(lr)
+    differences — that flakiness was measured to live exclusively at
+    |grad| < 3e-5 coords and says nothing about the pipeline's grads."""
+    import optax
+
     mesh = _mesh()
     params = init_pipeline_params(
         jax.random.PRNGKey(1), D_IN, WIDTH, 2, P_STAGES
     )
     x, y, mask = _data(1)
 
-    tr = PipelineTrainer(mesh, D_IN, WIDTH, 2, lr=1e-2, params=params)
+    tr = PipelineTrainer(mesh, D_IN, WIDTH, 2, params=params,
+                         optimizer=optax.sgd(1e-2))
     tr.train_step(x, y, mask)
     from paddlebox_tpu.parallel.multiprocess import local_view
 
     got = jax.tree.map(lambda l: local_view(l), tr.params)
 
     # sequential oracle
-    import optax
-
-    opt = optax.adam(1e-2)
+    opt = optax.sgd(1e-2)
     o0 = opt.init(params)
     loss, grads = jax.value_and_grad(reference_forward_loss)(
         params, jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask)

@@ -1,0 +1,58 @@
+"""Span-name drift check (tools/check_span_names.py): every span/instant
+recorded in code must have a row in ARCHITECTURE.md's "Distributed
+tracing & postmortems" span catalog and vice versa — the tier-1 guard
+that keeps the postmortem vocabulary honest, wired like the metric-name,
+fault-site and env-flag guards."""
+
+import os
+import subprocess
+import sys
+
+TOOL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "check_span_names.py",
+)
+
+
+def _load_tool():
+    sys.path.insert(0, os.path.dirname(TOOL))
+    try:
+        import importlib
+
+        return importlib.import_module("check_span_names")
+    finally:
+        sys.path.pop(0)
+
+
+def test_catalog_covers_every_call_site_both_ways():
+    mod = _load_tool()
+    missing, stale, found, pats = mod.check()
+    assert not missing, f"spans missing from the catalog: {missing}"
+    assert not stale, f"stale catalog rows: {stale}"
+    assert found and pats
+
+
+def test_scanner_finds_known_spans():
+    mod = _load_tool()
+    found = mod.scan_sources()
+    # a plain span, an f-string family, an instant marker, a retro span
+    assert "server.score" in found
+    assert "sync.apply.*" in found
+    assert "fleet.failover" in found
+    assert "hostplane.allgather" in found
+    # the docs' ``span("name")`` placeholder must NOT count as a span
+    assert "name" not in found
+
+
+def test_catalog_table_parses():
+    mod = _load_tool()
+    pats = mod.catalog_patterns()
+    assert "fleet.request" in pats
+    assert "sync.apply.*" in pats  # <kind> normalized to a wildcard
+
+
+def test_cli_exit_code_zero():
+    r = subprocess.run(
+        [sys.executable, TOOL], capture_output=True, text=True, timeout=60
+    )
+    assert r.returncode == 0, r.stderr

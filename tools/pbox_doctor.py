@@ -1,0 +1,541 @@
+#!/usr/bin/env python
+"""pbox_doctor: offline cross-process postmortem correlator.
+
+A paddlebox_tpu run scatters its evidence: per-process flight-recorder
+dumps (``flight-*.json``), rank-tagged JSONL event files (``*.jsonl`` +
+rotated ``.1/.2/...`` generations), per-pass Chrome-trace span files
+(``host-trace-*.json``) and the delivery plane's donefile.  After a
+stall, a rollback, a replica crash or a shed storm, the question is
+never "what does THIS file say" — it is "what happened, in order,
+across ALL of them".  This tool answers that without importing the
+package (stdlib only — it must run on a bare artifact directory):
+
+    python tools/pbox_doctor.py RUN_DIR              # merged timeline +
+                                                     # stall/crash/lag report
+    python tools/pbox_doctor.py RUN_DIR --trace ID   # one request's
+                                                     # cross-process path
+    python tools/pbox_doctor.py RUN_DIR --lineage    # publish->apply lag
+                                                     # per lineage ID
+    python tools/pbox_doctor.py RUN_DIR --json       # the full report as
+                                                     # machine-readable JSON
+
+What it correlates:
+
+  * **merged timeline** — every dump-ring record, JSONL event and trace
+    span placed on one wall-clock axis, labeled with its process
+    (trace files carry a ``pboxWallT0`` anchor; dumps and events carry
+    wall time natively);
+  * **who stalled first** — stall dumps carry the watchdog's structured
+    verdict (culprit / stage / age); the doctor reconstructs each
+    stall's START (dump time minus frozen age) and names the earliest;
+  * **publish→apply lag per lineage ID** — the publisher's donefile
+    entries and ``published`` events (lineage = producing pass/window)
+    joined against every process's ``sync_applied`` records: how long
+    each training window took to reach each serving process;
+  * **a request's path** (``--trace``) — all records sharing one trace
+    ID (router ``fleet.request``/``fleet.attempt`` spans, ``fleet.
+    failover`` markers, replica-side ``server.request``/``server.score``
+    spans), ordered: a failover reads as attempt 1 dying on replica A
+    and attempt 2 serving on replica B, under one ID;
+  * **replica crashes** — the supervisor's ``replica_crash`` dumps name
+    the dead child (id, pid, rc) and list any dumps the child left.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+DONEFILE_NAME = "donefile.txt"
+
+_EVENTS_RE = re.compile(r"\.jsonl(\.\d+)?$")
+_TRACE_RE = re.compile(r"(host-)?trace.*\.json$")
+
+
+# --------------------------------------------------------------------------- #
+# ingestion
+# --------------------------------------------------------------------------- #
+def _walk_files(run_dir: str) -> List[str]:
+    out: List[str] = []
+    for d, _, fs in os.walk(run_dir):
+        out += [os.path.join(d, f) for f in fs]
+    return sorted(out)
+
+
+def load_run(run_dir: str) -> dict:
+    """Ingest every artifact the run left under ``run_dir``.  Unreadable
+    or half-written files are skipped, not fatal: a postmortem tool that
+    dies on the torn file a crash left behind is useless exactly when
+    it is needed."""
+    dumps: List[dict] = []
+    events: List[dict] = []
+    traces: List[dict] = []
+    donefile_entries: List[dict] = []
+    for path in _walk_files(run_dir):
+        base = os.path.basename(path)
+        try:
+            if base.startswith("flight-") and base.endswith(".json"):
+                with open(path) as fh:
+                    d = json.load(fh)
+                if d.get("schema") == "pbox-flight-1":
+                    d["path"] = path
+                    dumps.append(d)
+            elif _EVENTS_RE.search(base):
+                events.extend(_load_jsonl(path))
+            elif base == DONEFILE_NAME:
+                donefile_entries.extend(_load_jsonl(path))
+            elif _TRACE_RE.search(base):
+                with open(path) as fh:
+                    d = json.load(fh)
+                if isinstance(d, dict) and "traceEvents" in d:
+                    d["path"] = path
+                    traces.append(d)
+        except (OSError, ValueError):
+            continue
+    dumps.sort(key=lambda d: d.get("t", 0.0))
+    return {
+        "run_dir": run_dir,
+        "dumps": dumps,
+        "events": events,
+        "traces": traces,
+        "donefile": donefile_entries,
+    }
+
+
+def _load_jsonl(path: str) -> List[dict]:
+    """JSONL records; a torn tail line (killed writer) is dropped, a
+    malformed middle line is skipped."""
+    out: List[dict] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    rec["_file"] = os.path.basename(path)
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# the merged timeline
+# --------------------------------------------------------------------------- #
+def _proc_label(proc: Optional[str], rank, pid=None) -> str:
+    bits = [proc or "pbox"]
+    if rank is not None:
+        bits.append(f"r{rank}")
+    if pid is not None:
+        bits.append(f"pid{pid}")
+    return "/".join(str(b) for b in bits)
+
+
+def build_timeline(data: dict) -> List[dict]:
+    """Every record from every source on one wall-clock axis.  Ring
+    records seen in several dumps of the same process dedupe by
+    (pid, t, kind, name, span identity)."""
+    rows: List[dict] = []
+    seen = set()
+    for d in data["dumps"]:
+        who = _proc_label(d.get("proc"), d.get("rank"), d.get("pid"))
+        rows.append({
+            "t": d.get("t", 0.0), "proc": who, "src": "dump",
+            "kind": "dump", "name": d.get("reason", "?"),
+            "detail": d.get("detail") or {},
+        })
+        for rec in d.get("ring", []):
+            key = (d.get("pid"), rec.get("t"), rec.get("kind"),
+                   rec.get("name"), rec.get("span_id"))
+            if key in seen:
+                continue
+            seen.add(key)
+            rows.append({
+                "t": rec.get("t", 0.0), "proc": who, "src": "ring",
+                "kind": rec.get("kind", "?"), "name": rec.get("name", "?"),
+                "detail": {k: v for k, v in rec.items()
+                           if k not in ("t", "kind", "name")},
+            })
+    for rec in data["events"]:
+        rows.append({
+            "t": rec.get("t", 0.0),
+            "proc": _proc_label(rec.get("_file"), rec.get("rank")),
+            "src": "event", "kind": "event",
+            "name": rec.get("event", "?"),
+            "detail": {k: v for k, v in rec.items()
+                       if k not in ("t", "rank", "event", "_file")},
+        })
+    for tr in data["traces"]:
+        wall0 = tr.get("pboxWallT0")
+        if wall0 is None:
+            continue  # un-anchored legacy trace: no wall placement
+        who = _proc_label(tr.get("pboxProcess"), tr.get("pboxRank"))
+        for ev in tr.get("traceEvents", []):
+            if ev.get("ph") not in ("X", "i"):
+                continue
+            rows.append({
+                "t": wall0 + ev.get("ts", 0.0) / 1e6,
+                "proc": who, "src": "trace", "kind": "span",
+                "name": ev.get("name", "?"),
+                "detail": dict(ev.get("args") or {}),
+            })
+    for e in data["donefile"]:
+        rows.append({
+            "t": e.get("published_at", 0.0), "proc": "publisher",
+            "src": "donefile", "kind": "publish",
+            "name": f"{e.get('kind', '?')}:{e.get('tag', '?')}",
+            "detail": {"seq": e.get("seq"), "lineage": e.get("lineage"),
+                       "n_rows": e.get("n_rows")},
+        })
+    rows.sort(key=lambda r: r["t"])
+    return rows
+
+
+# --------------------------------------------------------------------------- #
+# reports
+# --------------------------------------------------------------------------- #
+def stall_report(data: dict) -> dict:
+    """Who stalled first: each ``stall`` dump carries the watchdog's
+    verdict plus the frozen age — stall START = dump time − age."""
+    stalls = []
+    for d in data["dumps"]:
+        if d.get("reason") != "stall":
+            continue
+        det = d.get("detail") or {}
+        age = float(det.get("age_s") or 0.0)
+        stalls.append({
+            "t_detected": d.get("t", 0.0),
+            "t_stall_start": d.get("t", 0.0) - age,
+            "culprit": det.get("culprit"),
+            "stage": det.get("stage"),
+            "kind": det.get("kind"),
+            "detected_by": det.get("detected_by"),
+            "dumped_by": _proc_label(d.get("proc"), d.get("rank"),
+                                     d.get("pid")),
+            "path": d.get("path"),
+        })
+    stalls.sort(key=lambda s: s["t_stall_start"])
+    first = None
+    if stalls:
+        # the culprit's OWN (local) verdict outranks peer observations
+        # of the same instant; otherwise earliest reconstructed start
+        local = [s for s in stalls if s["kind"] == "local"]
+        first = (local or stalls)[0]
+    return {"first": first, "stalls": stalls}
+
+
+def crash_report(data: dict) -> List[dict]:
+    out = []
+    for d in data["dumps"]:
+        if d.get("reason") != "replica_crash":
+            continue
+        det = d.get("detail") or {}
+        out.append({
+            "t": d.get("t", 0.0),
+            "replica_id": det.get("replica_id"),
+            "pid": det.get("pid"),
+            "returncode": det.get("returncode"),
+            "port": det.get("port"),
+            "child_dumps": det.get("child_dumps") or [],
+            "path": d.get("path"),
+        })
+    return out
+
+
+def _iter_all_records(data: dict):
+    """(t, proc, kind, name, fields) across rings + events (the trace-ID
+    and lineage joins read from both)."""
+    for d in data["dumps"]:
+        who = _proc_label(d.get("proc"), d.get("rank"), d.get("pid"))
+        for rec in d.get("ring", []):
+            yield rec.get("t", 0.0), who, rec.get("kind", "?"), \
+                rec.get("name", "?"), rec
+    for rec in data["events"]:
+        who = _proc_label(rec.get("_file"), rec.get("rank"))
+        yield rec.get("t", 0.0), who, "event", rec.get("event", "?"), rec
+
+
+def lineage_report(data: dict) -> Dict[str, dict]:
+    """Per lineage ID: when it was published, and when (and where) each
+    process applied it — the publish→apply lag breakdown."""
+    lineages: Dict[str, dict] = {}
+
+    def slot(lid) -> dict:
+        return lineages.setdefault(str(lid), {
+            "published_at": None, "publish_seq": None, "kind": None,
+            "tag": None, "applies": [],
+        })
+
+    for e in data["donefile"]:
+        lid = e.get("lineage")
+        if lid is None:
+            continue
+        s = slot(lid)
+        s["published_at"] = e.get("published_at")
+        s["publish_seq"] = e.get("seq")
+        s["kind"] = e.get("kind")
+        s["tag"] = e.get("tag")
+    for t, who, kind, name, rec in _iter_all_records(data):
+        lid = rec.get("lineage")
+        if lid is None:
+            continue
+        if name == "published":
+            s = slot(lid)
+            if s["published_at"] is None:
+                s["published_at"] = t
+                s["publish_seq"] = rec.get("seq")
+                # JSONL events carry the publish kind as "kind"; ring
+                # records protect the ring schema by storing it as
+                # "field_kind"
+                s["kind"] = rec.get("field_kind", rec.get("kind"))
+                s["tag"] = rec.get("tag")
+        elif name == "sync_applied":
+            s = slot(lid)
+            pub = rec.get("published_at") or s["published_at"]
+            s["applies"].append({
+                "t": t, "proc": who, "seq": rec.get("seq"),
+                "lag_s": (t - pub) if pub else None,
+            })
+    for s in lineages.values():
+        # dedupe applies: a dump ring and the same process's JSONL both
+        # carry one apply under DIFFERENT proc labels, but they share the
+        # seq and the (sub-millisecond) apply instant — distinct replicas
+        # applying the same seq do so at genuinely different times
+        uniq = {}
+        for a in s["applies"]:
+            uniq.setdefault((a["seq"], round(a["t"], 2)), a)
+        s["applies"] = sorted(uniq.values(), key=lambda a: a["t"])
+        lags = [a["lag_s"] for a in s["applies"] if a["lag_s"] is not None]
+        s["first_apply_lag_s"] = min(lags) if lags else None
+        s["last_apply_lag_s"] = max(lags) if lags else None
+        s["n_applies"] = len(s["applies"])
+    return lineages
+
+
+def trace_report(data: dict, trace_id: Optional[str] = None) -> Dict[str, list]:
+    """Records grouped by trace ID (all traces, or just one), each list
+    wall-time ordered: a request's full cross-process path."""
+    traces: Dict[str, list] = {}
+    for t, who, kind, name, rec in _iter_all_records(data):
+        tid = rec.get("trace_id")
+        if tid is None or (trace_id is not None and tid != trace_id):
+            continue
+        traces.setdefault(tid, []).append({
+            "t": t, "proc": who, "kind": kind, "name": name,
+            "span_id": rec.get("span_id"),
+            "parent_span_id": rec.get("parent_span_id"),
+            "detail": {k: v for k, v in rec.items()
+                       if k not in ("t", "kind", "name", "trace_id",
+                                    "span_id", "parent_span_id")},
+        })
+    for tr in data["traces"]:
+        wall0 = tr.get("pboxWallT0")
+        if wall0 is None:
+            continue
+        who = _proc_label(tr.get("pboxProcess"), tr.get("pboxRank"))
+        for ev in tr.get("traceEvents", []):
+            args = ev.get("args") or {}
+            tid = args.get("trace_id")
+            if tid is None or (trace_id is not None and tid != trace_id):
+                continue
+            traces.setdefault(tid, []).append({
+                "t": wall0 + ev.get("ts", 0.0) / 1e6,
+                "proc": who, "kind": "span", "name": ev.get("name", "?"),
+                "span_id": args.get("span_id"),
+                "parent_span_id": args.get("parent_span_id"),
+                "detail": {k: v for k, v in args.items()
+                           if k not in ("trace_id", "span_id",
+                                        "parent_span_id")},
+            })
+    for recs in traces.values():
+        # dedupe (a span can appear in several dumps of one process)
+        uniq = {}
+        for r in recs:
+            uniq[(r["proc"], r["span_id"], r["name"], round(r["t"], 5))] = r
+        recs[:] = sorted(uniq.values(), key=lambda r: r["t"])
+    return traces
+
+
+def analyze(run_dir: str) -> dict:
+    """The whole report, machine-readable (what the e2e tests assert on
+    and ``--json`` prints)."""
+    data = load_run(run_dir)
+    report = {
+        "run_dir": run_dir,
+        "sources": {
+            "dumps": len(data["dumps"]),
+            "events": len(data["events"]),
+            "trace_files": len(data["traces"]),
+            "donefile_entries": len(data["donefile"]),
+        },
+        "timeline": build_timeline(data),
+        "stalls": stall_report(data),
+        "crashes": crash_report(data),
+        "lineage": lineage_report(data),
+        "traces": trace_report(data),
+        "dump_reasons": sorted(
+            {d.get("reason", "?") for d in data["dumps"]}
+        ),
+    }
+    return report
+
+
+# --------------------------------------------------------------------------- #
+# formatting
+# --------------------------------------------------------------------------- #
+def _fmt_detail(detail: dict, width: int = 80) -> str:
+    s = " ".join(
+        f"{k}={v}" for k, v in detail.items()
+        if v is not None and k not in ("metrics", "telemetry", "ring")
+    )
+    return s[:width]
+
+
+def format_timeline(report: dict, limit: int = 0) -> str:
+    rows = report["timeline"]
+    if limit and len(rows) > limit:
+        rows = rows[-limit:]
+    t0 = rows[0]["t"] if rows else 0.0
+    lines = [f"# merged timeline ({len(report['timeline'])} records, "
+             f"t0={t0:.3f})"]
+    for r in rows:
+        lines.append(
+            f"{r['t'] - t0:10.3f}s  {r['proc']:<28s} {r['src']:<8s} "
+            f"{r['kind']:<7s} {r['name']:<24s} {_fmt_detail(r['detail'])}"
+        )
+    return "\n".join(lines)
+
+
+def format_summary(report: dict) -> str:
+    lines = ["# pbox_doctor summary"]
+    src = report["sources"]
+    lines.append(
+        f"sources: {src['dumps']} flight dump(s), {src['events']} "
+        f"event record(s), {src['trace_files']} trace file(s), "
+        f"{src['donefile_entries']} donefile entr(ies)"
+    )
+    if report["dump_reasons"]:
+        lines.append(f"dump reasons: {', '.join(report['dump_reasons'])}")
+    first = report["stalls"]["first"]
+    if first is not None:
+        lines.append(
+            f"STALLED FIRST: rank {first['culprit']} in stage "
+            f"{first['stage']!r} (stall began t={first['t_stall_start']:.3f},"
+            f" detected by rank {first['detected_by']}, "
+            f"{first['kind']} check; {len(report['stalls']['stalls'])} "
+            f"process(es) dumped)"
+        )
+    for c in report["crashes"]:
+        lines.append(
+            f"REPLICA CRASH: replica {c['replica_id']} (pid {c['pid']}, "
+            f"rc={c['returncode']}, port {c['port']}) at t={c['t']:.3f}; "
+            f"{len(c['child_dumps'])} dump(s) left by the child"
+        )
+    for lid, s in sorted(report["lineage"].items()):
+        pub = s["published_at"]
+        lines.append(
+            f"lineage {lid}: published seq={s['publish_seq']} "
+            f"({s['kind']}) at t={pub:.3f}; " if pub else
+            f"lineage {lid}: publish record missing; "
+        )
+        if s["n_applies"]:
+            lines[-1] += (
+                f"applied by {s['n_applies']} process(es), lag "
+                f"first={_fmt_lag(s['first_apply_lag_s'])} "
+                f"last={_fmt_lag(s['last_apply_lag_s'])}"
+            )
+        else:
+            lines[-1] += "NEVER APPLIED (no sync_applied record)"
+    n_traces = len(report["traces"])
+    failovers = sum(
+        1 for recs in report["traces"].values()
+        if any(r["name"] == "fleet.failover" for r in recs)
+    )
+    if n_traces:
+        lines.append(f"traces: {n_traces} request trace(s) captured, "
+                     f"{failovers} with failover hops "
+                     f"(--trace <id> for a path)")
+    return "\n".join(lines)
+
+
+def _fmt_lag(v) -> str:
+    return f"{v * 1e3:.0f}ms" if v is not None else "?"
+
+
+def format_trace(report: dict, trace_id: str) -> str:
+    recs = report["traces"].get(trace_id)
+    if not recs:
+        return f"no records for trace {trace_id!r}"
+    t0 = recs[0]["t"]
+    lines = [f"# trace {trace_id} ({len(recs)} records)"]
+    for r in recs:
+        lines.append(
+            f"{(r['t'] - t0) * 1e3:9.2f}ms  {r['proc']:<28s} "
+            f"{r['name']:<22s} {_fmt_detail(r['detail'])}"
+        )
+    return "\n".join(lines)
+
+
+def format_lineage(report: dict) -> str:
+    lines = ["# publish -> apply lag per lineage"]
+    for lid, s in sorted(report["lineage"].items()):
+        lines.append(f"lineage {lid} (seq {s['publish_seq']}, {s['kind']}, "
+                     f"tag {s['tag']}):")
+        if not s["applies"]:
+            lines.append("    NEVER APPLIED")
+        for a in s["applies"]:
+            lines.append(
+                f"    {a['proc']:<28s} applied seq {a['seq']} "
+                f"lag {_fmt_lag(a['lag_s'])}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/pbox_doctor.py",
+        description="cross-process postmortem correlator",
+    )
+    ap.add_argument("run_dir", help="directory holding the run's flight "
+                                    "dumps / JSONL events / traces / "
+                                    "publish root")
+    ap.add_argument("--trace", default=None, metavar="ID",
+                    help="print one request's cross-process path")
+    ap.add_argument("--lineage", action="store_true",
+                    help="print the publish->apply lag table")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    ap.add_argument("--limit", type=int, default=60,
+                    help="timeline rows to print (0 = all)")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"ERROR: {args.run_dir} is not a directory", file=sys.stderr)
+        return 2
+    report = analyze(args.run_dir)
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, default=str)
+        print()
+        return 0
+    if args.trace:
+        print(format_trace(report, args.trace))
+        return 0
+    if args.lineage:
+        print(format_lineage(report))
+        return 0
+    print(format_summary(report))
+    print()
+    print(format_timeline(report, limit=args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
