@@ -12,12 +12,15 @@ parser transparently.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
 from typing import Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "slot_parser.cpp")
@@ -312,7 +315,10 @@ class CensusIndex:
         try:
             self.close()
         except Exception:
-            pass
+            # interpreter-teardown finalizer: the lib/lock may be half
+            # collected — record it, never raise out of __del__
+            logger.debug("census index close failed in __del__",
+                         exc_info=True)
 
     def lookup_unique(self, keys: np.ndarray, n_real: int):
         """(inverse[:n_real], uniq_key[:n_uniq], uniq_pos[:n_uniq]) with

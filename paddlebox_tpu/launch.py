@@ -250,11 +250,11 @@ def launch(
                 serve_proc.wait(timeout=10.0)
             except subprocess.TimeoutExpired:
                 serve_proc.kill()
-        deadline = time.time() + 10.0
+        deadline = time.monotonic() + 10.0
         for p in procs:
             if p.poll() is None:
                 try:
-                    p.wait(timeout=max(0.1, deadline - time.time()))
+                    p.wait(timeout=max(0.1, deadline - time.monotonic()))
                 except subprocess.TimeoutExpired:
                     p.kill()
         for f in logs:

@@ -68,6 +68,8 @@ def _tile_for(n: int) -> int:
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"
+    # pbox-lint: ignore[swallowed-exception] capability probe: no backend
+    # at all means "not on TPU", which is the answer
     except Exception:
         return False
 

@@ -209,6 +209,8 @@ class AsyncDenseTable:
                 self.applied += 1
                 self._q.task_done()
         except BaseException as e:  # surface on the next push/pull/drain
+            # pbox-lint: ignore[thread-shared-state] single-writer error
+            # latch: one atomic ref store, readers only test/raise it
             self._err = e
             self._q.task_done()  # the in-flight item
             # drain anything still queued so no producer stays blocked on a

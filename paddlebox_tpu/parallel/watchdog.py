@@ -247,14 +247,18 @@ class CoordKv:
         # is the poll primitive (DEADLINE_EXCEEDED -> absent)
         try:
             return self._client.blocking_key_value_get(key, 1)
+        # pbox-lint: ignore[swallowed-exception] DEADLINE_EXCEEDED -> absent
+        # is this poll primitive's contract, not a swallowed failure
         except Exception:
             return None
 
     def delete(self, key: str) -> None:
         try:
             self._client.key_value_delete(key)
+        # pbox-lint: ignore[swallowed-exception] older runtimes lack
+        # key_value_delete: the key leaks, bounded
         except Exception:
-            pass  # older runtimes without delete: key leaks, bounded
+            pass
 
 
 # --------------------------------------------------------------------------- #
@@ -367,6 +371,9 @@ class Watchdog:
                 stats.add("watchdog.poison_set")
             except Exception:
                 logger.exception("watchdog: failed to publish poison key")
+        # pbox-lint: ignore[thread-shared-state] written before the
+        # _aborted Event trips; readers check the Event first — it is the
+        # fence
         self._error = err
         self._aborted.set()
         stats.add("watchdog.aborts")
@@ -562,7 +569,8 @@ class Watchdog:
             try:
                 self.kv.delete(self._hb_key(self.rank))
             except Exception:
-                pass
+                logger.debug("heartbeat key cleanup failed on close "
+                             "(stale key ages out)", exc_info=True)
 
     def __enter__(self) -> "Watchdog":
         return self.start()

@@ -340,6 +340,8 @@ class Syncer:
             self.server.register_predictor(
                 self.name, predictor, feed_conf, version=lineage
             )
+        # pbox-lint: ignore[thread-shared-state] monotonic int latch: a
+        # stale read just delays one freshness confirmation poll
         self._applied_seq = version.seq
         # the apply-side half of the publish→apply lag record: pairs with
         # the publisher's "published" event by lineage/seq across

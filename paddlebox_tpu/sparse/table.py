@@ -33,6 +33,7 @@ it is re-zeroed after every push so stray gradients cannot leak into it.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 import time
@@ -46,6 +47,8 @@ import numpy as np
 from paddlebox_tpu.config import SparseTableConfig
 from paddlebox_tpu.data.feed import HostBatch
 from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
+
+logger = logging.getLogger(__name__)
 
 
 class _SerialWorker:
@@ -392,7 +395,10 @@ class SparseTable:
             try:
                 fut.result()
             except Exception:
-                pass  # a failed stage has nothing to discard
+                # a failed stage has nothing to discard — but the staging
+                # thread's failure must not evaporate silently
+                logger.debug("discarded a failed background stage",
+                             exc_info=True)
         with self._overlay_lock:
             self._patch_log = []
 

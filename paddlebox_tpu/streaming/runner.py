@@ -61,6 +61,8 @@ def _watchdog_mod():
         from paddlebox_tpu.parallel import watchdog
 
         return watchdog
+    # pbox-lint: ignore[swallowed-exception] gated-import fallback: a build
+    # without the parallel package is the handled case
     except Exception:
         import sys
 
@@ -185,13 +187,17 @@ class StreamingTrainer:
             try:
                 self.policy.confirm_served(self.served_seq_fn())
             except Exception:
-                pass  # the serving side may not be up yet
+                # the serving side may not be up yet — expected early on,
+                # but a *persistently* failing poll must stay visible
+                stats.add("stream.confirm_errors")
             self._confirm_stop.wait(0.05)
         # final sweep so a publish confirmed just before shutdown lands
         try:
             self.policy.confirm_served(self.served_seq_fn())
         except Exception:
-            pass
+            stats.add("stream.confirm_errors")
+            logger.debug("final serve-confirmation sweep failed",
+                         exc_info=True)
 
     # -- the loop ------------------------------------------------------------ #
     def run(

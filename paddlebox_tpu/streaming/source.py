@@ -331,7 +331,9 @@ class TailingFileSource(StreamSource):
             try:
                 self._poll_once(draining=True)
             except Exception:
-                pass
+                self.poll_errors += 1
+                stats.add("stream.tail_errors")
+                logger.debug("final drain poll failed", exc_info=True)
         except BaseException:
             # a watchdog hang-interrupt (DistributedStallError) or any
             # other escape retires the producer; EOF below unblocks the

@@ -47,6 +47,8 @@ class FieldDumper:
             try:
                 self._fh.write(self._format(*item))
             except Exception as e:  # disk full / quota: surface on next call
+                # pbox-lint: ignore[thread-shared-state] single-writer
+                # error latch: one atomic ref store, reader raises from it
                 self._error = e
 
     def _format(self, batch, preds: np.ndarray, base: int) -> str:

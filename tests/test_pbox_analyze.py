@@ -1,0 +1,575 @@
+"""pbox-lint (tools/pbox_analyze/): the concurrency- and JAX-aware
+static-analysis framework.
+
+Per rule: a good fixture (no finding), a bad fixture (finding at the
+expected line), a suppressed fixture (inline ``# pbox-lint: ignore``),
+and — once — a baselined fixture.  Plus the framework plumbing
+(suppression placement, baseline schema/order/staleness hygiene,
+--changed line filtering) and the tier-1 gate: zero non-baselined
+findings over the repo's default roots.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+CLI = os.path.join(TOOLS, "pbox_analyze.py")
+
+sys.path.insert(0, TOOLS)
+
+from pbox_analyze import baseline as baseline_mod  # noqa: E402
+from pbox_analyze import (  # noqa: E402
+    rules_clock,
+    rules_except,
+    rules_locks,
+    rules_threads,
+    rules_tracer,
+)
+from pbox_analyze.core import Context, SourceFile  # noqa: E402
+
+
+def _ctx(tmp_path, source: str) -> Context:
+    path = tmp_path / "fixture.py"
+    path.write_text(textwrap.dedent(source))
+    return Context(paths=[str(path)], repo=str(tmp_path))
+
+
+def _run(mod, tmp_path, source: str):
+    ctx = _ctx(tmp_path, source)
+    findings = mod.run(ctx)
+    # apply inline suppressions the way the CLI does
+    return [
+        f for f in findings
+        if not ctx.by_rel[f.file].suppressed(f)
+    ]
+
+
+# --------------------------------------------------------------------------- #
+# swallowed-exception
+# --------------------------------------------------------------------------- #
+BAD_EXCEPT = """\
+    def f():
+        try:
+            risky()
+        except Exception:
+            pass
+"""
+
+
+def test_swallowed_exception_bad(tmp_path):
+    (finding,) = _run(rules_except, tmp_path, BAD_EXCEPT)
+    assert finding.rule == "swallowed-exception"
+    assert finding.line == 4
+
+
+@pytest.mark.parametrize("body", [
+    "raise",                                # re-raise
+    "logger.warning('x', exc_info=True)",   # log
+    "stats.add('x.errors')",                # counter
+    "flight.dump_now('boom')",              # flight dump
+    "print('x')",                           # stderr surfacing
+])
+def test_swallowed_exception_good(tmp_path, body):
+    src = BAD_EXCEPT.replace("pass", body)
+    assert _run(rules_except, tmp_path, src) == []
+
+
+def test_swallowed_exception_stored_latch_good(tmp_path):
+    src = """\
+        def f(self):
+            try:
+                risky()
+            except BaseException as e:
+                self._err = e
+    """
+    assert _run(rules_except, tmp_path, src) == []
+
+
+def test_narrow_except_is_not_flagged(tmp_path):
+    src = BAD_EXCEPT.replace("Exception", "ValueError")
+    assert _run(rules_except, tmp_path, src) == []
+
+
+def test_swallowed_exception_suppressed(tmp_path):
+    src = BAD_EXCEPT.replace(
+        "except Exception:",
+        "# pbox-lint: ignore[swallowed-exception] fixture reason\n"
+        "    except Exception:",
+    )
+    assert _run(rules_except, tmp_path, src) == []
+
+
+def test_multiline_reason_comment_still_covers_the_site(tmp_path):
+    src = BAD_EXCEPT.replace(
+        "except Exception:",
+        "# pbox-lint: ignore[swallowed-exception] a reason so long it\n"
+        "    # wraps onto a second comment line before the code\n"
+        "    except Exception:",
+    )
+    assert _run(rules_except, tmp_path, src) == []
+
+
+# --------------------------------------------------------------------------- #
+# clock-misuse
+# --------------------------------------------------------------------------- #
+def test_clock_misuse_literal_deadline(tmp_path):
+    src = """\
+        import time
+        deadline = time.time() + 10.0
+    """
+    (finding,) = _run(rules_clock, tmp_path, src)
+    assert finding.rule == "clock-misuse"
+    assert finding.line == 2
+
+
+def test_clock_misuse_timeout_name_and_compare(tmp_path):
+    src = """\
+        import time
+        state = {"deadline": time.time() + hang_timeout}
+        if time.time() > state["deadline"]:
+            boom()
+    """
+    lines = {f.line for f in _run(rules_clock, tmp_path, src)}
+    assert lines == {2, 3}
+
+
+def test_clock_wallclock_timestamps_are_legal(tmp_path):
+    src = """\
+        import time
+        published_at = time.time()
+        lag = time.time() - rec.event_ts
+        fresh = time.time() - oldest
+    """
+    assert _run(rules_clock, tmp_path, src) == []
+
+
+def test_clock_misuse_suppressed(tmp_path):
+    src = """\
+        import time
+        # pbox-lint: ignore[clock-misuse] fixture reason
+        deadline = time.time() + 10.0
+    """
+    assert _run(rules_clock, tmp_path, src) == []
+
+
+# --------------------------------------------------------------------------- #
+# lock-order / lock-held-blocking
+# --------------------------------------------------------------------------- #
+LOCK_CYCLE = """\
+    import threading
+
+    class Gate:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_lock_order_cycle(tmp_path):
+    findings = _run(rules_locks, tmp_path, LOCK_CYCLE)
+    assert {f.rule for f in findings} == {"lock-order"}
+    assert {f.line for f in findings} == {10, 15}
+
+
+def test_lock_order_consistent_is_legal(tmp_path):
+    src = LOCK_CYCLE.replace(
+        "with self._b:\n                with self._a:",
+        "with self._a:\n                with self._b:",
+    )
+    assert "def two" in src and src.count("with self._a:") == 2
+    assert _run(rules_locks, tmp_path, src) == []
+
+
+def test_lock_order_interprocedural(tmp_path):
+    src = """\
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def outer(self):
+                with self._a:
+                    self.inner()
+
+            def inner(self):
+                with self._b:
+                    pass
+
+            def reversed(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    assert any(
+        f.rule == "lock-order"
+        for f in _run(rules_locks, tmp_path, src)
+    )
+
+
+BLOCKING = """\
+    import threading
+    import time
+
+    class Gate:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition()
+            self.sock = None
+
+        def bad(self):
+            with self._lock:
+                time.sleep(1.0)
+                self.sock.recv(4096)
+                self._cond.wait()
+
+        def good(self):
+            with self._cond:
+                self._cond.wait()
+            time.sleep(1.0)
+"""
+
+
+def test_lock_held_blocking(tmp_path):
+    findings = _run(rules_locks, tmp_path, BLOCKING)
+    assert {f.rule for f in findings} == {"lock-held-blocking"}
+    assert {f.line for f in findings} == {12, 13, 14}
+
+
+def test_lock_held_blocking_suppressed(tmp_path):
+    src = BLOCKING.replace(
+        "time.sleep(1.0)\n                self.sock.recv",
+        "time.sleep(1.0)  # pbox-lint: ignore[lock-held-blocking] reason\n"
+        "                self.sock.recv",
+    )
+    assert "ignore[lock-held-blocking]" in src
+    lines = {f.line for f in _run(rules_locks, tmp_path, src)}
+    assert lines == {13, 14}  # only the sleep was waved through
+
+
+# --------------------------------------------------------------------------- #
+# thread-shared-state
+# --------------------------------------------------------------------------- #
+SHARED = """\
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._thread = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            self.count += 1
+
+        def read(self):
+            return self.count
+"""
+
+
+def test_thread_shared_state_bad(tmp_path):
+    (finding,) = _run(rules_threads, tmp_path, SHARED)
+    assert finding.rule == "thread-shared-state"
+    assert finding.line == 10
+    assert "count" in finding.message
+
+
+def test_thread_shared_state_locked_is_legal(tmp_path):
+    src = SHARED.replace(
+        "def _loop(self):\n        self.count += 1",
+        "def _loop(self):\n        with self._lock:\n"
+        "            self.count += 1",
+    ).replace(
+        "return self.count",
+        "with self._lock:\n            return self.count",
+    )
+    assert _run(rules_threads, tmp_path, src) == []
+
+
+def test_thread_shared_state_sync_attrs_exempt(tmp_path):
+    src = """\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                self._stop.wait(1.0)
+
+            def close(self):
+                self._stop.set()
+                self._thread = None
+    """
+    assert _run(rules_threads, tmp_path, src) == []
+
+
+def test_thread_shared_state_suppressed(tmp_path):
+    src = SHARED.replace(
+        "self.count += 1",
+        "# pbox-lint: ignore[thread-shared-state] fixture reason\n"
+        "        self.count += 1",
+    )
+    assert _run(rules_threads, tmp_path, src) == []
+
+
+# --------------------------------------------------------------------------- #
+# jax-tracer-safety
+# --------------------------------------------------------------------------- #
+def test_tracer_host_effect_and_branch(tmp_path):
+    src = """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            print("trace-time only")
+            y = np.asarray(x)
+            if x > 0:
+                return y
+            return -y
+    """
+    findings = _run(rules_tracer, tmp_path, src)
+    assert {f.rule for f in findings} == {"jax-tracer-safety"}
+    assert {f.line for f in findings} == {6, 7, 8}
+
+
+def test_tracer_static_idioms_are_legal(tmp_path):
+    src = """\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def step(x, mask=None):
+            k = x.shape[0]
+            pad = np.zeros((4,), np.float32)
+            if mask is None:
+                mask = jnp.ones((k,))
+            if k > 128:
+                x = x[:128]
+            jax.debug.print("ok {}", x)
+            return x * mask + pad
+    """
+    assert _run(rules_tracer, tmp_path, src) == []
+
+
+def test_tracer_scan_body_by_callsite(tmp_path):
+    src = """\
+        import jax
+
+        def body(carry, x):
+            print("host effect in scan body")
+            return carry, x
+
+        def outer(xs):
+            return jax.lax.scan(body, 0, xs)
+    """
+    (finding,) = _run(rules_tracer, tmp_path, src)
+    assert finding.line == 4
+
+
+def test_tracer_untraced_function_is_free(tmp_path):
+    src = """\
+        def host_loop(x):
+            print("fine: nobody traces this")
+            if x > 0:
+                return 1
+    """
+    assert _run(rules_tracer, tmp_path, src) == []
+
+
+def test_tracer_suppressed(tmp_path):
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(x):
+            # pbox-lint: ignore[jax-tracer-safety] fixture reason
+            print("deliberate trace-time banner")
+            return x
+    """
+    assert _run(rules_tracer, tmp_path, src) == []
+
+
+# --------------------------------------------------------------------------- #
+# suppression plumbing
+# --------------------------------------------------------------------------- #
+def test_suppression_only_masks_the_named_rule(tmp_path):
+    path = tmp_path / "s.py"
+    path.write_text(
+        "import time\n"
+        "# pbox-lint: ignore[swallowed-exception] wrong rule named\n"
+        "deadline = time.time() + 10.0\n"
+    )
+    ctx = Context(paths=[str(path)], repo=str(tmp_path))
+    findings = rules_clock.run(ctx)
+    assert findings and not ctx.by_rel[findings[0].file].suppressed(
+        findings[0])
+
+
+def test_suppression_multiple_rules_one_marker(tmp_path):
+    sf = SourceFile.__new__(SourceFile)  # placement parsing only
+    path = tmp_path / "m.py"
+    path.write_text(
+        "x = 1  # pbox-lint: ignore[rule-a, rule-b] both at once\n")
+    sf = SourceFile(str(path), repo=str(tmp_path))
+    assert sf.suppressions[1] == {"rule-a", "rule-b"}
+
+
+# --------------------------------------------------------------------------- #
+# baseline hygiene
+# --------------------------------------------------------------------------- #
+def _entry(rule="clock-misuse", file="a.py", snippet="x = 1", reason="r"):
+    return {"rule": rule, "file": file, "snippet": snippet, "reason": reason}
+
+
+def test_baseline_matches_by_snippet_not_line(tmp_path):
+    src = """\
+        import time
+
+
+        deadline = time.time() + 10.0
+    """
+    ctx = _ctx(tmp_path, src)
+    (finding,) = rules_clock.run(ctx)
+    entries = [_entry(file="fixture.py",
+                      snippet="deadline = time.time() + 10.0")]
+    kept, baselined, stale = baseline_mod.apply([finding], entries)
+    assert kept == [] and stale == [] and len(baselined) == 1
+
+
+def test_stale_baseline_entry_is_an_error(tmp_path):
+    entries = [_entry(snippet="code that no longer exists")]
+    kept, baselined, stale = baseline_mod.apply([], entries)
+    assert baselined == [] and len(stale) == 1
+    assert stale[0].rule == "stale-baseline"
+
+
+def test_baseline_schema_rejects_bad_entries(tmp_path):
+    for bad in (
+        {"rule": "r", "file": "f"},                      # missing keys
+        {**_entry(), "extra": 1},                        # unknown key
+        {**_entry(), "reason": "   "},                   # empty reason
+    ):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps([bad]))
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load(str(p))
+
+
+def test_baseline_must_be_sorted(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps([
+        _entry(rule="z-rule"), _entry(rule="a-rule"),
+    ]))
+    with pytest.raises(baseline_mod.BaselineError):
+        baseline_mod.load(str(p))
+
+
+def test_checked_in_baseline_is_valid():
+    # the repo's own baseline must always load (sorted, schema-clean)
+    baseline_mod.load()
+
+
+# --------------------------------------------------------------------------- #
+# CLI + the tier-1 gate
+# --------------------------------------------------------------------------- #
+def test_tier1_gate_repo_is_clean():
+    """THE gate: zero non-baselined findings over paddlebox_tpu/, tools/
+    and bench.py.  A new finding means fix it, suppress it with a
+    reason, or (legacy only) baseline it — not ignore it."""
+    r = subprocess.run(
+        [sys.executable, CLI, "--all"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, f"pbox-lint found:\n{r.stdout}\n{r.stderr}"
+
+
+def test_cli_json_shape():
+    r = subprocess.run(
+        [sys.executable, CLI, "--all", "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout) == []
+
+
+def test_cli_names_rule_file_line_on_regression(tmp_path):
+    """The acceptance scenario: a seeded clock regression exits non-zero
+    and the output names the rule, file and line."""
+    bad = tmp_path / "regress.py"
+    bad.write_text("import time\ndeadline = time.time() + 10.0\n")
+    r = subprocess.run(
+        [sys.executable, CLI, str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "clock-misuse" in r.stdout
+    assert "regress.py:2" in r.stdout
+
+
+def test_cli_rules_filter_and_unknown_rule(tmp_path):
+    bad = tmp_path / "regress.py"
+    bad.write_text(
+        "import time\n"
+        "deadline = time.time() + 10.0\n"
+        "try:\n"
+        "    pass\n"
+        "except Exception:\n"
+        "    pass\n"
+    )
+    r = subprocess.run(
+        [sys.executable, CLI, str(bad), "--rules", "swallowed-exception"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "swallowed-exception" in r.stdout
+    assert "clock-misuse" not in r.stdout
+    r = subprocess.run(
+        [sys.executable, CLI, "--rules", "no-such-rule"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 2
+
+
+def test_cli_list_rules():
+    r = subprocess.run(
+        [sys.executable, CLI, "--list-rules"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0
+    for rule in ("lock-order", "lock-held-blocking", "thread-shared-state",
+                 "swallowed-exception", "clock-misuse", "jax-tracer-safety",
+                 "metric-name-drift", "fault-site-drift", "env-flag-drift",
+                 "span-name-drift"):
+        assert rule in r.stdout
+
+
+def test_cli_changed_mode_clean():
+    """--changed vs HEAD on a clean-or-dirty tree must not crash and must
+    honor the touched-lines filter (findings subset of a full run)."""
+    r = subprocess.run(
+        [sys.executable, CLI, "--changed", "HEAD"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode in (0, 1), r.stderr
+    assert "changed vs HEAD" in r.stderr

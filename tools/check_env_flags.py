@@ -2,26 +2,12 @@
 """Env-flag drift check: every PBOX_* var the package reads must be
 documented, and every documented PBOX_* var must still exist.
 
-The env surface is the ops contract: a flag the code reads but no doc
-names is undiscoverable (operators grep ARCHITECTURE.md, not the
-source), and a doc naming a removed flag sends operators chasing knobs
-that do nothing.  This tool cross-checks the two in both directions:
-
-  * **referenced** — the union of (a) the flag-shim entries
-    (``config.py`` ``_Flags._DEFAULTS`` keys, read from the environment
-    as ``PBOX_<NAME>`` — parsed via AST, so dynamically-constructed
-    names are still caught) and (b) literal ``PBOX_*`` tokens anywhere
-    in the package source + bench.py (direct ``os.environ`` reads, and
-    comments naming flags — a comment citing a stale name fails too,
-    which keeps prose honest);
-  * **documented** — every ``PBOX_*`` token in ARCHITECTURE.md and
-    README.md (the "Environment flags" catalog table plus inline
-    mentions).
+Thin wrapper: the implementation moved into the pbox-lint framework
+(tools/pbox_analyze/rules_drift.py, rule ``env-flag-drift``).  This CLI
+and its module-level functions are preserved for tier-1 tests and docs.
 
 referenced − documented = undocumented flags (fail); documented −
-referenced = stale docs (fail).  Wired into tier-1 via
-tests/test_env_flags.py, exactly like the metric-name and fault-site
-guards.
+referenced = stale docs (fail).
 
 Usage:
     python tools/check_env_flags.py            # check, exit 1 on drift
@@ -31,89 +17,39 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import ast
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CONFIG_PY = os.path.join(REPO, "paddlebox_tpu", "config.py")
-DOCS = [os.path.join(REPO, "ARCHITECTURE.md"), os.path.join(REPO, "README.md")]
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# a real var name: PBOX_ + at least one more segment ("PBOX_<NAME>"-style
-# placeholder prose matches nothing)
-_VAR_RE = re.compile(r"PBOX_[A-Z][A-Z0-9_]*")
+from pbox_analyze import rules_drift  # noqa: E402
 
 
 def flag_vars() -> dict:
     """{PBOX_<NAME>: 'config.py:_Flags._DEFAULTS'} parsed statically out
     of the flag shim (no package import: must run on a bare checkout)."""
-    tree = ast.parse(open(CONFIG_PY).read())
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "_DEFAULTS":
-                    return {
-                        "PBOX_" + ast.literal_eval(k).upper():
-                            "paddlebox_tpu/config.py:_Flags._DEFAULTS"
-                        for k in node.value.keys
-                    }
-    raise SystemExit(f"ERROR: no _DEFAULTS literal found in {CONFIG_PY}")
-
-
-def _source_files() -> list:
-    roots = [os.path.join(REPO, "paddlebox_tpu"),
-             os.path.join(REPO, "bench.py")]
-    files: list = []
-    for root in roots:
-        if root.endswith(".py"):
-            files.append(root)
-            continue
-        for d, _, fs in os.walk(root):
-            files += [os.path.join(d, f) for f in fs if f.endswith(".py")]
-    return sorted(files)
+    return rules_drift.env_flag_vars()
 
 
 def referenced_vars() -> dict:
     """{var: first 'file:line' seen}: flag-shim entries + every literal
     PBOX_* token in the package source and bench.py."""
-    found = dict(flag_vars())
-    for path in _source_files():
-        text = open(path).read()
-        rel = os.path.relpath(path, REPO)
-        for m in _VAR_RE.finditer(text):
-            line = text.count("\n", 0, m.start()) + 1
-            found.setdefault(m.group(0), f"{rel}:{line}")
-    return found
+    return rules_drift.env_referenced_vars()
 
 
 def documented_vars() -> dict:
     """{var: first 'doc:line' seen} across ARCHITECTURE.md + README.md."""
-    found: dict = {}
-    for path in DOCS:
-        if not os.path.exists(path):
-            continue
-        text = open(path).read()
-        rel = os.path.relpath(path, REPO)
-        for m in _VAR_RE.finditer(text):
-            line = text.count("\n", 0, m.start()) + 1
-            found.setdefault(m.group(0), f"{rel}:{line}")
-    return found
+    return rules_drift.env_documented_vars()
 
 
 def check() -> tuple:
     """(undocumented, stale) drift lists: [(var, where), ...]."""
-    referenced = referenced_vars()
-    documented = documented_vars()
-    undocumented = sorted(
-        (var, where) for var, where in referenced.items()
-        if var not in documented
+    # late-bound module globals: tests monkeypatch referenced_vars /
+    # documented_vars on THIS module and expect check() to honor it
+    return rules_drift.env_check(
+        referenced_fn=lambda: referenced_vars(),
+        documented_fn=lambda: documented_vars(),
     )
-    stale = sorted(
-        (var, where) for var, where in documented.items()
-        if var not in referenced
-    )
-    return undocumented, stale
 
 
 def main(argv=None) -> int:

@@ -1,24 +1,19 @@
 #!/usr/bin/env python
 """Fault-site drift check: KNOWN_SITES and the call sites must agree.
 
-The chaos machinery is only as good as its site catalog
-(``utils.faults.KNOWN_SITES``): a fault plan naming a site no
-``inject()``/``fire()`` call uses silently never fires, and an
-instrumented call site missing from the catalog draws the unknown-site
-warning on every legitimate plan.  This tool statically cross-checks the
-two directions:
+Thin wrapper: the implementation moved into the pbox-lint framework
+(tools/pbox_analyze/rules_drift.py, rule ``fault-site-drift``).  This
+CLI and its module-level functions are preserved for tier-1 tests and
+docs; ``check()`` deliberately resolves ``known_sites`` through this
+module's global so tests can monkeypatch it.
 
   * **unknown** — a literal site name used at a call site
     (``faults.inject("x")`` / ``faults.fire("x")`` /
     ``retry_call(..., site="x")``) that is not in KNOWN_SITES (nor
     registered via a literal ``register_site("x")``) fails the check;
   * **orphaned** — a KNOWN_SITES entry no call site references fails
-    too.  Sites built dynamically by prefix concatenation
-    (``faults.inject("fs." + cmd)``) are recognized: the literal prefix
-    is collected and any catalog entry under it counts as referenced.
-
-Wired into tier-1 via tests/test_fault_sites.py, exactly like
-tools/check_metric_names.py keeps the metric catalog honest.
+    too; dynamic-prefix constructions (``faults.inject("fs." + cmd)``)
+    mark every catalog entry under the prefix as reachable.
 
 Usage:
     python tools/check_fault_sites.py            # check, exit 1 on drift
@@ -29,99 +24,32 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import ast
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-FAULTS_PY = os.path.join(REPO, "paddlebox_tpu", "utils", "faults.py")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# literal site uses: inject("x") / fire("x") / site="x".  The name must
-# be the WHOLE first argument (followed by ',' or ')') — a literal that
-# continues with '+' is a dynamic-prefix construction, collected
-# separately below.
-_USE_RE = re.compile(
-    r"""\b(?:faults\.)?(?:inject|fire)\(\s*(["'])([^"']+)\1\s*[,)]
-      | \bsite\s*=\s*(["'])([^"']+)\3\s*[,)\n]""",
-    re.VERBOSE,
-)
-# dynamic construction: inject("prefix" + expr) — the prefix marks every
-# catalog entry under it as reachable
-_DYN_RE = re.compile(
-    r"""\b(?:faults\.)?(?:inject|fire)\(\s*(["'])([^"']+)\1\s*\+""",
-    re.VERBOSE,
-)
-_REGISTER_RE = re.compile(
-    r"""\bregister_site\(\s*(["'])([^"']+)\1\s*\)""",
-    re.VERBOSE,
-)
+from pbox_analyze import rules_drift  # noqa: E402
 
 
 def known_sites() -> set:
     """KNOWN_SITES parsed statically out of utils/faults.py (no package
     import: the tool must run on a bare checkout)."""
-    tree = ast.parse(open(FAULTS_PY).read())
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "KNOWN_SITES":
-                    return set(ast.literal_eval(node.value))
-    raise SystemExit(f"ERROR: no KNOWN_SITES literal found in {FAULTS_PY}")
-
-
-def _source_files(extra=()) -> list:
-    roots = [os.path.join(REPO, "paddlebox_tpu"),
-             os.path.join(REPO, "bench.py")]
-    files: list = []
-    for root in roots:
-        if root.endswith(".py"):
-            files.append(root)
-            continue
-        for d, _, fs in os.walk(root):
-            files += [os.path.join(d, f) for f in fs if f.endswith(".py")]
-    return sorted(files) + [os.path.abspath(p) for p in extra]
+    return rules_drift.fault_known_sites()
 
 
 def scan_sources(extra=()):
     """(used, dynamic_prefixes, registered): literal site names at call
     sites, literal prefixes of dynamically-built names, and literal
     register_site() additions — each mapped to first 'file:line' seen."""
-    used: dict = {}
-    prefixes: dict = {}
-    registered: dict = {}
-    for path in _source_files(extra):
-        text = open(path).read()
-        rel = os.path.relpath(path, REPO)
-
-        def note(out, name, start):
-            line = text.count("\n", 0, start) + 1
-            out.setdefault(name, f"{rel}:{line}")
-
-        for m in _USE_RE.finditer(text):
-            note(used, m.group(2) or m.group(4), m.start())
-        for m in _DYN_RE.finditer(text):
-            note(prefixes, m.group(2), m.start())
-        for m in _REGISTER_RE.finditer(text):
-            note(registered, m.group(2), m.start())
-    return used, prefixes, registered
+    return rules_drift.fault_scan_sources(extra)
 
 
 def check(extra=()) -> tuple:
     """(unknown, orphaned) drift lists: [(site, where), ...]."""
-    known = known_sites()
-    used, prefixes, registered = scan_sources(extra)
-    unknown = sorted(
-        (site, where) for site, where in used.items()
-        if site not in known and site not in registered
-    )
-    reachable = set(used) | set(registered)
-    orphaned = sorted(
-        (site, "utils/faults.py KNOWN_SITES") for site in known
-        if site not in reachable
-        and not any(site.startswith(p) for p in prefixes)
-    )
-    return unknown, orphaned
+    # late-bound module global: monkeypatching check_fault_sites.known_sites
+    # (the orphaned-site self-test does) must take effect here
+    return rules_drift.fault_check(extra, known_sites_fn=lambda: known_sites())
 
 
 def main(argv=None) -> int:

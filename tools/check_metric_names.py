@@ -2,16 +2,12 @@
 """Metric-name drift check: every metric created in code must be in the
 ARCHITECTURE.md catalog.
 
-Greps the package (plus bench.py) for metric-creating call-sites —
-``stats.add(`` / ``stats.set(`` / ``counter(`` / ``gauge(`` /
-``histogram(`` with a literal first argument — and fails if any metric
-name is missing from the "Observability" section's catalog table.  This
-keeps the catalog honest as the codebase grows: a new counter lands, the
-tier-1 suite fails until the table row does too.
-
-Name matching: f-string placeholders in code (``f"retry.{site}.calls"``)
-and ``<site>``-style placeholders in the table both normalize to ``*``
-segments, so dynamic families stay one catalog row.
+Thin wrapper: the implementation moved into the pbox-lint framework
+(tools/pbox_analyze/rules_drift.py, rule ``metric-name-drift``), which
+shares the source walker and ARCHITECTURE.md table scraper with the
+other drift guards instead of re-implementing them.  This CLI and its
+module-level functions are preserved verbatim for tier-1 tests, docs,
+and operator muscle memory.
 
 Usage:
     python tools/check_metric_names.py            # check, exit 1 on drift
@@ -21,67 +17,23 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import fnmatch
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ARCH = os.path.join(REPO, "ARCHITECTURE.md")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# metric-creating call with a (possibly f-) string literal first argument;
-# DOTALL so names split across the open-paren's line break still match
-_CALL_RE = re.compile(
-    r"""\b(?:stats\.(?:add|set)|counter|gauge|histogram)\(\s*
-        (f?)(["'])([^"']+)\2""",
-    re.VERBOSE | re.DOTALL,
-)
-# backticked names in the catalog table's first column
-_TABLE_ROW_RE = re.compile(r"^\|\s*`([^`]+)`")
+from pbox_analyze import rules_drift  # noqa: E402
 
 
 def scan_sources() -> dict:
     """{normalized metric name pattern: first 'file:line' seen}."""
-    roots = [os.path.join(REPO, "paddlebox_tpu"), os.path.join(REPO, "bench.py")]
-    found: dict = {}
-    for root in roots:
-        files = [root] if root.endswith(".py") else [
-            os.path.join(d, f)
-            for d, _, fs in os.walk(root)
-            for f in fs
-            if f.endswith(".py")
-        ]
-        for path in sorted(files):
-            with open(path) as fh:
-                text = fh.read()
-            for m in _CALL_RE.finditer(text):
-                is_f, name = m.group(1), m.group(3)
-                if is_f:
-                    name = re.sub(r"\{[^}]*\}", "*", name)
-                if not re.search(r"[a-zA-Z]", name):
-                    continue
-                line = text.count("\n", 0, m.start()) + 1
-                rel = os.path.relpath(path, REPO)
-                found.setdefault(name, f"{rel}:{line}")
-    return found
+    return rules_drift.metric_scan_sources()
 
 
 def catalog_patterns() -> list:
-    """Glob patterns from the ARCHITECTURE.md metric catalog (``<x>`` and
-    ``*`` both mean "any segment text")."""
-    pats: list = []
-    in_obs = False
-    with open(ARCH) as fh:
-        for line in fh:
-            if line.startswith("## "):
-                in_obs = line.strip().lower().startswith("## observability")
-                continue
-            if not in_obs:
-                continue
-            m = _TABLE_ROW_RE.match(line.strip())
-            if m:
-                pats.append(re.sub(r"<[^>]*>", "*", m.group(1)))
-    return pats
+    """Glob patterns from the ARCHITECTURE.md metric catalog (``<x>``
+    and ``*`` both mean "any segment text")."""
+    return rules_drift.metric_catalog_patterns()
 
 
 def main(argv=None) -> int:
@@ -99,13 +51,7 @@ def main(argv=None) -> int:
         print("ERROR: no metric catalog table found in ARCHITECTURE.md "
               "('## Observability' section)", file=sys.stderr)
         return 2
-    missing = []
-    for name, where in sorted(found.items()):
-        # placeholders in the code name become a concrete dummy segment so
-        # glob matching runs pattern-vs-string, not pattern-vs-pattern
-        concrete = name.replace("*", "ANY")
-        if not any(fnmatch.fnmatchcase(concrete, p) for p in pats):
-            missing.append((name, where))
+    missing = rules_drift.metric_missing()
     if missing:
         print("metric names missing from the ARCHITECTURE.md catalog "
               "(## Observability):", file=sys.stderr)

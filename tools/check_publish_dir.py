@@ -1,23 +1,11 @@
 #!/usr/bin/env python
 """Publish-root lint: donefile/manifest consistency for the delivery plane.
 
-A serving fleet trusts ``<root>/donefile.txt`` blindly (serving_sync's
-donefile-last discipline makes that safe — IF the root is actually
-consistent).  This tool audits one publish root the way the syncer's
-fallback ladder would experience it:
-
-  errors (exit 1):
-    * donefile line unparsable (other than a torn tail)
-    * sequence numbers not strictly increasing by 1 from the first entry
-    * an entry's dir missing from the root
-    * an entry's dir missing its integrity manifest, or failing it
-    * a delta whose base_tag names no earlier base entry, or whose
-      prev_tag does not match the preceding entry's tag (broken chain)
-  warnings (exit 0, or 1 with --strict):
-    * orphan base-*/delta-* dirs not referenced by the donefile (normal
-      transient state mid-upload: data lands before the donefile — but a
-      permanent orphan is a crashed publish worth garbage-collecting)
-    * a torn (unparsable) final donefile line
+Thin wrapper: the implementation moved into the pbox-lint framework
+(tools/pbox_analyze/publish.py, rule ``publish-dir`` — opt-in via
+``tools/pbox_analyze.py --publish-root``, since it audits runtime data
+rather than source).  This CLI and ``check_publish_root`` are preserved
+for tier-1 tests, deploy gates, and operator muscle memory.
 
 Usage:
     python tools/check_publish_dir.py ROOT [--strict] [--quiet]
@@ -29,80 +17,9 @@ import argparse
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-
-def check_publish_root(root: str) -> tuple:
-    """(errors, warnings) for one publish root — importable for tests and
-    for operators embedding the check in deploy gates."""
-    from paddlebox_tpu.checkpoint import CheckpointCorrupt, verify_checkpoint_dir
-    from paddlebox_tpu.serving_sync.registry import DONEFILE_NAME, parse_donefile
-
-    errors: list = []
-    warnings: list = []
-    donefile = os.path.join(root, DONEFILE_NAME)
-    if not os.path.isdir(root):
-        return [f"{root}: not a directory"], []
-    if not os.path.exists(donefile):
-        return [f"{root}: no {DONEFILE_NAME}"], []
-    with open(donefile, "rb") as fh:
-        data = fh.read()
-    try:
-        entries = parse_donefile(data, strict=True)
-    except ValueError as e:
-        # distinguish a torn tail (warning) from mid-file corruption
-        try:
-            entries = parse_donefile(data, strict=False)
-            warnings.append(f"{DONEFILE_NAME}: torn tail line dropped ({e})")
-        except ValueError:
-            return [f"{DONEFILE_NAME}: {e}"], []
-
-    prev_seq = None
-    prev_tag = None
-    base_tags: set = set()
-    for e in entries:
-        where = f"seq {e.seq} ({e.kind}-{e.tag})"
-        if prev_seq is not None and e.seq != prev_seq + 1:
-            errors.append(
-                f"{where}: out-of-order sequence number (previous was "
-                f"{prev_seq}; the donefile is append-only and must count "
-                "up by 1)"
-            )
-        if e.prev_tag != prev_tag:
-            errors.append(
-                f"{where}: prev_tag {e.prev_tag!r} does not match the "
-                f"preceding entry's tag {prev_tag!r} (broken chain)"
-            )
-        if e.kind == "base":
-            base_tags.add(e.tag)
-        elif e.base_tag not in base_tags:
-            errors.append(
-                f"{where}: anchors base {e.base_tag!r} which no earlier "
-                "donefile entry published"
-            )
-        dirname = os.path.join(root, e.dir)
-        if not os.path.isdir(dirname):
-            errors.append(f"{where}: dir {e.dir}/ missing from the root")
-        elif not os.path.exists(os.path.join(dirname, "manifest.json")):
-            errors.append(f"{where}: {e.dir}/ has no integrity manifest")
-        else:
-            try:
-                verify_checkpoint_dir(dirname)
-            except CheckpointCorrupt as exc:
-                errors.append(f"{where}: {exc}")
-        prev_seq, prev_tag = e.seq, e.tag
-
-    referenced = {e.dir for e in entries}
-    for name in sorted(os.listdir(root)):
-        if not os.path.isdir(os.path.join(root, name)):
-            continue
-        if name.startswith(("base-", "delta-")) and name not in referenced:
-            warnings.append(
-                f"orphan dir {name}/ (uploaded but never donefiled — "
-                "mid-publish, or a crashed publish to garbage-collect)"
-            )
-    return errors, warnings
+from pbox_analyze.publish import check_publish_root  # noqa: E402,F401
 
 
 def main(argv=None) -> int:

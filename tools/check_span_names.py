@@ -2,23 +2,9 @@
 """Span-name drift check: every span recorded in code must be in the
 ARCHITECTURE.md span catalog, and every cataloged span must still exist.
 
-The span namespace is the postmortem contract the same way metric names
-are the scrape contract: ``tools/pbox_doctor.py`` timelines, Perfetto
-traces and flight-recorder dumps are read by operators who grep the
-ARCHITECTURE.md catalog for what a name means — an undocumented span is
-unexplainable evidence, and a documented-but-removed span sends the
-reader hunting for records that no longer exist.  Cross-checked in both
-directions, exactly like the metric-name, fault-site and env-flag
-guards:
-
-  * **recorded** — literal first arguments of ``span(`` /
-    ``telemetry.span(`` / ``add_span(`` / ``instant(`` /
-    ``telemetry.instant(`` calls in the package + bench.py; f-string
-    placeholders (``f"sync.apply.{kind}"``) normalize to ``*`` so a
-    dynamic family stays one catalog row;
-  * **cataloged** — backticked names in the first column of the span
-    catalog table under ARCHITECTURE.md's "## Distributed tracing"
-    section (``<x>`` placeholders also normalize to ``*``).
+Thin wrapper: the implementation moved into the pbox-lint framework
+(tools/pbox_analyze/rules_drift.py, rule ``span-name-drift``).  This CLI
+and its module-level functions are preserved for tier-1 tests and docs.
 
 Usage:
     python tools/check_span_names.py            # check, exit 1 on drift
@@ -28,93 +14,29 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import fnmatch
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ARCH = os.path.join(REPO, "ARCHITECTURE.md")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# span-recording call with a (possibly f-) string literal first argument.
-# Matches bare span(/instant( and their telemetry./tracer-method forms;
-# definition sites (def span(...) take no string literal and don't match.
-_CALL_RE = re.compile(
-    r"""\b(?:span|add_span|instant)\(\s*
-        (f?)(["'])([^"']+)\2""",
-    re.VERBOSE | re.DOTALL,
-)
-_TABLE_ROW_RE = re.compile(r"^\|\s*`([^`]+)`")
+from pbox_analyze import rules_drift  # noqa: E402
 
 
 def scan_sources() -> dict:
     """{normalized span name: first 'file:line' seen}."""
-    roots = [os.path.join(REPO, "paddlebox_tpu"),
-             os.path.join(REPO, "bench.py")]
-    found: dict = {}
-    for root in roots:
-        files = [root] if root.endswith(".py") else [
-            os.path.join(d, f)
-            for d, _, fs in os.walk(root)
-            for f in fs
-            if f.endswith(".py")
-        ]
-        for path in sorted(files):
-            with open(path) as fh:
-                text = fh.read()
-            for m in _CALL_RE.finditer(text):
-                is_f, name = m.group(1), m.group(3)
-                if is_f:
-                    name = re.sub(r"\{[^}]*\}", "*", name)
-                # skip docstring/prose fragments that happen to match
-                # ("span(" examples) — a real span name is dotted-or-bare
-                # lowercase identifier text
-                if not re.fullmatch(r"[a-z0-9_.*]+", name):
-                    continue
-                if name == "name":
-                    continue  # the docs' ``span("name")`` placeholder
-                line = text.count("\n", 0, m.start()) + 1
-                rel = os.path.relpath(path, REPO)
-                found.setdefault(name, f"{rel}:{line}")
-    return found
+    return rules_drift.span_scan_sources()
 
 
 def catalog_patterns() -> dict:
     """{glob pattern: 'ARCHITECTURE.md:line'} from the span catalog table
     in the '## Distributed tracing' section."""
-    pats: dict = {}
-    in_sec = False
-    with open(ARCH) as fh:
-        for i, line in enumerate(fh, 1):
-            if line.startswith("## "):
-                in_sec = line.strip().lower().startswith(
-                    "## distributed tracing")
-                continue
-            if not in_sec:
-                continue
-            m = _TABLE_ROW_RE.match(line.strip())
-            if m:
-                pats[re.sub(r"<[^>]*>", "*", m.group(1))] = \
-                    f"ARCHITECTURE.md:{i}"
-    return pats
+    return rules_drift.span_catalog_patterns()
 
 
 def check() -> tuple:
-    found = scan_sources()
-    pats = catalog_patterns()
-    missing = []
-    for name, where in sorted(found.items()):
-        concrete = name.replace("*", "ANY")
-        if not any(fnmatch.fnmatchcase(concrete, p) for p in pats):
-            missing.append((name, where))
-    stale = []
-    for pat, where in sorted(pats.items()):
-        if not any(
-            fnmatch.fnmatchcase(name.replace("*", "ANY"), pat)
-            for name in found
-        ):
-            stale.append((pat, where))
-    return missing, stale, found, pats
+    """(missing, stale, found, pats): both drift directions plus the raw
+    scan results."""
+    return rules_drift.span_check()
 
 
 def main(argv=None) -> int:

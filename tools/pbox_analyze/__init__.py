@@ -1,0 +1,83 @@
+"""pbox-lint: concurrency- and JAX-aware static analysis for this repo.
+
+An AST-based framework (stdlib only — it must run on a bare checkout in
+under ten seconds) that machine-checks the invariants every review round
+kept re-fixing by hand: lock ordering and locks held across blocking
+calls, thread-shared state without a lock, silently swallowed
+exceptions, wall-clock deadlines, and host-side hazards inside traced
+JAX functions — plus the five pre-existing drift guards (metric names,
+fault sites, env flags, span names, publish roots) folded in as passes
+sharing one walker and one reporting pipeline.
+
+Layout:
+
+  core.py          Finding schema, SourceFile cache (AST + parents +
+                   ``# pbox-lint: ignore[rule]`` suppressions), Context,
+                   the per-class concurrency model shared by the lock
+                   and thread passes.
+  baseline.py      checked-in accepted-legacy findings: load, schema-
+                   validate, multiset-match, stale-entry errors, update.
+  catalog.py       shared ARCHITECTURE.md table scraping + doc token
+                   scan (the code the five check_*.py tools used to
+                   re-implement).
+  rules_locks.py   lock-order, lock-held-blocking
+  rules_threads.py thread-shared-state
+  rules_except.py  swallowed-exception
+  rules_clock.py   clock-misuse
+  rules_tracer.py  jax-tracer-safety
+  rules_drift.py   metric-name-drift, fault-site-drift, env-flag-drift,
+                   span-name-drift (legacy function APIs preserved for
+                   the tools/check_*.py thin wrappers)
+  publish.py       publish-dir (per-root, opt-in via --publish-root)
+  cli.py           ``python tools/pbox_analyze.py --all --json ...``
+
+Suppression grammar: ``# pbox-lint: ignore[rule1,rule2] reason`` on the
+offending line (or on a comment-only line directly above it).  The
+reason string is required by policy for anything committed — a bare
+ignore is reviewable noise.  Accepted legacy findings live in
+``tools/pbox_lint_baseline.json`` instead (see baseline.py).
+"""
+
+from __future__ import annotations
+
+from . import (  # noqa: F401
+    rules_clock,
+    rules_drift,
+    rules_except,
+    rules_locks,
+    rules_threads,
+    rules_tracer,
+)
+from .core import Context, Finding  # noqa: F401
+
+#: every AST pass, in reporting order.  Each module exposes
+#: ``RULES = {rule_id: one-line description}`` and ``run(ctx)``.
+PASS_MODULES = [
+    rules_locks,
+    rules_threads,
+    rules_except,
+    rules_clock,
+    rules_tracer,
+    rules_drift,
+]
+
+
+def all_rules() -> dict:
+    """{rule_id: description} over every registered pass."""
+    out: dict = {}
+    for mod in PASS_MODULES:
+        out.update(mod.RULES)
+    return out
+
+
+def run_passes(ctx: Context, rules=None) -> list:
+    """Run every pass (or only the given rule ids) and return raw
+    findings — before suppression and baseline filtering."""
+    findings: list = []
+    for mod in PASS_MODULES:
+        if rules is not None and not (set(mod.RULES) & set(rules)):
+            continue
+        findings.extend(mod.run(ctx))
+    if rules is not None:
+        findings = [f for f in findings if f.rule in rules]
+    return findings

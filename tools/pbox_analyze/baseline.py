@@ -1,0 +1,148 @@
+"""Accepted-legacy findings: the checked-in baseline.
+
+``tools/pbox_lint_baseline.json`` holds findings that predate a rule and
+were reviewed as acceptable-for-now — the escape hatch that lets a new
+pass land strict without a big-bang cleanup.  Policy (ARCHITECTURE.md
+"Static analysis"): new code never gets a baseline entry; anything
+intentional gets an inline ``# pbox-lint: ignore[rule] reason`` at the
+site instead, so the justification lives next to the code.
+
+Hygiene is enforced, not hoped for:
+
+  * the file is schema-validated (exact keys, typed values) and must be
+    sorted — a hand-edit that breaks either is an error, not a silent
+    acceptance;
+  * entries match findings by ``(rule, file, snippet)`` — the stripped
+    source line, not the line number, so ordinary drift above the site
+    doesn't invalidate entries;
+  * an entry whose snippet no longer produces that finding is a *stale
+    baseline error*: the defect was fixed (delete the entry) or the code
+    changed (re-triage).  Stale entries can't sit around masking a
+    future regression that happens to produce the same key.
+
+Matching is a multiset: two identical offending lines in one file need
+two entries, and fixing one of them strands one stale entry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .core import REPO, Finding
+
+BASELINE_PATH = os.path.join(REPO, "tools", "pbox_lint_baseline.json")
+
+_SCHEMA = {
+    "rule": str, "file": str, "snippet": str, "reason": str,
+}
+
+
+class BaselineError(Exception):
+    """The baseline file itself is invalid (schema, ordering, staleness)."""
+
+
+def _sort_key(entry: dict) -> tuple:
+    return (entry["rule"], entry["file"], entry["snippet"])
+
+
+def load(path: str = BASELINE_PATH) -> list:
+    """Schema-validated, order-checked baseline entries ([] if the file
+    does not exist yet)."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        try:
+            data = json.load(fh)
+        except ValueError as e:
+            raise BaselineError(f"{path}: not valid JSON: {e}") from e
+    if not isinstance(data, list):
+        raise BaselineError(f"{path}: top level must be a list")
+    for i, entry in enumerate(data):
+        if not isinstance(entry, dict):
+            raise BaselineError(f"{path}: entry {i} is not an object")
+        extra = set(entry) - set(_SCHEMA)
+        missing = set(_SCHEMA) - set(entry)
+        if extra or missing:
+            raise BaselineError(
+                f"{path}: entry {i} keys wrong "
+                f"(missing {sorted(missing)}, unexpected {sorted(extra)})"
+            )
+        for k, t in _SCHEMA.items():
+            if not isinstance(entry[k], t):
+                raise BaselineError(
+                    f"{path}: entry {i} field {k!r} must be {t.__name__}")
+        if not entry["reason"].strip():
+            raise BaselineError(
+                f"{path}: entry {i} ({entry['rule']} {entry['file']}) has "
+                "an empty reason — a baseline entry without a "
+                "justification is just a suppressed bug")
+    keys = [_sort_key(e) for e in data]
+    if keys != sorted(keys):
+        raise BaselineError(
+            f"{path}: entries not sorted by (rule, file, snippet) — run "
+            "tools/pbox_analyze.py --update-baseline or sort by hand")
+    return data
+
+
+def apply(findings: list, entries: list) -> tuple:
+    """(kept, baselined, stale_errors): split findings against the
+    baseline multiset and surface stale entries as findings themselves
+    (rule ``stale-baseline``) so they fail the run."""
+    pool: dict = {}
+    for i, e in enumerate(entries):
+        pool.setdefault(_sort_key(e), []).append(i)
+    kept: list = []
+    baselined: list = []
+    matched: set = set()
+    for f in findings:
+        slots = pool.get(f.key)
+        if slots:
+            matched.add(slots.pop(0))
+            baselined.append(f)
+        else:
+            kept.append(f)
+    stale = [
+        Finding(
+            file="tools/pbox_lint_baseline.json",
+            line=1,
+            rule="stale-baseline",
+            message=(
+                f"baseline entry #{i} ({e['rule']} at {e['file']}: "
+                f"{e['snippet']!r}) matches no current finding — the "
+                "defect was fixed or the line changed; delete or "
+                "re-triage the entry"
+            ),
+            snippet=e["snippet"],
+        )
+        for i, e in enumerate(entries)
+        if i not in matched
+    ]
+    return kept, baselined, stale
+
+
+def update(findings: list, path: str = BASELINE_PATH,
+           reason: str = "accepted legacy finding") -> list:
+    """Write the given findings out as the new baseline, preserving the
+    reasons of entries that still match.  Returns the entries written."""
+    old = {}
+    if os.path.exists(path):
+        try:
+            for e in load(path):
+                old.setdefault(_sort_key(e), []).append(e["reason"])
+        except BaselineError:
+            pass  # regenerating over a broken file is the repair path
+    entries = []
+    for f in findings:
+        reasons = old.get(f.key)
+        entries.append({
+            "rule": f.rule,
+            "file": f.file,
+            "snippet": f.snippet,
+            "reason": reasons.pop(0) if reasons else reason,
+        })
+    entries.sort(key=_sort_key)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(entries, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return entries

@@ -1,0 +1,97 @@
+"""clock-misuse: wall-clock time in deadline/duration arithmetic.
+
+``time.time()`` jumps under NTP slew and VM suspend; a deadline computed
+from it can fire immediately or never (the ``launch.py`` shutdown
+deadline this PR fixes).  Deadlines, timeouts and elapsed-time math must
+use ``time.monotonic()``.
+
+What stays legal — and is deliberately NOT flagged:
+
+  * bare timestamps (``published_at = time.time()``, trace anchors,
+    event times) — monotonic clocks are meaningless across processes,
+    so the delivery plane's freshness math *must* be wall-clock;
+  * differences of two wall-clock timestamps taken on different hosts
+    (``time.time() - rec.event_ts``) — same reason.
+
+The rule therefore only fires when ``time.time()`` is combined with
+something deadline-shaped: a numeric literal (``time.time() + 10.0``),
+a name whose text says timeout/deadline/interval/…, or a comparison
+against such a name.  Cross-host freshness subtractions fall outside
+all three shapes.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, dotted
+
+RULES = {
+    "clock-misuse": (
+        "time.time() in deadline/timeout arithmetic — use "
+        "time.monotonic() (wall clock jumps under NTP/suspend)"
+    ),
+}
+
+_DEADLINE_TOKENS = (
+    "timeout", "deadline", "budget", "grace", "ttl", "expiry", "expire",
+    "hang", "interval", "elapsed", "duration", "remaining",
+)
+
+
+def _deadlineish(text: str) -> bool:
+    low = text.lower()
+    return any(tok in low for tok in _DEADLINE_TOKENS)
+
+
+def _expr_text(node) -> str:
+    """Identifier-ish text of a Name/Attribute/Subscript operand."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return _expr_text(node.value) + "." + node.attr
+    if isinstance(node, ast.Subscript):
+        key = node.slice
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            return _expr_text(node.value) + "." + key.value
+        return _expr_text(node.value)
+    return ""
+
+
+def _is_wallclock_call(node) -> bool:
+    return isinstance(node, ast.Call) and dotted(node.func) == "time.time"
+
+
+def run(ctx: Context) -> list:
+    findings: list = []
+    for sf in ctx.files:
+        for node in ast.walk(sf.tree):
+            hit = None
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, (ast.Add, ast.Sub)):
+                for side, other in ((node.left, node.right),
+                                    (node.right, node.left)):
+                    if not _is_wallclock_call(side):
+                        continue
+                    if isinstance(other, ast.Constant) and \
+                            isinstance(other.value, (int, float)):
+                        hit = (side, f"time.time() {'+' if isinstance(node.op, ast.Add) else '-'} "
+                                     f"{other.value!r} builds a deadline/duration")
+                    elif _deadlineish(_expr_text(other)):
+                        hit = (side, f"time.time() combined with "
+                                     f"{_expr_text(other)!r} (deadline math)")
+            elif isinstance(node, ast.Compare):
+                operands = [node.left] + list(node.comparators)
+                calls = [x for x in operands if _is_wallclock_call(x)]
+                others = [x for x in operands if not _is_wallclock_call(x)]
+                if calls and any(_deadlineish(_expr_text(x)) for x in others):
+                    hit = (calls[0], "time.time() compared against a "
+                                     "deadline value")
+            if hit is not None:
+                call, why = hit
+                findings.append(sf.finding(
+                    "clock-misuse", call,
+                    f"{why} — use time.monotonic(); wall clock jumps "
+                    "under NTP slew and suspend",
+                ))
+    return findings

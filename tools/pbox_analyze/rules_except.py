@@ -1,0 +1,104 @@
+"""swallowed-exception: broad handlers that make failure invisible.
+
+The silent-agent-death family (PR 7's background prober thread died
+without a trace; PR 8's drain loop ate a typo for two review rounds): a
+``except Exception:`` / ``except BaseException:`` / bare ``except:``
+whose body neither re-raises, logs, emits a telemetry counter,
+flight-dumps, exits, nor *stores the exception object* for a later
+re-raise.  Any of those is a deliberate disposition; none of them means
+the failure simply evaporates.
+
+Narrow handlers (``except ValueError:``) are not this rule's business —
+catching a specific exception silently is usually a considered default;
+catching *everything* silently is how threads die quietly.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, dotted
+
+RULES = {
+    "swallowed-exception": (
+        "broad except handler that neither re-raises, logs, counts, "
+        "flight-dumps, exits, nor stores the exception"
+    ),
+}
+
+_BROAD = {"Exception", "BaseException"}
+
+#: call-name evidence that the handler surfaced the failure somewhere.
+#: Matched against the dotted call name's segments (so ``logger.warning``,
+#: ``self.log.error``, ``stats.add``, ``flight.dump`` all qualify).
+_SURFACING_SEGMENTS = {
+    # logging methods
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log",
+    # telemetry planes
+    "stats", "counter", "gauge", "histogram", "instant", "add_span",
+    "flight", "dump_now",
+    # traceback / process disposition
+    "print_exc", "print_exception", "format_exc", "excepthook",
+    "_exit", "exit", "abort", "kill",
+}
+_SURFACING_NAMES = {"print"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for x in types:
+        name = dotted(x)
+        if name.rsplit(".", 1)[-1] in _BROAD:
+            return True
+    return False
+
+
+def _stores_exception(handler: ast.ExceptHandler) -> bool:
+    """``except Exception as e: self._err = e`` (or errs.append(e)) keeps
+    the failure for a later re-raise/report — not swallowed."""
+    name = handler.name
+    if not name:
+        return False
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Name) and node.id == name and \
+                isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+def _is_handled(handler: ast.ExceptHandler) -> bool:
+    body = ast.Module(body=handler.body, type_ignores=[])
+    for node in ast.walk(body):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if not name and isinstance(node.func, ast.Attribute):
+                name = node.func.attr  # method on a computed object
+            segments = set(name.split(".")) if name else set()
+            if segments & _SURFACING_SEGMENTS or name in _SURFACING_NAMES:
+                return True
+    return _stores_exception(handler)
+
+
+def run(ctx: Context) -> list:
+    findings: list = []
+    for sf in ctx.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _is_handled(node):
+                continue
+            what = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}"
+            findings.append(sf.finding(
+                "swallowed-exception", node,
+                f"{what} swallows the failure silently — re-raise, log, "
+                "bump a counter, or flight-dump (the silent-agent-death "
+                "family)",
+            ))
+    return findings

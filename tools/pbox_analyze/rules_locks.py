@@ -1,0 +1,271 @@
+"""lock-order and lock-held-blocking: the AdmissionGate-starvation and
+SocketSource-accept-race family.
+
+Two rules over the per-class concurrency model (core.ClassModel):
+
+``lock-order``
+    Build the lock-acquisition graph per class (module scope is a
+    pseudo-class): an edge A→B every time lock B is acquired — by a
+    ``with`` block, an explicit ``.acquire()``, or one level of
+    ``self.m()`` interprocedural closure — while A is held.  Any edge
+    that closes a cycle is flagged at its acquisition site.  Two threads
+    taking the same pair of locks in opposite orders is the textbook
+    deadlock PR 7's review caught by hand.
+
+``lock-held-blocking``
+    While any lock is held, flag calls that can block indefinitely:
+    socket send/recv/accept/connect, ``subprocess`` spawns and
+    ``communicate``, ``open()``, ``time.sleep``, thread joins,
+    ``Event``/``Condition`` waits on anything *other than the innermost
+    held condition* (waiting on your own innermost condition releases
+    it — that is the one legal blocking wait), and JAX host transfers
+    (``device_get`` / ``block_until_ready``).  A lock held across any
+    of these starves every other thread that needs it — the
+    AdmissionGate probe-starvation bug's exact shape.
+
+Scope limits (kept deliberately, for signal over noise): held-lock
+tracking follows ``with`` nesting inside one method plus a single level
+of ``self.m()`` calls; nested ``def``/``lambda`` bodies run later on
+some other stack and are scanned with an empty held set.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import ClassModel, Context, class_models, dotted
+
+RULES = {
+    "lock-order": (
+        "lock acquisition cycle within a class — two orders of the same "
+        "locks can deadlock"
+    ),
+    "lock-held-blocking": (
+        "blocking call (socket/subprocess/file/sleep/join/foreign wait/"
+        "jax transfer) while holding a lock"
+    ),
+}
+
+_SOCKETISH = ("sock", "conn", "client", "peer")
+_SOCKET_OPS = {"recv", "recv_into", "accept", "connect", "sendall", "send",
+               "makefile"}
+_SUBPROCESS_OPS = {"run", "Popen", "check_call", "check_output", "call"}
+
+
+def _base_text(func) -> str:
+    """Lowercased dotted text of a call's receiver ('self.sock' for
+    self.sock.recv)."""
+    if isinstance(func, ast.Attribute):
+        return dotted(func.value).lower()
+    return ""
+
+
+def _blocking_reason(call: ast.Call, model: ClassModel, held: tuple):
+    """Why this call blocks while a lock is held, or None."""
+    name = dotted(call.func)
+    last = name.rsplit(".", 1)[-1] if name else ""
+    if not last and isinstance(call.func, ast.Attribute):
+        last = call.func.attr
+    base = _base_text(call.func)
+
+    if name == "time.sleep":
+        return "time.sleep() holds the lock for the whole nap"
+    if name == "open":
+        return "file I/O (open) under the lock"
+    if name.startswith("subprocess.") and last in _SUBPROCESS_OPS:
+        return "subprocess spawn under the lock"
+    if last == "communicate":
+        return "subprocess communicate() blocks until the child exits"
+    if last in {"wait", "wait_for"} and isinstance(call.func, ast.Attribute):
+        lid = model.is_lock_name(call.func.value)
+        if lid is not None:
+            if held and lid == held[-1]:
+                return None  # waiting on the innermost condition is THE idiom
+            return (
+                f"wait on condition {lid!r} while the innermost held lock "
+                f"is {held[-1]!r} — wait() only releases its own lock"
+            )
+        # Event.wait / Popen.wait / future .result-ish waits
+        return f"blocking wait on {dotted(call.func) or last!r} under the lock"
+    if last == "join" and isinstance(call.func, ast.Attribute):
+        attr_base = call.func.value
+        is_thread = (
+            isinstance(attr_base, ast.Attribute)
+            and isinstance(attr_base.value, ast.Name)
+            and attr_base.value.id == "self"
+            and attr_base.attr in model.thread_attrs
+        ) or "thread" in base or "proc" in base or "worker" in base
+        if is_thread:
+            return "thread join under the lock (deadlocks if the joined " \
+                   "thread needs it)"
+        return None  # os.path.join and friends
+    if last in _SOCKET_OPS and any(s in base for s in _SOCKETISH):
+        return f"socket {last}() under the lock"
+    if last in {"device_get", "block_until_ready"}:
+        return "JAX host transfer under the lock (device sync latency)"
+    return None
+
+
+def _locks_acquired(model: ClassModel, fn) -> set:
+    """Lock ids a method acquires anywhere at its own level (not inside
+    nested defs) — the one-level interprocedural closure."""
+    out: set = set()
+
+    def walk(body):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    lid = model.is_lock_name(item.context_expr)
+                    if lid:
+                        out.add(lid)
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "acquire":
+                    lid = model.is_lock_name(node.func.value)
+                    if lid:
+                        out.add(lid)
+            for field in ("body", "orelse", "finalbody"):
+                walk(getattr(stmt, field, []) or [])
+            for h in getattr(stmt, "handlers", []) or []:
+                walk(h.body)
+
+    walk(fn.body)
+    return out
+
+
+class _Scan:
+    """One class's scan state: acquisition edges and blocking sites."""
+
+    def __init__(self, sf, model):
+        self.sf = sf
+        self.model = model
+        self.edges: dict = {}       # (A, B) -> first acquisition node
+        self.blocking: list = []    # (held, node, reason)
+        self.self_calls: list = []  # (held, method name, node)
+
+    # -- expression scanning (one statement, nested stmts excluded) ----- #
+    def scan_expr(self, node, held):
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.Lambda, ast.FunctionDef,
+                              ast.AsyncFunctionDef)) or n is None:
+                continue
+            if isinstance(n, ast.Call):
+                if held:
+                    reason = _blocking_reason(n, self.model, held)
+                    if reason:
+                        self.blocking.append((held, n, reason))
+                    if (
+                        isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "self"
+                        and n.func.attr in self.model.methods
+                    ):
+                        self.self_calls.append((held, n.func.attr, n))
+                if isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "acquire":
+                    lid = self.model.is_lock_name(n.func.value)
+                    if lid:
+                        for h in held:
+                            self.edges.setdefault((h, lid), n)
+            stack.extend(
+                c for c in ast.iter_child_nodes(n)
+                if not isinstance(c, ast.stmt)
+            )
+
+    def scan_stmt_exprs(self, stmt, held):
+        for _, value in ast.iter_fields(stmt):
+            if isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.AST) and not isinstance(
+                            v, (ast.stmt, ast.ExceptHandler)):
+                        self.scan_expr(v, held)
+            elif isinstance(value, ast.AST) and not isinstance(
+                    value, (ast.stmt, ast.ExceptHandler)):
+                self.scan_expr(value, held)
+
+    # -- statement walking with the held-lock stack --------------------- #
+    def walk_body(self, body, held):
+        for stmt in body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in stmt.items:
+                    lid = self.model.is_lock_name(item.context_expr)
+                    if lid is not None:
+                        for h in held:
+                            self.edges.setdefault((h, lid), item.context_expr)
+                        acquired.append(lid)
+                    else:
+                        self.scan_expr(item.context_expr, held)
+                self.walk_body(stmt.body, held + tuple(acquired))
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                # runs later on another stack: no locks held at entry
+                self.walk_body(stmt.body, ())
+            else:
+                self.scan_stmt_exprs(stmt, held)
+                for field in ("body", "orelse", "finalbody"):
+                    self.walk_body(getattr(stmt, field, []) or [], held)
+                for h in getattr(stmt, "handlers", []) or []:
+                    self.walk_body(h.body, held)
+
+
+def run(ctx: Context) -> list:
+    findings: list = []
+    for sf in ctx.files:
+        for model in class_models(sf):
+            if not model.lock_attrs:
+                continue
+            scan = _Scan(sf, model)
+            for fn in model.methods.values():
+                scan.walk_body(fn.body, ())
+            # one-level interprocedural closure: held + self.m() where m
+            # acquires more locks
+            acquired_by = {
+                name: _locks_acquired(model, fn)
+                for name, fn in model.methods.items()
+            }
+            for held, mname, node in scan.self_calls:
+                for lid in acquired_by.get(mname, ()):
+                    for h in held:
+                        if h != lid:
+                            scan.edges.setdefault((h, lid), node)
+            # blocking findings
+            for held, node, reason in scan.blocking:
+                findings.append(sf.finding(
+                    "lock-held-blocking", node,
+                    f"[{model.name}] holding {', '.join(repr(h) for h in held)}: "
+                    f"{reason}",
+                ))
+            # cycle detection over the acquisition graph
+            adj: dict = {}
+            for (a, b) in scan.edges:
+                adj.setdefault(a, set()).add(b)
+
+            def reachable(src, dst):
+                seen, stack = set(), [src]
+                while stack:
+                    n = stack.pop()
+                    if n == dst:
+                        return True
+                    if n in seen:
+                        continue
+                    seen.add(n)
+                    stack.extend(adj.get(n, ()))
+                return False
+
+            for (a, b), node in sorted(
+                    scan.edges.items(), key=lambda kv: kv[1].lineno):
+                if a != b and reachable(b, a):
+                    findings.append(sf.finding(
+                        "lock-order", node,
+                        f"[{model.name}] acquires {b!r} while holding "
+                        f"{a!r}, but the reverse order also exists — "
+                        "acquisition cycle; pick one canonical order",
+                    ))
+    return findings

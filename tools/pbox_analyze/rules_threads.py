@@ -1,0 +1,124 @@
+"""thread-shared-state: attributes crossing a thread boundary bare.
+
+The drain/offset family (PR 8's review found offsets mutated by the
+tail-poll thread and read by the consumer with no fence): an attribute
+written inside a class's thread closure — any method reachable from a
+``Thread(target=self.m)`` target via ``self`` calls — and also touched
+from the non-thread side, where *neither* site sits under a ``with
+self.<lock>`` block.
+
+Exemptions that keep this rule honest rather than noisy:
+
+  * attributes bound to synchronization/thread-safe constructors
+    (``Lock``, ``Event``, ``Queue``, ``deque``, ``Thread``, …) — they
+    ARE the fence;
+  * attributes the thread side only *reads* (config handed in before
+    ``start()``); the rule triggers on thread-side *writes*;
+  * ``__init__`` writes (the thread cannot exist yet).
+
+A flagged attribute wants a lock, an ``Event``, a queue hand-off — or,
+where a torn read is genuinely tolerable (a stats counter), an inline
+``# pbox-lint: ignore[thread-shared-state] reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import ClassModel, Context, class_models
+
+RULES = {
+    "thread-shared-state": (
+        "attribute written on the thread path and touched on the "
+        "non-thread path with no lock at either site"
+    ),
+}
+
+
+def _self_attr_sites(model: ClassModel, fn):
+    """[(attr, node, is_write, locked)] for every self.X touch in fn,
+    with ``locked`` = inside any ``with self.<lock>`` block."""
+    sites: list = []
+
+    def walk_expr(node, locked):
+        for n in ast.walk(node):
+            if (
+                isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"
+            ):
+                is_write = isinstance(n.ctx, (ast.Store, ast.Del))
+                sites.append((n.attr, n, is_write, locked))
+
+    def walk_body(body, locked):
+        for stmt in body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = locked
+                for item in stmt.items:
+                    if model.is_lock_name(item.context_expr):
+                        inner = True
+                    else:
+                        walk_expr(item.context_expr, locked)
+                walk_body(stmt.body, inner)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                walk_body(stmt.body, locked)
+            else:
+                for _, value in ast.iter_fields(stmt):
+                    if isinstance(value, list):
+                        for v in value:
+                            if isinstance(v, ast.AST) and not isinstance(
+                                    v, (ast.stmt, ast.ExceptHandler)):
+                                walk_expr(v, locked)
+                    elif isinstance(value, ast.AST) and not isinstance(
+                            value, (ast.stmt, ast.ExceptHandler)):
+                        walk_expr(value, locked)
+                for field in ("body", "orelse", "finalbody"):
+                    walk_body(getattr(stmt, field, []) or [], locked)
+                for h in getattr(stmt, "handlers", []) or []:
+                    walk_body(h.body, locked)
+
+    walk_body(fn.body, False)
+    return sites
+
+
+def run(ctx: Context) -> list:
+    findings: list = []
+    for sf in ctx.files:
+        for model in class_models(sf):
+            if model.is_module or not model.thread_targets:
+                continue
+            closure = model.reachable_from(model.thread_targets)
+            closure.discard("__init__")
+            if not closure:
+                continue
+            # attr -> [(method, node, is_write, locked, on_thread)]
+            touches: dict = {}
+            for name, fn in model.methods.items():
+                if name == "__init__":
+                    continue
+                on_thread = name in closure
+                for attr, node, is_write, locked in \
+                        _self_attr_sites(model, fn):
+                    if attr in model.sync_attrs or attr in model.methods:
+                        continue
+                    touches.setdefault(attr, []).append(
+                        (name, node, is_write, locked, on_thread))
+            for attr, sites in sorted(touches.items()):
+                thread_writes = [
+                    s for s in sites if s[4] and s[2] and not s[3]]
+                other_bare = [
+                    s for s in sites if not s[4] and not s[3]]
+                if not thread_writes or not other_bare:
+                    continue
+                w = thread_writes[0]
+                o = other_bare[0]
+                findings.append(sf.finding(
+                    "thread-shared-state", w[1],
+                    f"[{model.name}] self.{attr} written in thread-path "
+                    f"method {w[0]}() with no lock, and touched bare on "
+                    f"the non-thread path ({o[0]}(), line {o[1].lineno}) "
+                    "— add a lock/Event/queue hand-off or justify with "
+                    "an inline ignore",
+                ))
+    return findings

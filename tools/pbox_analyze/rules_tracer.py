@@ -1,0 +1,219 @@
+"""jax-tracer-safety: host-side hazards inside traced functions.
+
+A function handed to ``jit`` / ``lax.scan`` / ``shard_map`` / ``vmap``
+runs ONCE at trace time; its Python-level side effects do not re-run per
+step, and branching on a traced value raises
+``TracerBoolConversionError`` at trace time — or worse, silently bakes
+in the tracing-time branch when the value happens to be concrete.
+
+Three hazard shapes inside a traced function body:
+
+``host side effect``
+    ``print`` / ``open`` / ``time.*`` / ``logging`` / ``stats.*`` /
+    ``random.*`` calls — they fire once at trace, then never again.
+    The sanctioned escapes are allowed: anything under ``jax.debug``,
+    and the callback family (``io_callback`` / ``pure_callback`` /
+    ``host_callback``).
+
+``np-on-tracer``
+    ``np.*`` / ``numpy.*`` calls whose argument derives from a traced
+    parameter — numpy eagerly materializes, which either crashes on a
+    tracer or silently forces a host transfer.  ``np.*`` on constants
+    (dtypes, static shapes) stays legal.
+
+``tracer branching``
+    ``if`` / ``while`` tests referencing a traced parameter.  Static
+    idioms are recognized and allowed: ``x is None`` arg-defaulting,
+    ``isinstance``/``len``/``getattr``/``hasattr``, and attribute
+    chains through ``.shape`` / ``.ndim`` / ``.dtype`` / ``.size``
+    (static under tracing).
+
+Taint is syntactic and local: parameters plus names assigned from
+tainted expressions within the same function.  Decorator detection
+covers ``@jax.jit``/``@jit``/``@partial(jax.jit, ...)`` and call-site
+usage ``jit(f)`` / ``lax.scan(f, ...)`` / ``shard_map(f, ...)`` where
+``f`` is a function defined in the same file.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, dotted
+
+RULES = {
+    "jax-tracer-safety": (
+        "host side effect, np.* on a traced value, or Python branching "
+        "on a tracer inside a jitted/scanned/shard_mapped function"
+    ),
+}
+
+_TRACE_ENTRY_LASTS = {
+    "jit", "pjit", "pmap", "vmap", "scan", "cond", "while_loop",
+    "fori_loop", "shard_map", "grad", "value_and_grad", "checkpoint",
+    "remat", "custom_vjp", "custom_jvp",
+}
+_HOST_PREFIXES = ("time.", "os.", "logging.", "random.", "stats.")
+_HOST_NAMES = {"print", "open", "input"}
+_ALLOWED_SEGMENTS = {"debug", "io_callback", "pure_callback",
+                     "host_callback", "call", "callback"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "at"}
+_STATIC_CALLS = {"isinstance", "len", "getattr", "hasattr", "type",
+                 "range", "zip", "enumerate"}
+
+
+def _entry_last(name: str) -> bool:
+    return bool(name) and name.rsplit(".", 1)[-1] in _TRACE_ENTRY_LASTS
+
+
+def _is_trace_decorator(dec) -> bool:
+    name = dotted(dec)
+    if _entry_last(name):
+        return True
+    if isinstance(dec, ast.Call):
+        dname = dotted(dec.func)
+        if _entry_last(dname):
+            return True
+        if dname.rsplit(".", 1)[-1] == "partial" and dec.args:
+            return _entry_last(dotted(dec.args[0]))
+    return False
+
+
+def _traced_functions(sf) -> list:
+    """FunctionDef/Lambda nodes traced by decorator or by being passed
+    to a trace entry point somewhere in the file."""
+    by_name: dict = {}
+    traced: list = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            if any(_is_trace_decorator(d) for d in node.decorator_list):
+                traced.append(node)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _entry_last(dotted(node.func)):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords
+                                      if kw.arg in ("f", "fun", "body_fun",
+                                                    "cond_fun", "target")]:
+            if isinstance(arg, ast.Name) and arg.id in by_name:
+                fn = by_name[arg.id]
+                if fn not in traced:
+                    traced.append(fn)
+            elif isinstance(arg, ast.Lambda):
+                traced.append(arg)
+    return traced
+
+
+def _taint(sf, fn) -> set:
+    """Parameter names plus same-function names assigned from them.
+    Assignments that only touch tainted names through static accesses
+    (``k = x.shape[0]``, ``n = len(x)``) do NOT propagate — those are
+    concrete Python values under tracing."""
+    args = fn.args
+    names = {
+        a.arg
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else []))
+    }
+    names.discard("self")
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(ast.Module(body=[s for s in body
+                                              if isinstance(s, ast.stmt)],
+                                        type_ignores=[])):
+            if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                continue
+            tainted_uses = [
+                n for n in ast.walk(node.value)
+                if isinstance(n, ast.Name) and n.id in names
+                and not _allowed_name_use(sf, n)
+            ]
+            if not tainted_uses:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name) and n.id not in names:
+                        names.add(n.id)
+                        changed = True
+    return names
+
+
+def _allowed_name_use(sf, name_node) -> bool:
+    """Tainted name used in a statically-evaluable way?"""
+    node = name_node
+    while True:
+        parent = sf.parent(node)
+        if parent is None:
+            return False
+        if isinstance(parent, ast.Attribute) and \
+                parent.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(parent, ast.Call) and \
+                dotted(parent.func) in _STATIC_CALLS:
+            return True
+        if isinstance(parent, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
+            return True
+        if isinstance(parent, (ast.expr,)):
+            node = parent
+            continue
+        return False
+
+
+def run(ctx: Context) -> list:
+    findings: list = []
+    for sf in ctx.files:
+        for fn in _traced_functions(sf):
+            tainted = _taint(sf, fn)
+            label = getattr(fn, "name", "<lambda>")
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            wrap = ast.Module(body=[s for s in body
+                                    if isinstance(s, ast.stmt)],
+                              type_ignores=[])
+            for node in ast.walk(wrap) if wrap.body else ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    segs = set(name.split(".")) if name else set()
+                    if segs & _ALLOWED_SEGMENTS or "jax" in segs:
+                        continue
+                    if name in _HOST_NAMES or \
+                            any(name.startswith(p) for p in _HOST_PREFIXES):
+                        findings.append(sf.finding(
+                            "jax-tracer-safety", node,
+                            f"host side effect {name}() inside traced "
+                            f"function {label}() — runs once at trace "
+                            "time, never per step (use jax.debug.* or a "
+                            "callback)",
+                        ))
+                    elif name.split(".")[0] in ("np", "numpy") and any(
+                        isinstance(n, ast.Name) and n.id in tainted
+                        for a in node.args + [kw.value
+                                              for kw in node.keywords]
+                        for n in ast.walk(a)
+                    ):
+                        findings.append(sf.finding(
+                            "jax-tracer-safety", node,
+                            f"{name}() on a traced value inside "
+                            f"{label}() — numpy materializes eagerly; "
+                            "use jnp or hoist to host code",
+                        ))
+                elif isinstance(node, (ast.If, ast.While)):
+                    for n in ast.walk(node.test):
+                        if isinstance(n, ast.Name) and n.id in tainted \
+                                and not _allowed_name_use(sf, n):
+                            findings.append(sf.finding(
+                                "jax-tracer-safety", node,
+                                f"Python branch on traced value "
+                                f"{n.id!r} inside {label}() — use "
+                                "lax.cond/lax.select or mark the arg "
+                                "static",
+                            ))
+                            break
+    return findings
