@@ -65,6 +65,9 @@ def get_lib():
         if _tried:
             return _lib
         _tried = True
+        # pbox-lint: ignore[lock-held-blocking] build-once: holding the
+        # lock through the compile is the point — every caller must wait
+        # for the single build instead of racing their own
         so = _build()
         if so is None:
             return None
@@ -247,6 +250,8 @@ def get_plan_lib():
         if _plan_tried:
             return _plan_lib
         _plan_tried = True
+        # pbox-lint: ignore[lock-held-blocking] build-once under the lock
+        # (see get_lib): waiters NEED the build to finish
         so = _build_plan()
         if so is None:
             return None

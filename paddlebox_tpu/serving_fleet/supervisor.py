@@ -192,7 +192,13 @@ class ReplicaSupervisor:
                     self._dump_crash(r)
                 if r.next_restart_at and now >= r.next_restart_at:
                     try:
+                        # pbox-lint: ignore[lock-held-blocking] a hang:
+                        # spec wedging the respawn under the lock is the
+                        # chaos the watchdog must catch — deliberate
                         faults.inject("fleet.restart")
+                        # pbox-lint: ignore[lock-held-blocking] respawn is
+                        # serialized against stop()/kill_replica by design;
+                        # spawn cost is bounded (log open + fork)
                         self._spawn(r)
                         r.restarts += 1
                         _RESTARTS.inc()

@@ -328,6 +328,16 @@ class BucketStore:
         ))
 
     # -- bulk / serialization ------------------------------------------------ #
+    def close(self) -> None:
+        """Retire the bucket-parallelism pool (its worker threads
+        otherwise outlive the store across table respawns).  Safe to
+        call at any quiesced point: ``_run_buckets`` lazily recreates
+        the pool if the store is used again afterwards."""
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
     def clear(self) -> None:
         for b in range(self.n_buckets):
             if self._spilled[b]:

@@ -387,6 +387,19 @@ class SparseTable:
         while self._merge_futures:
             self._merge_futures.pop(0).result()
 
+    def close(self) -> None:
+        """Quiesce and retire background resources: barrier the
+        write-back pipeline (flush), drop any staged next pass, and shut
+        the host store's bucket pool down so its worker threads don't
+        outlive the table across respawns.  The table remains usable —
+        a later lookup simply respawns the pool — so callers may still
+        checkpoint/publish after close()."""
+        if self._in_pass:
+            raise RuntimeError("end_pass (or abort_pass) before close")
+        self._discard_stage()
+        self.flush()
+        self._store.close()
+
     def _discard_stage(self) -> None:
         """Drop any staged next-pass buffer (waiting for the job so no
         staging read can race a store mutation) and trim the patch log."""

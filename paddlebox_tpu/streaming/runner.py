@@ -259,10 +259,19 @@ class StreamingTrainer:
                 self._confirm_stop.set()
                 self._confirm_thread.join(timeout=5.0)
                 self._confirm_thread = None
+            # two-phase source shutdown made explicit: request the
+            # graceful drain first (idempotent — the normal path already
+            # stopped), then escalate through close().  An exception
+            # path that skipped stop() must not jump straight to the
+            # hard-kill half of the contract.
+            self.scheduler.source.stop()
             self.scheduler.close()
             self.scheduler.source.close()
             if wd is not None:
                 wd.close()
+            # retire the table's background machinery (write-back pool);
+            # the table stays checkpointable — a later use respawns it
+            self.table.close()
         return self.summary()
 
     def _next_window(self, wd):
@@ -293,6 +302,9 @@ class StreamingTrainer:
         ds = sched.dataset(window)
         census_wait = self.census_wait_s
         for attempt in (0, 1):
+            # pbox-lint: ignore[protocol-sparse-pass] the retrain lap only
+            # re-enters after PassRolledBack, whose rollback machinery
+            # already abort_pass()ed and restored the table
             self.table.begin_pass(window.census)
             try:
                 # the window's lineage ID ("w<idx>") names this span AND

@@ -89,6 +89,9 @@ class EventLog:
             self._f.write(line + "\n")
             self._f.flush()
             if self.max_bytes > 0 and self._f.tell() >= self.max_bytes:
+                # pbox-lint: ignore[lock-held-blocking] rotation must be
+                # atomic with the write stream: a writer admitted mid-
+                # rotate would tear a line across generations
                 self._rotate_locked()
 
     def _rotate_locked(self) -> None:
@@ -151,6 +154,9 @@ def ensure_event_log(path: Optional[str] = None) -> Optional[EventLog]:
             path = flags.events_path
         if not path:
             return None
+        # pbox-lint: ignore[lock-held-blocking] ensure-singleton: the log
+        # (and its open()) must be constructed under the module lock or
+        # two racing callers each open the file
         _event_log = EventLog(path)
         return _event_log
 

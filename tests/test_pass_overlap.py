@@ -315,6 +315,38 @@ class TestParallelStore:
         kp, vvp = pooled.materialize()
         assert np.array_equal(ks, kp) and np.array_equal(vvs, vvp)
 
+    def test_store_close_retires_pool_and_stays_usable(self):
+        # the pbox-lint executor-shutdown finding: the bucket pool's
+        # workers must not outlive the store — close() retires them, and
+        # a later use lazily respawns the pool (close is a quiesce, not
+        # a poison pill)
+        from paddlebox_tpu.sparse.store import BucketStore
+
+        store = BucketStore(n_cols=3, n_buckets=16, n_threads=4)
+        keys = np.arange(0, 2000, dtype=np.uint64)
+        store.update(keys, np.ones((2000, 3), np.float32))
+        assert store._pool is not None  # pool was actually exercised
+        store.close()
+        assert store._pool is None
+        store.close()  # idempotent
+        v, f = store.lookup(keys)  # respawns the pool transparently
+        assert f.all() and (v == 1.0).all()
+        assert store._pool is not None
+        store.close()
+
+    def test_table_close_flushes_and_retires(self):
+        t = SparseTable(_tconf(True), seed=0)
+        keys = np.arange(1, 120, dtype=np.uint64)
+        t.begin_pass(keys)
+        with pytest.raises(RuntimeError):
+            t.close()  # close inside a pass is a contract violation
+        t.end_pass()
+        t.close()
+        assert t._store._pool is None
+        # still checkpointable after close: the pool respawns on demand
+        state = t.state_dict()
+        assert state["keys"].shape[0] == keys.shape[0]
+
     def test_concurrent_lookup_update_disjoint_keys(self):
         # merge thread (update) and staging thread (lookup) on disjoint
         # key ranges must not corrupt each other under the pool

@@ -573,3 +573,672 @@ def test_cli_changed_mode_clean():
     )
     assert r.returncode in (0, 1), r.stderr
     assert "changed vs HEAD" in r.stderr
+
+
+# --------------------------------------------------------------------------- #
+# call graph (callgraph.py)
+# --------------------------------------------------------------------------- #
+from pbox_analyze import rules_protocol, rules_resources  # noqa: E402
+from pbox_analyze.callgraph import CallGraph  # noqa: E402
+from pbox_analyze.cli import parse_changed_diff  # noqa: E402
+
+
+def _graph(tmp_path, files: dict) -> CallGraph:
+    for name, src in files.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    ctx = Context(
+        paths=[str(tmp_path / n) for n in files], repo=str(tmp_path))
+    return CallGraph.of(ctx)
+
+
+def test_callgraph_resolves_cross_module_calls(tmp_path):
+    cg = _graph(tmp_path, {
+        "util.py": "def helper():\n    pass\n",
+        "main.py": (
+            "from util import helper\n"
+            "def drive():\n"
+            "    helper()\n"
+        ),
+    })
+    assert "util:helper" in cg.callees("main:drive")
+
+
+def test_callgraph_self_and_attr_dispatch(tmp_path):
+    cg = _graph(tmp_path, {"m.py": """\
+        class Store:
+            def merge(self):
+                pass
+
+        class Table:
+            def __init__(self):
+                self._store = Store()
+
+            def flush(self):
+                self._store.merge()
+
+            def state_dict(self):
+                self.flush()
+    """})
+    assert "m:Table.flush" in cg.callees("m:Table.state_dict")
+    assert "m:Store.merge" in cg.callees("m:Table.flush")
+    assert "m:Store.merge" in cg.transitive_callees("m:Table.state_dict")
+
+
+def test_callgraph_property_read_is_a_call(tmp_path):
+    cg = _graph(tmp_path, {"m.py": """\
+        class T:
+            @property
+            def n(self):
+                self.flush()
+                return 0
+
+            def flush(self):
+                pass
+
+            def shrink(self):
+                if self.n == 0:
+                    return 0
+    """})
+    assert "m:T.n" in cg.callees("m:T.shrink")
+    assert "m:T.flush" in cg.transitive_callees("m:T.shrink")
+
+
+def test_callgraph_thread_edges_are_kinded(tmp_path):
+    cg = _graph(tmp_path, {"m.py": """\
+        import threading
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+    """})
+    kinds = {(e.callee, e.kind) for e in cg.edges["m:W.start"]}
+    assert ("m:W._run", "thread") in kinds
+    # thread edges are excluded from the synchronous-call closure
+    assert "m:W._run" not in cg.transitive_callees("m:W.start")
+
+
+# --------------------------------------------------------------------------- #
+# typestate protocols (rules_protocol.py + protocols.py)
+# --------------------------------------------------------------------------- #
+def test_protocol_sparse_pass_double_begin(tmp_path):
+    src = """\
+        def drive(conf, k):
+            table = SparseTable(conf)
+            table.begin_pass(k)
+            table.begin_pass(k)
+            table.end_pass()
+    """
+    findings = _run(rules_protocol, tmp_path, src)
+    assert [f.rule for f in findings] == ["protocol-sparse-pass"]
+    assert findings[0].line == 4
+
+
+def test_protocol_sparse_pass_loop_without_end(tmp_path):
+    # second loop iteration re-begins an unclosed pass
+    src = """\
+        def drive(conf, passes):
+            table = SparseTable(conf)
+            for k in passes:
+                table.begin_pass(k)
+                train(table)
+    """
+    findings = _run(rules_protocol, tmp_path, src)
+    assert any(f.rule == "protocol-sparse-pass" and f.line == 4
+               for f in findings)
+
+
+def test_protocol_sparse_pass_good_loop(tmp_path):
+    src = """\
+        def drive(conf, passes):
+            table = SparseTable(conf)
+            for k in passes:
+                table.begin_pass(k)
+                table.end_pass()
+            state = table.state_dict()
+            return state
+    """
+    assert _run(rules_protocol, tmp_path, src) == []
+
+
+def test_protocol_sparse_pass_checkpoint_inside_pass(tmp_path):
+    src = """\
+        def drive(conf, k):
+            table = SparseTable(conf)
+            table.begin_pass(k)
+            snap = table.state_dict()
+            table.end_pass()
+            return snap
+    """
+    findings = _run(rules_protocol, tmp_path, src)
+    assert any("state_dict" in f.message for f in findings)
+
+
+def test_protocol_sparse_pass_interprocedural_summary(tmp_path):
+    # the helper ends the pass — the call graph summary must see it
+    good = """\
+        def finish(t):
+            t.end_pass()
+
+        def drive(conf, k):
+            table = SparseTable(conf)
+            table.begin_pass(k)
+            finish(table)
+    """
+    assert _run(rules_protocol, tmp_path, good) == []
+    bad = good.replace("t.end_pass()", "pass")
+    findings = _run(rules_protocol, tmp_path, bad)
+    assert any(f.rule == "protocol-sparse-pass" for f in findings)
+
+
+def test_protocol_stream_close_on_running(tmp_path):
+    src = """\
+        def drive(lines):
+            source = IterableSource(lines)
+            source.start()
+            source.close()
+    """
+    findings = _run(rules_protocol, tmp_path, src)
+    assert [f.rule for f in findings] == ["protocol-stream-lifecycle"]
+    assert findings[0].line == 4
+
+
+def test_protocol_stream_two_phase_good(tmp_path):
+    src = """\
+        def drive(lines):
+            source = IterableSource(lines)
+            source.start()
+            source.stop()
+            source.close()
+    """
+    assert _run(rules_protocol, tmp_path, src) == []
+
+
+def test_protocol_admission_release_every_path(tmp_path):
+    src = """\
+        def score(server, body):
+            server.gate.admit(1.0)
+            return run(body)
+    """
+    findings = _run(rules_protocol, tmp_path, src)
+    assert [f.rule for f in findings] == ["protocol-admission-ticket"]
+    assert "held" in findings[0].message
+
+
+def test_protocol_admission_release_not_finally_guarded(tmp_path):
+    src = """\
+        def score(server, body):
+            server.gate.admit(1.0)
+            out = run(body)
+            server.gate.release(0.1)
+            return out
+    """
+    findings = _run(rules_protocol, tmp_path, src)
+    assert any("finally" in f.message for f in findings)
+
+
+def test_protocol_admission_try_finally_good(tmp_path):
+    src = """\
+        def score(server, body):
+            server.gate.admit(1.0)
+            try:
+                return run(body)
+            finally:
+                server.gate.release(0.1)
+    """
+    assert _run(rules_protocol, tmp_path, src) == []
+
+
+def test_protocol_admission_shed_handler_is_not_a_leak(tmp_path):
+    # admit() raising means NO slot was taken: the except path must not
+    # be reported as holding a ticket
+    src = """\
+        def score(server, body):
+            try:
+                server.gate.admit(1.0)
+            except ShedRequest:
+                return None
+            try:
+                return run(body)
+            finally:
+                server.gate.release(0.1)
+    """
+    assert _run(rules_protocol, tmp_path, src) == []
+
+
+def test_protocol_publish_order_donefile_last(tmp_path):
+    bad = """\
+        class P:
+            def publish(self, table, local):
+                self._append_donefile(entry)
+                write_manifest(local, "manifest.json")
+                self._upload(local, "x", site="s")
+                table.clear_delta()
+    """
+    findings = _run(rules_protocol, tmp_path, bad)
+    assert any(f.rule == "protocol-publish-order" and f.line == 3
+               for f in findings)
+
+    good = """\
+        class P:
+            def publish(self, table, local):
+                write_manifest(local, "manifest.json")
+                self._upload(local, "x", site="s")
+                self._append_donefile(entry)
+                table.clear_delta()
+    """
+    assert _run(rules_protocol, tmp_path, good) == []
+
+
+def test_protocol_publish_order_clear_before_visible(tmp_path):
+    src = """\
+        class P:
+            def publish(self, table, local):
+                write_manifest(local, "manifest.json")
+                self._upload(local, "x", site="s")
+                table.clear_delta()
+                self._append_donefile(entry)
+    """
+    findings = _run(rules_protocol, tmp_path, src)
+    assert any("clear_delta" in f.message for f in findings)
+
+
+def test_protocol_span_pairing(tmp_path):
+    bad = """\
+        def trace(x):
+            s = span("step")
+            s.__enter__()
+            return x
+    """
+    findings = _run(rules_protocol, tmp_path, bad)
+    assert [f.rule for f in findings] == ["protocol-span-pairing"]
+
+    good = bad.replace("return x",
+                       "s.__exit__(None, None, None)\n    return x")
+    assert _run(rules_protocol, tmp_path, good) == []
+
+    with_form = """\
+        def trace(x):
+            with span("step"):
+                return x
+    """
+    assert _run(rules_protocol, tmp_path, with_form) == []
+
+
+def test_protocol_impl_obligation_fixture(tmp_path):
+    # a class NAMED SparseTable whose state_dict forgets the flush
+    # barrier trips the obligation; adding it back clears it
+    bad = """\
+        class SparseTable:
+            def flush(self):
+                pass
+
+            def state_dict(self):
+                return {}
+    """
+    findings = _run(rules_protocol, tmp_path, bad)
+    assert any(f.rule == "protocol-impl-requires"
+               and "state_dict" in f.message for f in findings)
+    good = bad.replace("return {}", "self.flush()\n        return {}")
+    assert not [f for f in _run(rules_protocol, tmp_path, good)
+                if "state_dict() must" in f.message]
+
+
+def test_protocol_suppressed(tmp_path):
+    src = """\
+        def drive(conf, k):
+            table = SparseTable(conf)
+            table.begin_pass(k)
+            # pbox-lint: ignore[protocol-sparse-pass] fixture reason
+            table.begin_pass(k)
+            table.end_pass()
+    """
+    assert _run(rules_protocol, tmp_path, src) == []
+
+
+# --------------------------------------------------------------------------- #
+# resource lifecycle (rules_resources.py)
+# --------------------------------------------------------------------------- #
+def test_thread_unjoined_bad(tmp_path):
+    src = """\
+        import threading
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+    """
+    findings = _run(rules_resources, tmp_path, src)
+    assert [f.rule for f in findings] == ["thread-unjoined"]
+
+
+@pytest.mark.parametrize("fix", [
+    # daemonized
+    "self._t = threading.Thread(target=self._run, daemon=True)",
+    # joined elsewhere in the class (added below)
+    None,
+])
+def test_thread_unjoined_good(tmp_path, fix):
+    src = """\
+        import threading
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def close(self):
+                self._t.join(timeout=5.0)
+
+            def _run(self):
+                pass
+    """
+    if fix:
+        src = src.replace(
+            "self._t = threading.Thread(target=self._run)", fix)
+    assert _run(rules_resources, tmp_path, src) == []
+
+
+def test_thread_join_through_loop_alias(tmp_path):
+    src = """\
+        import threading
+
+        class W:
+            def start(self):
+                self._a = threading.Thread(target=self._run)
+                self._b = threading.Thread(target=self._run)
+
+            def close(self):
+                for t in (self._a, self._b):
+                    t.join(timeout=2.0)
+
+            def _run(self):
+                pass
+    """
+    assert _run(rules_resources, tmp_path, src) == []
+
+
+def test_executor_shutdown_bad_and_good(tmp_path):
+    bad = """\
+        from concurrent.futures import ThreadPoolExecutor
+
+        class S:
+            def warm(self):
+                self._pool = ThreadPoolExecutor(max_workers=2)
+    """
+    findings = _run(rules_resources, tmp_path, bad)
+    assert [f.rule for f in findings] == ["executor-shutdown"]
+
+    good = bad + """\
+
+            def close(self):
+                pool, self._pool = self._pool, None
+                if pool is not None:
+                    pool.shutdown(wait=False)
+    """
+    assert _run(rules_resources, tmp_path, good) == []
+
+
+def test_resource_leak_on_early_return(tmp_path):
+    src = """\
+        def read(path, skip):
+            fh = open(path)
+            if skip:
+                return None
+            data = fh.read()
+            fh.close()
+            return data
+    """
+    findings = _run(rules_resources, tmp_path, src)
+    assert [f.rule for f in findings] == ["resource-leak"]
+    assert findings[0].line == 4
+
+    fixed = src.replace("return None", "fh.close()\n        return None")
+    assert _run(rules_resources, tmp_path, fixed) == []
+
+    with_form = """\
+        def read(path, skip):
+            with open(path) as fh:
+                if skip:
+                    return None
+                return fh.read()
+    """
+    assert _run(rules_resources, tmp_path, with_form) == []
+
+
+def test_lock_manual_release_shapes(tmp_path):
+    bad = """\
+        import threading
+
+        class G:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def work(self):
+                self._lock.acquire()
+                compute()
+                self._lock.release()
+    """
+    findings = _run(rules_resources, tmp_path, bad)
+    assert [f.rule for f in findings] == ["lock-manual-release"]
+
+    good = """\
+        import threading
+
+        class G:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def work(self):
+                self._lock.acquire()
+                try:
+                    compute()
+                finally:
+                    self._lock.release()
+    """
+    assert _run(rules_resources, tmp_path, good) == []
+
+    trylock = """\
+        import threading
+
+        class G:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def work(self):
+                if self._lock.acquire(blocking=False):
+                    try:
+                        compute()
+                    finally:
+                        self._lock.release()
+    """
+    assert _run(rules_resources, tmp_path, trylock) == []
+
+
+# --------------------------------------------------------------------------- #
+# interprocedural / cross-class lock analysis
+# --------------------------------------------------------------------------- #
+def test_lock_order_cross_class_cycle(tmp_path):
+    src = """\
+        import threading
+
+        class Router:
+            def __init__(self, sup: Supervisor):
+                self._la = threading.Lock()
+                self.sup = sup
+
+            def route(self):
+                with self._la:
+                    self.sup.poke()
+
+        class Supervisor:
+            def __init__(self, router: Router):
+                self._lb = threading.Lock()
+                self.router = router
+
+            def poke(self):
+                with self._lb:
+                    pass
+
+            def back(self):
+                with self._lb:
+                    self.router.route()
+    """
+    findings = _run(rules_locks, tmp_path, src)
+    assert any(f.rule == "lock-order" for f in findings)
+
+
+def test_lock_held_blocking_through_callee(tmp_path):
+    src = """\
+        import threading
+        import time
+
+        class G:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                time.sleep(1.0)
+
+            def work(self):
+                with self._lock:
+                    self.slow()
+    """
+    findings = _run(rules_locks, tmp_path, src)
+    assert any(
+        f.rule == "lock-held-blocking" and "slow()" in f.message
+        and f.line == 13
+        for f in findings
+    )
+
+
+def test_lock_split_helper_wait_on_own_cond_is_legal(tmp_path):
+    src = """\
+        import threading
+
+        class G:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def _wait_locked(self):
+                self._cv.wait(timeout=1.0)
+
+            def take(self):
+                with self._cv:
+                    self._wait_locked()
+    """
+    assert _run(rules_locks, tmp_path, src) == []
+
+
+# --------------------------------------------------------------------------- #
+# --changed diff parsing robustness
+# --------------------------------------------------------------------------- #
+FABRICATED_DIFF = """\
+diff --git a/kept.py b/kept.py
+index 111..222 100644
+--- a/kept.py
++++ b/kept.py
+@@ -10,0 +11,2 @@ def f():
++new line
++another
+diff --git a/gone.py b/gone.py
+deleted file mode 100644
+index 333..000
+--- a/gone.py
++++ /dev/null
+@@ -1,5 +0,0 @@
+-removed
+diff --git a/old_name.py b/new_name.py
+similarity index 90%
+rename from old_name.py
+rename to new_name.py
+--- a/old_name.py
++++ b/new_name.py
+@@ -3,0 +4 @@ def g():
++renamed-file line
+diff --git a/pure_rename.py b/also_pure.py
+similarity index 100%
+rename from pure_rename.py
+rename to also_pure.py
+"""
+
+
+def test_parse_changed_diff_handles_rename_and_delete():
+    touched = parse_changed_diff(FABRICATED_DIFF)
+    assert touched["kept.py"] == {11, 12}
+    # the deleted file's hunks must not bleed onto the previous file,
+    # nor appear under /dev/null
+    assert "gone.py" not in touched
+    assert not any("dev/null" in k for k in touched)
+    # renamed file is tracked under its NEW path
+    assert touched["new_name.py"] == {4}
+    assert "old_name.py" not in touched
+    # a 100%-similarity rename has no hunks and touches nothing
+    assert "pure_rename.py" not in touched and "also_pure.py" not in touched
+
+
+def test_changed_mode_survives_unparsable_file(tmp_path):
+    """A mid-edit syntax error must surface as parse-error, not crash."""
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    r = subprocess.run(
+        [sys.executable, CLI, str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "parse-error" in r.stdout
+
+
+def test_cli_names_protocol_rule_on_regression(tmp_path):
+    bad = tmp_path / "regress.py"
+    bad.write_text(
+        "def drive(conf, k):\n"
+        "    table = SparseTable(conf)\n"
+        "    table.begin_pass(k)\n"
+        "    table.begin_pass(k)\n"
+        "    table.end_pass()\n"
+    )
+    r = subprocess.run(
+        [sys.executable, CLI, str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "protocol-sparse-pass" in r.stdout
+    assert "regress.py:4" in r.stdout
+
+
+def test_new_rules_listed():
+    r = subprocess.run(
+        [sys.executable, CLI, "--list-rules"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0
+    for rule in ("protocol-sparse-pass", "protocol-stream-lifecycle",
+                 "protocol-admission-ticket", "protocol-publish-order",
+                 "protocol-span-pairing", "protocol-impl-requires",
+                 "thread-unjoined", "executor-shutdown", "resource-leak",
+                 "lock-manual-release"):
+        assert rule in r.stdout
+
+
+def test_full_run_wall_time_budget():
+    """The interprocedural passes must not regress lint latency: a full
+    --all run stays under the 5s budget (pre-commit viability)."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    r = subprocess.run(
+        [sys.executable, CLI, "--all"],
+        capture_output=True, text=True, timeout=60,
+    )
+    elapsed = _time.monotonic() - t0
+    assert r.returncode == 0, f"repo not clean:\n{r.stdout}"
+    assert elapsed <= 5.0, f"pbox-lint --all took {elapsed:.2f}s (> 5s)"

@@ -45,6 +45,8 @@ from . import (  # noqa: F401
     rules_drift,
     rules_except,
     rules_locks,
+    rules_protocol,
+    rules_resources,
     rules_threads,
     rules_tracer,
 )
@@ -55,6 +57,8 @@ from .core import Context, Finding  # noqa: F401
 PASS_MODULES = [
     rules_locks,
     rules_threads,
+    rules_protocol,
+    rules_resources,
     rules_except,
     rules_clock,
     rules_tracer,

@@ -1,0 +1,545 @@
+"""Project-wide call graph over the SourceFile cache.
+
+The whole-program layer every interprocedural pass shares: one build per
+Context resolves modules, classes (nested included — the ScoringServer
+request handler lives three scopes deep), methods, and four kinds of
+edges:
+
+  * plain calls — ``f()``, ``mod.f()``, ``self.m()``, ``cls.m()``,
+    ``Class.m()``, constructor calls (edge to ``__init__``);
+  * attribute dispatch — ``self.attr.m()`` / ``local.m()`` where the
+    receiver's class is known from a constructor binding or an annotated
+    parameter, resolved through the project-local MRO;
+  * thread edges — ``Thread(target=X)``: X runs later on another stack,
+    so lock/blocking closures exclude these while reachability keeps
+    them (a leaked lock in a thread target is still reachable code);
+  * callback edges — a known function/bound method passed as a call
+    argument (``register(cb=self._on_x)``): weakest edge kind, used for
+    reachability only.
+
+Property reads count as calls (``self.n_features`` → the property body):
+the SparseTable checkpoint barrier reaches ``flush()`` through exactly
+such a read, and an impl-obligation pass that missed it would flag
+correct code.
+
+Everything is resolved against project files only; calls into the
+stdlib or jax are simply absent from the graph.  Resolution is
+conservative — an unresolvable call contributes no edge — so closures
+built on the graph under-approximate, which for lint purposes means
+missed findings, never false ones.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import Context, SourceFile, dotted
+
+
+@dataclass
+class FuncInfo:
+    id: str
+    name: str
+    module: str
+    node: ast.AST
+    sf: SourceFile
+    cls: str | None = None  # owning class id (innermost), if a method
+
+
+@dataclass
+class ClassInfo:
+    id: str
+    name: str
+    module: str
+    node: ast.ClassDef
+    sf: SourceFile
+    bases: list = field(default_factory=list)       # resolved class ids
+    base_names: list = field(default_factory=list)  # raw dotted names
+    methods: dict = field(default_factory=dict)     # name -> func id
+    attr_types: dict = field(default_factory=dict)  # self.attr -> class id
+    properties: set = field(default_factory=set)    # property method names
+
+
+@dataclass(frozen=True)
+class Edge:
+    callee: str
+    node: ast.AST = field(compare=False)
+    kind: str = "call"  # call | ctor | thread | callback
+
+
+def module_name(rel: str) -> str:
+    """'paddlebox_tpu/sparse/table.py' -> 'paddlebox_tpu.sparse.table';
+    package __init__ files name the package itself."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    parts = mod.replace("\\", "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _decorator_names(node) -> set:
+    out = set()
+    for d in getattr(node, "decorator_list", []) or []:
+        name = dotted(d if not isinstance(d, ast.Call) else d.func)
+        if name:
+            out.add(name.rsplit(".", 1)[-1])
+    return out
+
+
+class CallGraph:
+    """Build once per Context (``CallGraph.of(ctx)`` caches it there)."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.modules: dict = {}    # module name -> SourceFile
+        self.functions: dict = {}  # func id -> FuncInfo
+        self.classes: dict = {}    # class id -> ClassInfo
+        self.imports: dict = {}    # module -> {alias: dotted target}
+        self.edges: dict = {}      # func id -> [Edge]
+        self._symbol_cache: dict = {}
+        self._by_node: dict = {}   # id(ast node) -> func id
+        self._props_cache: dict = {}
+        self._lt_cache: dict = {}
+        self._build()
+
+    @classmethod
+    def of(cls, ctx: Context) -> "CallGraph":
+        cg = getattr(ctx, "_callgraph", None)
+        if cg is None:
+            cg = cls(ctx)
+            ctx._callgraph = cg
+        return cg
+
+    # -- construction -------------------------------------------------------- #
+    def _build(self) -> None:
+        for sf in self.ctx.files:
+            mod = module_name(sf.rel)
+            self.modules[mod] = sf
+            self.imports[mod] = self._scan_imports(sf, mod)
+            self._register_scope(sf, mod, sf.tree.body, prefix="", cls=None)
+        self._resolve_bases()
+        self._scan_attr_types()
+        for fi in list(self.functions.values()):
+            self.edges[fi.id] = self._scan_edges(fi)
+
+    def _scan_imports(self, sf: SourceFile, mod: str) -> dict:
+        """{local alias: dotted target} — 'import a.b as x' maps x->a.b,
+        'from m import s' maps s->m.s, relative imports resolved against
+        the importing package."""
+        out: dict = {}
+        pkg = mod.split(".")
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # relative: strip one segment per level beyond the
+                    # module itself (packages import relative to self)
+                    anchor = pkg if self._is_package(mod) else pkg[:-1]
+                    keep = len(anchor) - (node.level - 1)
+                    prefix = ".".join(anchor[:keep]) if keep > 0 else ""
+                    base = f"{prefix}.{base}".strip(".") if base else prefix
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = (
+                        f"{base}.{a.name}" if base else a.name
+                    )
+        return out
+
+    def _is_package(self, mod: str) -> bool:
+        sf = self.modules.get(mod)
+        return bool(sf) and sf.rel.endswith("__init__.py")
+
+    def _register_scope(self, sf, mod, body, prefix, cls) -> None:
+        """Register every class/function, recursing into nested scopes."""
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                cid = f"{mod}:{prefix}{node.name}"
+                ci = ClassInfo(id=cid, name=node.name, module=mod,
+                               node=node, sf=sf)
+                ci.base_names = [dotted(b) for b in node.bases if dotted(b)]
+                self.classes[cid] = ci
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fid = f"{cid}.{item.name}"
+                        self.functions[fid] = FuncInfo(
+                            id=fid, name=item.name, module=mod,
+                            node=item, sf=sf, cls=cid,
+                        )
+                        self._by_node[id(item)] = fid
+                        ci.methods[item.name] = fid
+                        if _decorator_names(item) & {
+                            "property", "cached_property",
+                        }:
+                            ci.properties.add(item.name)
+                        self._register_scope(
+                            sf, mod, item.body,
+                            prefix=f"{prefix}{node.name}.{item.name}.",
+                            cls=cid,
+                        )
+                    elif isinstance(item, ast.ClassDef):
+                        self._register_scope(
+                            sf, mod, [item],
+                            prefix=f"{prefix}{node.name}.", cls=cid)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fid = f"{mod}:{prefix}{node.name}"
+                if fid not in self.functions:  # methods registered above
+                    self.functions[fid] = FuncInfo(
+                        id=fid, name=node.name, module=mod,
+                        node=node, sf=sf, cls=cls,
+                    )
+                    self._by_node[id(node)] = fid
+                self._register_scope(
+                    sf, mod, node.body, prefix=f"{prefix}{node.name}.",
+                    cls=cls)
+            elif hasattr(node, "body") and not isinstance(node, ast.expr):
+                for fieldname in ("body", "orelse", "finalbody"):
+                    self._register_scope(
+                        sf, mod, getattr(node, fieldname, []) or [],
+                        prefix=prefix, cls=cls)
+                for h in getattr(node, "handlers", []) or []:
+                    self._register_scope(sf, mod, h.body, prefix, cls)
+
+    def _resolve_bases(self) -> None:
+        for ci in self.classes.values():
+            for bn in ci.base_names:
+                sym = self.resolve_symbol(ci.module, bn)
+                if sym and sym[0] == "class":
+                    ci.bases.append(sym[1])
+
+    def _scan_attr_types(self) -> None:
+        """self.attr = Ctor(...) where Ctor is a project class, and
+        self.attr = <param> for annotated ctor params."""
+        for ci in self.classes.values():
+            ann: dict = {}
+            init = ci.methods.get("__init__")
+            if init:
+                fn = self.functions[init].node
+                args = list(fn.args.args) + list(fn.args.kwonlyargs)
+                for a in args:
+                    if a.annotation is None:
+                        continue
+                    name = dotted(a.annotation) or (
+                        a.annotation.value
+                        if isinstance(a.annotation, ast.Constant)
+                        and isinstance(a.annotation.value, str) else ""
+                    )
+                    if name:
+                        sym = self.resolve_symbol(ci.module, name)
+                        if sym and sym[0] == "class":
+                            ann[a.arg] = sym[1]
+            for mid in ci.methods.values():
+                for node in ast.walk(self.functions[mid].node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if not (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            continue
+                        v = node.value
+                        if isinstance(v, ast.Call):
+                            sym = self.resolve_symbol(
+                                ci.module, dotted(v.func))
+                            if sym and sym[0] == "class":
+                                ci.attr_types[t.attr] = sym[1]
+                        elif isinstance(v, ast.Name) and v.id in ann:
+                            ci.attr_types[t.attr] = ann[v.id]
+
+    # -- symbol resolution ---------------------------------------------------- #
+    def resolve_symbol(self, module: str, name: str, _depth: int = 0):
+        """('class'|'func', id) for a dotted name as seen from ``module``,
+        following import aliases and package re-exports; None if it does
+        not resolve to a project symbol."""
+        if not name or _depth > 8:
+            return None
+        key = (module, name)
+        if key in self._symbol_cache:
+            return self._symbol_cache[key]
+        self._symbol_cache[key] = None  # cycle guard
+        res = self._resolve_symbol_uncached(module, name, _depth)
+        self._symbol_cache[key] = res
+        return res
+
+    def _resolve_symbol_uncached(self, module, name, depth):
+        head, _, rest = name.partition(".")
+        # a module-local definition?
+        for cid in (f"{module}:{name}",):
+            if cid in self.classes:
+                return ("class", cid)
+            if cid in self.functions:
+                return ("func", cid)
+        # Class.method / Class.Inner within this module
+        if rest:
+            local = f"{module}:{head}"
+            if local in self.classes:
+                m = self.resolve_method(local, rest)
+                if m:
+                    return ("func", m)
+        # through an import alias
+        imports = self.imports.get(module, {})
+        if head in imports:
+            target = imports[head]
+            full = f"{target}.{rest}" if rest else target
+            return self._resolve_dotted(full, depth)
+        # a fully dotted project path used directly
+        return self._resolve_dotted(name, depth)
+
+    def _resolve_dotted(self, full: str, depth: int):
+        """Resolve 'pkg.mod.Symbol.member' against project modules."""
+        if depth > 8:
+            return None
+        parts = full.split(".")
+        for i in range(len(parts), 0, -1):
+            mod = ".".join(parts[:i])
+            if mod not in self.modules:
+                continue
+            rest = ".".join(parts[i:])
+            if not rest:
+                return None  # a bare module is not a callable symbol
+            cid = f"{mod}:{rest}"
+            if cid in self.classes:
+                return ("class", cid)
+            if cid in self.functions:
+                return ("func", cid)
+            head, _, tail = rest.partition(".")
+            hid = f"{mod}:{head}"
+            if tail and hid in self.classes:
+                m = self.resolve_method(hid, tail)
+                if m:
+                    return ("func", m)
+            # re-export: the module imported the symbol from elsewhere
+            if head in self.imports.get(mod, {}):
+                target = self.imports[mod][head]
+                full2 = f"{target}.{tail}" if tail else target
+                return self.resolve_symbol(mod, head, depth + 1) \
+                    if not tail else self._resolve_dotted(full2, depth + 1)
+        return None
+
+    def resolve_method(self, cid: str, name: str, _seen=None):
+        """func id of ``name`` on class ``cid``, walking project bases."""
+        if _seen is None:
+            _seen = set()
+        if cid in _seen or cid not in self.classes:
+            return None
+        _seen.add(cid)
+        ci = self.classes[cid]
+        if name in ci.methods:
+            return ci.methods[name]
+        for b in ci.bases:
+            m = self.resolve_method(b, name, _seen)
+            if m:
+                return m
+        return None
+
+    def attr_type(self, cid: str, attr: str, _seen=None):
+        """Class id of ``self.attr`` on ``cid`` (inherited bindings too)."""
+        if _seen is None:
+            _seen = set()
+        if cid in _seen or cid not in self.classes:
+            return None
+        _seen.add(cid)
+        ci = self.classes[cid]
+        if attr in ci.attr_types:
+            return ci.attr_types[attr]
+        for b in ci.bases:
+            t = self.attr_type(b, attr, _seen)
+            if t:
+                return t
+        return None
+
+    # -- per-function edges --------------------------------------------------- #
+    def _local_types(self, fi: FuncInfo) -> dict:
+        """{local name: class id} from ctor assignments, self-attr
+        aliases, and annotated parameters.  Cached per function."""
+        cached = self._lt_cache.get(fi.id)
+        if cached is not None:
+            return cached
+        out: dict = {}
+        fn = fi.node
+        args = list(fn.args.args) + list(fn.args.kwonlyargs)
+        for a in args:
+            if a.annotation is not None:
+                name = dotted(a.annotation)
+                if name:
+                    sym = self.resolve_symbol(fi.module, name)
+                    if sym and sym[0] == "class":
+                        out[a.arg] = sym[1]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                v = node.value
+                if isinstance(v, ast.Call):
+                    sym = self.resolve_symbol(fi.module, dotted(v.func))
+                    if sym and sym[0] == "class":
+                        out[t.id] = sym[1]
+                elif (
+                    fi.cls
+                    and isinstance(v, ast.Attribute)
+                    and isinstance(v.value, ast.Name)
+                    and v.value.id == "self"
+                ):
+                    ty = self.attr_type(fi.cls, v.attr)
+                    if ty:
+                        out[t.id] = ty
+        self._lt_cache[fi.id] = out
+        return out
+
+    def _resolve_call_target(self, fi, local_types, func):
+        """func id for a call expression's target, or None."""
+        if isinstance(func, ast.Name):
+            sym = self.resolve_symbol(fi.module, func.id)
+            if sym:
+                if sym[0] == "class":
+                    return self.resolve_method(sym[1], "__init__")
+                return sym[1]
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and fi.cls:
+                return self.resolve_method(fi.cls, func.attr)
+            if base.id in local_types:
+                return self.resolve_method(local_types[base.id], func.attr)
+        elif (
+            fi.cls
+            and isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+        ):
+            ty = self.attr_type(fi.cls, base.attr)
+            if ty:
+                return self.resolve_method(ty, func.attr)
+        # dotted module path (mod.f(), pkg.mod.Class.m(), Class.m())
+        sym = self.resolve_symbol(fi.module, dotted(func))
+        if sym:
+            if sym[0] == "class":
+                return self.resolve_method(sym[1], "__init__")
+            return sym[1]
+        return None
+
+    def _ref_target(self, fi, local_types, nested, expr):
+        """func id a non-call reference points at (thread targets,
+        callbacks): self.m / name / mod.f / a sibling nested def."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls") and fi.cls:
+            return self.resolve_method(fi.cls, expr.attr)
+        if isinstance(expr, ast.Name) and expr.id in nested:
+            return nested[expr.id]
+        name = dotted(expr)
+        if name:
+            sym = self.resolve_symbol(fi.module, name)
+            if sym and sym[0] == "func":
+                return sym[1]
+        return None
+
+    def _shallow_walk(self, fn):
+        """Nodes of fn's own body, not descending into nested defs or
+        classes (their calls belong to their own graph node)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _scan_edges(self, fi: FuncInfo) -> list:
+        edges: list = []
+        local_types = self._local_types(fi)
+        # directly nested defs, addressable by bare name from this body
+        nested = {
+            n.name: self._by_node[id(n)]
+            for n in self._shallow_walk(fi.node)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and id(n) in self._by_node
+        }
+
+        for node in self._shallow_walk(fi.node):
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in nested:
+                    tgt = nested[node.func.id]
+                else:
+                    tgt = self._resolve_call_target(
+                        fi, local_types, node.func)
+                if tgt:
+                    kind = "ctor" if tgt.endswith(".__init__") else "call"
+                    edges.append(Edge(callee=tgt, node=node, kind=kind))
+                is_thread = dotted(node.func).rsplit(".", 1)[-1] == "Thread"
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    t = self._ref_target(fi, local_types, nested, kw.value)
+                    if t:
+                        kind = "thread" if is_thread and kw.arg == "target" \
+                            else "callback"
+                        edges.append(Edge(callee=t, node=node, kind=kind))
+                for a in node.args:
+                    t = self._ref_target(fi, local_types, nested, a)
+                    if t:
+                        edges.append(
+                            Edge(callee=t, node=node, kind="callback"))
+            elif (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in ("self", "cls")
+                and fi.cls
+                and node.attr in self._properties_of(fi.cls)
+            ):
+                # property read = a call into the property body
+                m = self.resolve_method(fi.cls, node.attr)
+                if m:
+                    edges.append(Edge(callee=m, node=node, kind="call"))
+        return edges
+
+    def _properties_of(self, cid: str) -> set:
+        if cid in self._props_cache:
+            return self._props_cache[cid]
+        self._props_cache[cid] = set()  # cycle guard
+        ci = self.classes.get(cid)
+        out = set(ci.properties) if ci else set()
+        if ci:
+            for b in ci.bases:
+                out |= self._properties_of(b)
+        self._props_cache[cid] = out
+        return out
+
+    # -- queries -------------------------------------------------------------- #
+    def callees(self, fid: str, kinds=("call", "ctor")) -> set:
+        return {e.callee for e in self.edges.get(fid, ())
+                if e.kind in kinds}
+
+    def transitive_callees(self, fid: str, kinds=("call", "ctor"),
+                           max_depth: int = 64) -> set:
+        """Every function reachable from ``fid`` through the given edge
+        kinds (``fid`` itself excluded unless recursive)."""
+        seen: set = set()
+        frontier = [fid]
+        depth = 0
+        while frontier and depth < max_depth:
+            nxt: list = []
+            for f in frontier:
+                for c in self.callees(f, kinds):
+                    if c not in seen:
+                        seen.add(c)
+                        nxt.append(c)
+            frontier = nxt
+            depth += 1
+        return seen

@@ -33,24 +33,25 @@ from . import baseline as baseline_mod
 from .core import REPO, Context, Finding, discover_files
 
 
-def _changed_lines(ref: str) -> dict:
-    """{repo-relative path: set of touched 1-based lines} vs the ref."""
-    try:
-        out = subprocess.run(
-            ["git", "diff", "--unified=0", ref, "--", "*.py"],
-            cwd=REPO, capture_output=True, text=True, timeout=60,
-        )
-    except (OSError, subprocess.TimeoutExpired) as e:
-        raise SystemExit(f"ERROR: git diff {ref} failed: {e}")
-    if out.returncode != 0:
-        raise SystemExit(
-            f"ERROR: git diff {ref} failed: {out.stderr.strip()}")
+def parse_changed_diff(text: str) -> dict:
+    """{post-image repo-relative path: set of touched 1-based lines} from
+    unified-diff text.
+
+    Robust to the shapes a working tree actually produces: deleted files
+    (``+++ /dev/null`` — their hunks belong to no current file and must
+    not bleed onto the previous file), renames (``+++ b/<new path>`` is
+    the analyzable file; a pure rename with no hunks touches nothing),
+    and mode-only entries (no ``+++`` line at all)."""
     touched: dict = {}
     current = None
-    for line in out.stdout.splitlines():
+    for line in text.splitlines():
         if line.startswith("+++ b/"):
             current = line[6:]
             touched.setdefault(current, set())
+        elif line.startswith("+++ "):
+            current = None  # '+++ /dev/null': the file is gone
+        elif line.startswith("diff --git"):
+            current = None  # a headerless entry must not inherit state
         elif line.startswith("@@") and current is not None:
             m = re.search(r"\+(\d+)(?:,(\d+))?", line)
             if m:
@@ -58,6 +59,22 @@ def _changed_lines(ref: str) -> dict:
                 count = int(m.group(2)) if m.group(2) is not None else 1
                 touched[current].update(range(start, start + max(count, 1)))
     return touched
+
+
+def _changed_lines(ref: str) -> dict:
+    """{repo-relative path: set of touched 1-based lines} vs the ref."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--unified=0", "--find-renames", ref,
+             "--", "*.py"],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise SystemExit(f"ERROR: git diff {ref} failed: {e}")
+    if out.returncode != 0:
+        raise SystemExit(
+            f"ERROR: git diff {ref} failed: {out.stderr.strip()}")
+    return parse_changed_diff(out.stdout)
 
 
 def _resolve_paths(paths: list) -> list:
@@ -157,13 +174,17 @@ def main(argv=None) -> int:
         kept, baselined, stale = baseline_mod.apply(kept, entries)
         kept += stale
 
-    # incremental mode: only touched lines (stale-baseline findings
-    # survive the filter — a stale entry is a whole-repo invariant)
+    # incremental mode: only touched lines.  stale-baseline findings
+    # survive the filter (a stale entry is a whole-repo invariant), and
+    # so does a parse error in any touched file — a mid-edit syntax
+    # error reported at line 1 would otherwise vanish whenever line 1
+    # itself wasn't part of the diff.
     if args.changed is not None:
         touched = _changed_lines(args.changed)
         kept = [
             f for f in kept
             if f.rule == "stale-baseline"
+            or (f.rule == "parse-error" and f.file in touched)
             or f.line in touched.get(f.file, ())
         ]
 

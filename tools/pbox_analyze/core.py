@@ -285,7 +285,11 @@ def _scan_attr_bindings(model: ClassModel, tree) -> None:
 
 def class_models(sf: SourceFile) -> list:
     """ClassModels for every class in the file, plus one module-level
-    pseudo-model (bare functions + module locks) as the last element."""
+    pseudo-model (bare functions + module locks) as the last element.
+    Cached per SourceFile — three passes share one scan."""
+    cached = getattr(sf, "_class_models", None)
+    if cached is not None:
+        return cached
     models: list = []
     for node in sf.tree.body:
         if isinstance(node, ast.ClassDef):
@@ -302,4 +306,5 @@ def class_models(sf: SourceFile) -> list:
     _scan_attr_bindings(mod, sf.tree)
     # module functions can also spawn threads targeting module functions
     models.append(mod)
+    sf._class_models = models
     return models

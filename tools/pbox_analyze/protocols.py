@@ -1,0 +1,222 @@
+"""The checked-in protocol catalog: every spec here is a contract a past
+review round fixed by hand, now machine-checked at lint time (see
+ARCHITECTURE.md "Static analysis" → "Declaring a protocol").
+
+Each spec is data, not code: states, per-op transitions, per-op state
+requirements, and what must hold at scope exit.  The engine
+(:mod:`rules_protocol`) reports *definite* violations only, so a spec
+can be strict without drowning the repo in maybes.
+"""
+
+from __future__ import annotations
+
+from .rules_protocol import ImplObligation, ProtocolSpec
+
+# --------------------------------------------------------------------------- #
+# 1. SparseTable pass lifecycle (PR 5/6: flush barriers, staged passes)
+# --------------------------------------------------------------------------- #
+SPARSE_PASS = ProtocolSpec(
+    rule="protocol-sparse-pass",
+    name="sparse-pass",
+    description=(
+        "SparseTable begin_pass -> train -> end_pass ordering, with "
+        "checkpoint-shaped reads only between passes"
+    ),
+    states=("idle", "in_pass"),
+    initial="idle",
+    ctors=frozenset({"SparseTable", "ShardedSparseTable"}),
+    receivers=r"(^|\.)(table|sparse_table)$",
+    transitions={
+        "begin_pass": {"idle": "in_pass"},
+        "end_pass": {"in_pass": "idle"},
+        "abort_pass": {"in_pass": "idle"},
+    },
+    require_state={
+        "state_dict": {"idle"},
+        "delta_state_dict": {"idle"},
+        "pop_delta": {"idle"},
+        "shrink": {"idle"},
+        "load_state_dict": {"idle"},
+        "apply_delta": {"idle"},
+    },
+    end_states=frozenset({"idle"}),
+    hints={
+        "begin_pass": "the previous pass was never end_pass()/abort_pass()d",
+        "state_dict": "end_pass() (or abort_pass()) before checkpointing",
+        "delta_state_dict": "end_pass() before taking a delta",
+        "shrink": "shrink between passes, never inside one",
+    },
+)
+
+# --------------------------------------------------------------------------- #
+# 2. StreamSource two-phase shutdown (PR 8 review: the drain contract)
+# --------------------------------------------------------------------------- #
+STREAM_LIFECYCLE = ProtocolSpec(
+    rule="protocol-stream-lifecycle",
+    name="stream-lifecycle",
+    description=(
+        "StreamSource lifecycle: start once; stop() (graceful drain) "
+        "before close() (hard-kill escalation)"
+    ),
+    states=("new", "running", "stopped", "closed"),
+    initial="new",
+    ctors=frozenset({
+        "StreamSource", "IterableSource", "TailingFileSource",
+        "SocketSource",
+    }),
+    receivers=r"(^|\.)source$",
+    transitions={
+        "start": {"new": "running"},
+        "stop": {"new": "stopped", "running": "stopped",
+                 "stopped": "stopped"},
+        "close": {"new": "closed", "stopped": "closed", "closed": "closed"},
+    },
+    end_states=None,  # sources routinely outlive the creating scope
+    hints={
+        "start": "start() twice respawns producer threads over live state",
+        "close": (
+            "close() on a RUNNING source skips the graceful drain: call "
+            "stop(), consume until drained, then close()"
+        ),
+    },
+)
+
+# --------------------------------------------------------------------------- #
+# 3. AdmissionGate ticket discipline (PR 7: the starved-queue family)
+# --------------------------------------------------------------------------- #
+ADMISSION_TICKET = ProtocolSpec(
+    rule="protocol-admission-ticket",
+    name="admission-ticket",
+    description=(
+        "AdmissionGate admit() must be released on every exit path, "
+        "exception paths included"
+    ),
+    states=("idle", "held"),
+    initial="idle",
+    ctors=frozenset({"AdmissionGate"}),
+    receivers=r"(^|\.)gate$",
+    end_check_receivers=True,
+    transitions={
+        "admit": {"idle": "held"},
+        "release": {"held": "idle"},
+    },
+    end_states=frozenset({"idle"}),
+    guarded=frozenset({"admit"}),
+    release_ops=frozenset({"release"}),
+    hints={
+        "admit": "admit() while already holding a slot double-counts",
+        "release": "release() without a held slot underflows the gate",
+    },
+)
+
+# --------------------------------------------------------------------------- #
+# 4. Publish ordering (PR 4: donefile-LAST; delta cleared only once visible)
+# --------------------------------------------------------------------------- #
+PUBLISH_ORDER = ProtocolSpec(
+    rule="protocol-publish-order",
+    name="publish-order",
+    description=(
+        "publish discipline: stage -> write_manifest -> verified upload "
+        "-> donefile LAST -> clear_delta only once the entry is visible"
+    ),
+    states=("staged", "manifested", "uploaded", "published", "cleared"),
+    initial="staged",
+    scope_ops=True,
+    trigger="_append_donefile",
+    transitions={
+        "write_manifest": {"staged": "manifested"},
+        "_upload": {"manifested": "uploaded"},
+        "_append_donefile": {"uploaded": "published"},
+        "clear_delta": {"published": "cleared"},
+    },
+    end_states=None,
+    hints={
+        "_append_donefile": (
+            "the donefile must land LAST, after the entry's data "
+            "uploaded and verified — a consumer must never see an entry "
+            "whose bytes are missing"
+        ),
+        "clear_delta": (
+            "clearing the delta tracker before the donefile is visible "
+            "drops rows from the chain on a failed publish"
+        ),
+        "_upload": "upload only after the recursive manifest is written",
+    },
+)
+
+# --------------------------------------------------------------------------- #
+# 5. Span pairing (PR 3/9: manual __enter__ without __exit__ corrupts the
+#    per-thread span stack every later span nests under)
+# --------------------------------------------------------------------------- #
+SPAN_PAIRING = ProtocolSpec(
+    rule="protocol-span-pairing",
+    name="span-pairing",
+    description=(
+        "manually-entered span()/context managers must __exit__ on every "
+        "path (prefer `with`)"
+    ),
+    states=("created", "entered", "exited"),
+    initial="created",
+    ctors=frozenset({"span"}),
+    transitions={
+        "__enter__": {"created": "entered"},
+        "__exit__": {"entered": "exited"},
+    },
+    end_states=frozenset({"created", "exited"}),
+    hints={
+        "__enter__": "a span entered twice corrupts the nesting stack",
+        "__exit__": "__exit__ without __enter__ pops someone else's span",
+    },
+)
+
+PROTOCOLS = [
+    SPARSE_PASS,
+    STREAM_LIFECYCLE,
+    ADMISSION_TICKET,
+    PUBLISH_ORDER,
+    SPAN_PAIRING,
+]
+
+# --------------------------------------------------------------------------- #
+# class-level obligations, verified over the call graph (property reads
+# count as calls — SparseTable.shrink reaches flush() through the
+# n_features property)
+# --------------------------------------------------------------------------- #
+OBLIGATIONS = [
+    ImplObligation(
+        cls="SparseTable",
+        methods=("state_dict", "delta_state_dict", "shrink",
+                 "load_state_dict", "apply_delta"),
+        must_call=("flush",),
+        why=(
+            "the PR-5 write-back worker may still be merging: flush() is "
+            "the barrier that makes checkpoint-shaped reads coherent"
+        ),
+    ),
+    ImplObligation(
+        cls="ShardedSparseTable",
+        methods=("state_dict", "delta_state_dict", "shrink",
+                 "load_state_dict", "apply_delta"),
+        must_call=("flush",),
+        why="same flush barrier as SparseTable, per local shard",
+    ),
+    ImplObligation(
+        cls="StreamSource",
+        methods=("close",),
+        must_call=("stop",),
+        why=(
+            "close() is the two-phase escalation: the graceful stop/drain "
+            "must be requested before the hard kill"
+        ),
+    ),
+    ImplObligation(
+        cls="Publisher",
+        methods=("publish_base", "publish_delta"),
+        must_call=("write_manifest", "_upload", "_append_donefile"),
+        why=(
+            "every publish must stage, manifest, verify-upload and land "
+            "the donefile last — skipping a step breaks the consumer's "
+            "integrity contract"
+        ),
+    ),
+]

@@ -1,48 +1,57 @@
 """lock-order and lock-held-blocking: the AdmissionGate-starvation and
-SocketSource-accept-race family.
+SocketSource-accept-race family — now whole-program.
 
-Two rules over the per-class concurrency model (core.ClassModel):
+Two rules over qualified lock identities ``(owner, attr)`` — a class's
+lock (``FleetRouter._lock``) or a module-level lock (``trace._lock``) —
+resolved through the project call graph:
 
 ``lock-order``
-    Build the lock-acquisition graph per class (module scope is a
-    pseudo-class): an edge A→B every time lock B is acquired — by a
-    ``with`` block, an explicit ``.acquire()``, or one level of
-    ``self.m()`` interprocedural closure — while A is held.  Any edge
-    that closes a cycle is flagged at its acquisition site.  Two threads
-    taking the same pair of locks in opposite orders is the textbook
-    deadlock PR 7's review caught by hand.
+    One global lock-acquisition graph: an edge A→B every time lock B is
+    acquired while A is held — by a ``with`` block, an explicit
+    ``.acquire()``, or *any resolved call* whose transitive closure
+    acquires B (``self.m()``, ``other.m()`` through a typed attribute or
+    local, module functions, constructors).  Cross-class edges make the
+    router→supervisor→server surface one graph; any edge closing a
+    cycle is flagged at its acquisition site.
 
 ``lock-held-blocking``
-    While any lock is held, flag calls that can block indefinitely:
-    socket send/recv/accept/connect, ``subprocess`` spawns and
-    ``communicate``, ``open()``, ``time.sleep``, thread joins,
-    ``Event``/``Condition`` waits on anything *other than the innermost
-    held condition* (waiting on your own innermost condition releases
-    it — that is the one legal blocking wait), and JAX host transfers
-    (``device_get`` / ``block_until_ready``).  A lock held across any
-    of these starves every other thread that needs it — the
-    AdmissionGate probe-starvation bug's exact shape.
+    While a lock is held, flag (a) direct calls that can block
+    indefinitely — socket ops, subprocess spawns/communicate, ``open``,
+    ``time.sleep``, thread joins, waits on anything other than the
+    innermost held condition, JAX host transfers — and (b) calls into
+    project functions that perform such an op within two call-graph
+    levels (the finding names the op's actual site).  A callee's wait on
+    the caller's innermost held condition stays legal — that is the
+    split-helper form of THE condition idiom.
 
-Scope limits (kept deliberately, for signal over noise): held-lock
-tracking follows ``with`` nesting inside one method plus a single level
-of ``self.m()`` calls; nested ``def``/``lambda`` bodies run later on
-some other stack and are scanned with an empty held set.
+Held-lock tracking follows ``with`` nesting inside one function; nested
+``def``/``lambda`` bodies run later on some other stack and are analyzed
+as their own call-graph nodes with an empty held set.
 """
 
 from __future__ import annotations
 
 import ast
 
-from .core import ClassModel, Context, class_models, dotted
+from .callgraph import CallGraph
+from .core import (
+    ClassModel,
+    Context,
+    LOCK_CTORS,
+    _ctor_name,
+    _scan_attr_bindings,
+    dotted,
+)
 
 RULES = {
     "lock-order": (
-        "lock acquisition cycle within a class — two orders of the same "
-        "locks can deadlock"
+        "lock acquisition cycle (cross-class, call-graph closed) — two "
+        "orders of the same locks can deadlock"
     ),
     "lock-held-blocking": (
         "blocking call (socket/subprocess/file/sleep/join/foreign wait/"
-        "jax transfer) while holding a lock"
+        "jax transfer) while holding a lock, directly or through a "
+        "called function"
     ),
 }
 
@@ -50,129 +59,201 @@ _SOCKETISH = ("sock", "conn", "client", "peer")
 _SOCKET_OPS = {"recv", "recv_into", "accept", "connect", "sendall", "send",
                "makefile"}
 _SUBPROCESS_OPS = {"run", "Popen", "check_call", "check_output", "call"}
+_BLOCK_DEPTH = 2  # interprocedural blocking: callee + callee's callees
 
 
-def _base_text(func) -> str:
-    """Lowercased dotted text of a call's receiver ('self.sock' for
-    self.sock.recv)."""
-    if isinstance(func, ast.Attribute):
-        return dotted(func.value).lower()
-    return ""
+def _disp(ref) -> str:
+    owner, attr = ref
+    short = owner.split(":")[-1] if ":" in owner else owner
+    return f"{short}.{attr}"
 
 
-def _blocking_reason(call: ast.Call, model: ClassModel, held: tuple):
-    """Why this call blocks while a lock is held, or None."""
-    name = dotted(call.func)
-    last = name.rsplit(".", 1)[-1] if name else ""
-    if not last and isinstance(call.func, ast.Attribute):
-        last = call.func.attr
-    base = _base_text(call.func)
+class _Locks:
+    """Qualified lock tables + per-class concurrency models."""
 
-    if name == "time.sleep":
-        return "time.sleep() holds the lock for the whole nap"
-    if name == "open":
-        return "file I/O (open) under the lock"
-    if name.startswith("subprocess.") and last in _SUBPROCESS_OPS:
-        return "subprocess spawn under the lock"
-    if last == "communicate":
-        return "subprocess communicate() blocks until the child exits"
-    if last in {"wait", "wait_for"} and isinstance(call.func, ast.Attribute):
-        lid = model.is_lock_name(call.func.value)
-        if lid is not None:
-            if held and lid == held[-1]:
-                return None  # waiting on the innermost condition is THE idiom
+    def __init__(self, cg: CallGraph):
+        self.cg = cg
+        self.cmodels: dict = {}   # class id -> ClassModel
+        self.mlocks: dict = {}    # module -> {name: kind}
+        for cid, ci in cg.classes.items():
+            cm = ClassModel(name=ci.name, node=ci.node)
+            for name, fid in ci.methods.items():
+                cm.methods[name] = cg.functions[fid].node
+            _scan_attr_bindings(cm, ci.node)
+            self.cmodels[cid] = cm
+        for mod, sf in cg.modules.items():
+            locks: dict = {}
+            for node in sf.tree.body:
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    ctor = _ctor_name(node.value)
+                    if ctor in LOCK_CTORS:
+                        for t in targets:
+                            if isinstance(t, ast.Name):
+                                locks[t.id] = LOCK_CTORS[ctor]
+            self.mlocks[mod] = locks
+
+    def _class_lock(self, cid, attr, _seen=None):
+        """(defining class id, kind) for attr along the project MRO."""
+        if _seen is None:
+            _seen = set()
+        if cid in _seen or cid not in self.cg.classes:
+            return None
+        _seen.add(cid)
+        cm = self.cmodels.get(cid)
+        if cm and attr in cm.lock_attrs:
+            return cid, cm.lock_attrs[attr]
+        for b in self.cg.classes[cid].bases:
+            hit = self._class_lock(b, attr, _seen)
+            if hit:
+                return hit
+        return None
+
+    def thread_attr(self, cid, attr) -> bool:
+        cm = self.cmodels.get(cid)
+        return bool(cm and attr in cm.thread_attrs)
+
+
+class _FnScan:
+    """One function's walk: direct acquisitions, acquisition edges,
+    blocking sites, and resolved-call sites under held locks."""
+
+    def __init__(self, locks: _Locks, fi, local_types):
+        self.locks = locks
+        self.fi = fi
+        self.local_types = local_types
+        self.acquired: set = set()
+        self.edges: list = []     # (a, b, node)
+        self.blocking: list = []  # (held, node, reason)
+        self.calls: list = []     # (held, call node)
+        self.block_any: list = []  # (node, reason, condref|None)
+
+    # -- lock resolution ---------------------------------------------------- #
+    def lock_of(self, expr):
+        """(owner, attr) lock ref this expression names, if any."""
+        lk = self.locks
+        if isinstance(expr, ast.Name):
+            if expr.id in lk.mlocks.get(self.fi.module, {}):
+                return (self.fi.module, expr.id)
+            ty = self.local_types.get(expr.id)
+            return None if ty is None else None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base = expr.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and self.fi.cls:
+                hit = lk._class_lock(self.fi.cls, expr.attr)
+                if hit:
+                    return (hit[0], expr.attr)
+            ty = self.local_types.get(base.id)
+            if ty:
+                hit = lk._class_lock(ty, expr.attr)
+                if hit:
+                    return (hit[0], expr.attr)
+        elif (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+            and self.fi.cls
+        ):
+            ty = lk.cg.attr_type(self.fi.cls, base.attr)
+            if ty:
+                hit = lk._class_lock(ty, expr.attr)
+                if hit:
+                    return (hit[0], expr.attr)
+        return None
+
+    def kind_of(self, ref) -> str:
+        owner, attr = ref
+        if ":" in owner:
+            cm = self.locks.cmodels.get(owner)
+            if cm:
+                return cm.lock_attrs.get(attr, "lock")
+        return self.locks.mlocks.get(owner, {}).get(attr, "lock")
+
+    # -- blocking classification -------------------------------------------- #
+    def _classify_blocking(self, call):
+        """(reason, condref|None) when this call can block indefinitely;
+        condref identifies a wait on a condition (legality decided by
+        the holder)."""
+        name = dotted(call.func)
+        last = name.rsplit(".", 1)[-1] if name else ""
+        if not last and isinstance(call.func, ast.Attribute):
+            last = call.func.attr
+        base = ""
+        if isinstance(call.func, ast.Attribute):
+            base = dotted(call.func.value).lower()
+
+        if name == "time.sleep":
+            return "time.sleep() holds the lock for the whole nap", None
+        if name == "open":
+            return "file I/O (open) under the lock", None
+        if name.startswith("subprocess.") and last in _SUBPROCESS_OPS:
+            return "subprocess spawn under the lock", None
+        if last == "communicate":
+            return "subprocess communicate() blocks until the child " \
+                   "exits", None
+        if last in {"wait", "wait_for"} and \
+                isinstance(call.func, ast.Attribute):
+            ref = self.lock_of(call.func.value)
+            if ref is not None:
+                return (
+                    f"wait on condition {_disp(ref)!r} — wait() only "
+                    "releases its own lock", ref,
+                )
             return (
-                f"wait on condition {lid!r} while the innermost held lock "
-                f"is {held[-1]!r} — wait() only releases its own lock"
+                f"blocking wait on {dotted(call.func) or last!r} under "
+                "the lock", None,
             )
-        # Event.wait / Popen.wait / future .result-ish waits
-        return f"blocking wait on {dotted(call.func) or last!r} under the lock"
-    if last == "join" and isinstance(call.func, ast.Attribute):
-        attr_base = call.func.value
-        is_thread = (
-            isinstance(attr_base, ast.Attribute)
-            and isinstance(attr_base.value, ast.Name)
-            and attr_base.value.id == "self"
-            and attr_base.attr in model.thread_attrs
-        ) or "thread" in base or "proc" in base or "worker" in base
-        if is_thread:
-            return "thread join under the lock (deadlocks if the joined " \
-                   "thread needs it)"
-        return None  # os.path.join and friends
-    if last in _SOCKET_OPS and any(s in base for s in _SOCKETISH):
-        return f"socket {last}() under the lock"
-    if last in {"device_get", "block_until_ready"}:
-        return "JAX host transfer under the lock (device sync latency)"
-    return None
+        if last == "join" and isinstance(call.func, ast.Attribute):
+            attr_base = call.func.value
+            is_thread = (
+                isinstance(attr_base, ast.Attribute)
+                and isinstance(attr_base.value, ast.Name)
+                and attr_base.value.id == "self"
+                and self.fi.cls is not None
+                and self.locks.thread_attr(self.fi.cls, attr_base.attr)
+            ) or "thread" in base or "proc" in base or "worker" in base
+            if is_thread:
+                return (
+                    "thread join under the lock (deadlocks if the "
+                    "joined thread needs it)", None,
+                )
+            return None
+        if last in _SOCKET_OPS and any(s in base for s in _SOCKETISH):
+            return f"socket {last}() under the lock", None
+        if last in {"device_get", "block_until_ready"}:
+            return "JAX host transfer under the lock (device sync " \
+                   "latency)", None
+        return None
 
-
-def _locks_acquired(model: ClassModel, fn) -> set:
-    """Lock ids a method acquires anywhere at its own level (not inside
-    nested defs) — the one-level interprocedural closure."""
-    out: set = set()
-
-    def walk(body):
-        for stmt in body:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.ClassDef)):
-                continue
-            if isinstance(stmt, (ast.With, ast.AsyncWith)):
-                for item in stmt.items:
-                    lid = model.is_lock_name(item.context_expr)
-                    if lid:
-                        out.add(lid)
-            for node in ast.walk(stmt):
-                if isinstance(node, ast.Call) and \
-                        isinstance(node.func, ast.Attribute) and \
-                        node.func.attr == "acquire":
-                    lid = model.is_lock_name(node.func.value)
-                    if lid:
-                        out.add(lid)
-            for field in ("body", "orelse", "finalbody"):
-                walk(getattr(stmt, field, []) or [])
-            for h in getattr(stmt, "handlers", []) or []:
-                walk(h.body)
-
-    walk(fn.body)
-    return out
-
-
-class _Scan:
-    """One class's scan state: acquisition edges and blocking sites."""
-
-    def __init__(self, sf, model):
-        self.sf = sf
-        self.model = model
-        self.edges: dict = {}       # (A, B) -> first acquisition node
-        self.blocking: list = []    # (held, node, reason)
-        self.self_calls: list = []  # (held, method name, node)
-
-    # -- expression scanning (one statement, nested stmts excluded) ----- #
+    # -- walking ------------------------------------------------------------- #
     def scan_expr(self, node, held):
         stack = [node]
         while stack:
             n = stack.pop()
-            if isinstance(n, (ast.Lambda, ast.FunctionDef,
-                              ast.AsyncFunctionDef)) or n is None:
+            if n is None or isinstance(
+                    n, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if isinstance(n, ast.Call):
+                hit = self._classify_blocking(n)
+                if hit is not None:
+                    self.block_any.append((n, hit[0], hit[1]))
+                    if held:
+                        reason, condref = hit
+                        if not (condref is not None
+                                and held and condref == held[-1]):
+                            self.blocking.append((held, n, reason))
                 if held:
-                    reason = _blocking_reason(n, self.model, held)
-                    if reason:
-                        self.blocking.append((held, n, reason))
-                    if (
-                        isinstance(n.func, ast.Attribute)
-                        and isinstance(n.func.value, ast.Name)
-                        and n.func.value.id == "self"
-                        and n.func.attr in self.model.methods
-                    ):
-                        self.self_calls.append((held, n.func.attr, n))
+                    self.calls.append((held, n))
                 if isinstance(n.func, ast.Attribute) and \
                         n.func.attr == "acquire":
-                    lid = self.model.is_lock_name(n.func.value)
-                    if lid:
+                    ref = self.lock_of(n.func.value)
+                    if ref:
+                        self.acquired.add(ref)
                         for h in held:
-                            self.edges.setdefault((h, lid), n)
+                            self.edges.append((h, ref, n))
             stack.extend(
                 c for c in ast.iter_child_nodes(n)
                 if not isinstance(c, ast.stmt)
@@ -189,24 +270,23 @@ class _Scan:
                     value, (ast.stmt, ast.ExceptHandler)):
                 self.scan_expr(value, held)
 
-    # -- statement walking with the held-lock stack --------------------- #
     def walk_body(self, body, held):
         for stmt in body:
             if isinstance(stmt, (ast.With, ast.AsyncWith)):
                 acquired = []
                 for item in stmt.items:
-                    lid = self.model.is_lock_name(item.context_expr)
-                    if lid is not None:
+                    ref = self.lock_of(item.context_expr)
+                    if ref is not None:
+                        self.acquired.add(ref)
                         for h in held:
-                            self.edges.setdefault((h, lid), item.context_expr)
-                        acquired.append(lid)
+                            self.edges.append((h, ref, item.context_expr))
+                        acquired.append(ref)
                     else:
                         self.scan_expr(item.context_expr, held)
                 self.walk_body(stmt.body, held + tuple(acquired))
             elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                    ast.ClassDef)):
-                # runs later on another stack: no locks held at entry
-                self.walk_body(stmt.body, ())
+                continue  # its own call-graph node, empty held at entry
             else:
                 self.scan_stmt_exprs(stmt, held)
                 for field in ("body", "orelse", "finalbody"):
@@ -214,58 +294,125 @@ class _Scan:
                 for h in getattr(stmt, "handlers", []) or []:
                     self.walk_body(h.body, held)
 
+    def walk(self) -> "_FnScan":
+        self.walk_body(self.fi.node.body, ())
+        return self
+
 
 def run(ctx: Context) -> list:
+    cg = CallGraph.of(ctx)
+    locks = _Locks(cg)
+    scans: dict = {}
+    for fid, fi in cg.functions.items():
+        scans[fid] = _FnScan(locks, fi, cg._local_types(fi)).walk()
+
+    # transitive acquired-locks closure over call/ctor edges (thread and
+    # callback edges excluded: those run on another stack)
+    acq = {fid: set(s.acquired) for fid, s in scans.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid in scans:
+            mine = acq[fid]
+            for e in cg.edges.get(fid, ()):
+                if e.kind not in ("call", "ctor"):
+                    continue
+                extra = acq.get(e.callee, ())
+                for ref in extra:
+                    if ref not in mine:
+                        mine.add(ref)
+                        changed = True
+
     findings: list = []
-    for sf in ctx.files:
-        for model in class_models(sf):
-            if not model.lock_attrs:
+    all_edges: dict = {}  # (a, b) -> (sf, node)
+
+    def callee_block(fid2, held, depth=_BLOCK_DEPTH, _seen=None):
+        """(via_fid, node, reason) of the first blocking op reachable in
+        fid2 within depth levels, caller-legality applied."""
+        if depth <= 0 or fid2 not in scans:
+            return None
+        if _seen is None:
+            _seen = set()
+        if fid2 in _seen:
+            return None
+        _seen.add(fid2)
+        for node, reason, condref in scans[fid2].block_any:
+            if condref is not None and held and condref == held[-1]:
+                continue  # split-helper wait on the caller's own cond
+            return (fid2, node, reason)
+        for e in cg.edges.get(fid2, ()):
+            if e.kind not in ("call", "ctor"):
                 continue
-            scan = _Scan(sf, model)
-            for fn in model.methods.values():
-                scan.walk_body(fn.body, ())
-            # one-level interprocedural closure: held + self.m() where m
-            # acquires more locks
-            acquired_by = {
-                name: _locks_acquired(model, fn)
-                for name, fn in model.methods.items()
-            }
-            for held, mname, node in scan.self_calls:
-                for lid in acquired_by.get(mname, ()):
+            hit = callee_block(e.callee, held, depth - 1, _seen)
+            if hit:
+                return hit
+        return None
+
+    for fid, scan in scans.items():
+        fi = cg.functions[fid]
+        sf = fi.sf
+        ctx_name = fi.cls.split(":")[-1] if fi.cls else fi.module
+        for held, node, reason in scan.blocking:
+            findings.append(sf.finding(
+                "lock-held-blocking", node,
+                f"[{ctx_name}] holding "
+                f"{', '.join(repr(_disp(h)) for h in held)}: {reason}",
+            ))
+        # resolved call sites under held locks: closure edges + blocking
+        by_node: dict = {}
+        for e in cg.edges.get(fid, ()):
+            if e.kind in ("call", "ctor"):
+                by_node.setdefault(id(e.node), []).append(e.callee)
+        reported_nodes: set = set()
+        for held, node in scan.calls:
+            for callee in by_node.get(id(node), ()):
+                for ref in acq.get(callee, ()):
                     for h in held:
-                        if h != lid:
-                            scan.edges.setdefault((h, lid), node)
-            # blocking findings
-            for held, node, reason in scan.blocking:
-                findings.append(sf.finding(
-                    "lock-held-blocking", node,
-                    f"[{model.name}] holding {', '.join(repr(h) for h in held)}: "
-                    f"{reason}",
-                ))
-            # cycle detection over the acquisition graph
-            adj: dict = {}
-            for (a, b) in scan.edges:
-                adj.setdefault(a, set()).add(b)
-
-            def reachable(src, dst):
-                seen, stack = set(), [src]
-                while stack:
-                    n = stack.pop()
-                    if n == dst:
-                        return True
-                    if n in seen:
-                        continue
-                    seen.add(n)
-                    stack.extend(adj.get(n, ()))
-                return False
-
-            for (a, b), node in sorted(
-                    scan.edges.items(), key=lambda kv: kv[1].lineno):
-                if a != b and reachable(b, a):
+                        if h != ref:
+                            all_edges.setdefault((h, ref), (sf, node))
+                if id(node) in reported_nodes:
+                    continue
+                hit = callee_block(callee, held)
+                if hit is not None:
+                    via_fid, bnode, reason = hit
+                    via = cg.functions[via_fid]
                     findings.append(sf.finding(
-                        "lock-order", node,
-                        f"[{model.name}] acquires {b!r} while holding "
-                        f"{a!r}, but the reverse order also exists — "
-                        "acquisition cycle; pick one canonical order",
+                        "lock-held-blocking", node,
+                        f"[{ctx_name}] holding "
+                        f"{', '.join(repr(_disp(h)) for h in held)}: "
+                        f"calls {via.name}() which blocks — {reason} "
+                        f"({via.sf.rel}:{bnode.lineno})",
                     ))
+                    reported_nodes.add(id(node))
+        for a, b, node in scan.edges:
+            if a != b:
+                all_edges.setdefault((a, b), (sf, node))
+
+    # global cycle detection over qualified lock refs
+    adj: dict = {}
+    for (a, b) in all_edges:
+        adj.setdefault(a, set()).add(b)
+
+    def reachable(src, dst):
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(adj.get(n, ()))
+        return False
+
+    for (a, b), (sf, node) in sorted(
+            all_edges.items(),
+            key=lambda kv: (kv[1][0].rel, kv[1][1].lineno)):
+        if reachable(b, a):
+            findings.append(sf.finding(
+                "lock-order", node,
+                f"acquires {_disp(b)!r} while holding {_disp(a)!r}, but "
+                "the reverse order also exists in the lock graph — "
+                "acquisition cycle; pick one canonical order",
+            ))
     return findings

@@ -1,0 +1,362 @@
+"""Resource lifecycle: threads, executors, files/sockets, manual locks.
+
+The leaked-FD-per-respawn and wedged-interpreter-exit family (PR 7's
+review found a log handle leaked per supervisor respawn; PR 10 left the
+BucketStore pool alive forever).  Four rules:
+
+``thread-unjoined``
+    A ``threading.Thread`` that is neither ``daemon=True`` nor ever
+    ``join()``ed.  Non-daemon threads block interpreter exit; undaemoned
+    *and* unjoined means shutdown depends on the thread noticing on its
+    own.  Self-attribute threads may be joined from any method of the
+    class (alias- and loop-aware: ``for t in (self._a, self._b):
+    t.join()`` counts); locals must be joined in the creating function
+    or escape to an owner that can.
+
+``executor-shutdown``
+    A ``ThreadPoolExecutor``/``ProcessPoolExecutor`` that is never
+    ``shutdown()`` and not used as a context manager: its workers
+    outlive the owner across respawns.
+
+``resource-leak``
+    A file/socket opened outside ``with`` that can exit the scope on
+    some path (early return, raise) without ``close()`` — the typestate
+    engine runs the same definite-only path analysis the protocol rules
+    use.
+
+``lock-manual-release``
+    A manual ``.acquire()`` (not a ``with`` block) whose ``release()``
+    is not guaranteed through a covering ``finally`` — one raised
+    exception and every other thread deadlocks on the orphaned lock.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, class_models, dotted
+from .rules_protocol import Engine, ProtocolSpec, release_guarded
+
+RULES = {
+    "thread-unjoined": (
+        "thread started but neither daemon=True nor ever joined"
+    ),
+    "executor-shutdown": (
+        "ThreadPoolExecutor/ProcessPoolExecutor never shut down"
+    ),
+    "resource-leak": (
+        "file/socket opened without `with` can leave scope unclosed on "
+        "some path"
+    ),
+    "lock-manual-release": (
+        "manual lock acquire() without a finally-guaranteed release()"
+    ),
+}
+
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+# files and sockets as typestate protocols: `with` is the blessed idiom,
+# a bare binding must reach close() on every path
+_FILE_SPEC = ProtocolSpec(
+    rule="resource-leak",
+    name="file-handle",
+    description=RULES["resource-leak"],
+    states=("open", "closed"),
+    initial="open",
+    ctors=frozenset({"open"}),
+    ctor_bare_only=True,
+    transitions={"close": {"open": "closed", "closed": "closed"}},
+    end_states=frozenset({"closed"}),
+    hints={},
+)
+_SOCKET_SPEC = ProtocolSpec(
+    rule="resource-leak",
+    name="socket",
+    description=RULES["resource-leak"],
+    states=("open", "closed"),
+    initial="open",
+    ctors=frozenset({"socket", "create_connection"}),
+    transitions={"close": {"open": "closed", "closed": "closed"}},
+    end_states=frozenset({"closed"}),
+    hints={},
+)
+
+
+def _kw(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_true(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _ctor_base(call) -> str:
+    name = dotted(call.func)
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _self_attr(expr):
+    """'attr' for a bare ``self.attr`` expression."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+# --------------------------------------------------------------------------- #
+# threads + executors: per-class/function ownership analysis
+# --------------------------------------------------------------------------- #
+def _attr_method_calls(tree, method: str) -> set:
+    """self-attrs on which ``.method()`` is called anywhere under tree —
+    directly, through a local alias (``t = self._thread; t.join()``),
+    or through a loop over a tuple/list of self-attrs."""
+    out: set = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        aliases: dict = {}  # local name -> set of self attrs
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                attr = _self_attr(node.value)
+                if attr:
+                    aliases.setdefault(node.targets[0].id, set()).add(attr)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Tuple) and \
+                    isinstance(node.value, ast.Tuple) and \
+                    len(node.targets[0].elts) == len(node.value.elts):
+                # `pool, self._pool = self._pool, None` swap idiom
+                for t, v in zip(node.targets[0].elts, node.value.elts):
+                    attr = _self_attr(v)
+                    if attr and isinstance(t, ast.Name):
+                        aliases.setdefault(t.id, set()).add(attr)
+            elif isinstance(node, ast.For) and \
+                    isinstance(node.target, ast.Name) and \
+                    isinstance(node.iter, (ast.Tuple, ast.List)):
+                for el in node.iter.elts:
+                    attr = _self_attr(el)
+                    if attr:
+                        aliases.setdefault(node.target.id, set()).add(attr)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == method):
+                continue
+            recv = node.func.value
+            attr = _self_attr(recv)
+            if attr:
+                out.add(attr)
+            elif isinstance(recv, ast.Name) and recv.id in aliases:
+                out.update(aliases[recv.id])
+    return out
+
+
+def _local_method_calls(fn, method: str) -> set:
+    """Local names on which ``.method()`` is called within fn."""
+    out: set = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == method
+            and isinstance(node.func.value, ast.Name)
+        ):
+            out.add(node.func.value.id)
+    return out
+
+
+def _local_escapes(fn, name: str, binder) -> bool:
+    """Does local ``name`` escape fn (returned, stored, appended,
+    passed along)?  An escaped handle has an owner elsewhere."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name) and n.id == name:
+                    return True
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id == name:
+                    return True
+        elif isinstance(node, ast.Call) and node is not binder:
+            recv_is_name = (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == name
+            )
+            if recv_is_name:
+                continue  # methods ON the handle are not escapes
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(a, ast.Name) and a.id == name:
+                    return True
+    return False
+
+
+def _enclosing_with_names(sf, call) -> bool:
+    """Is this ctor call a `with` context expression?"""
+    parent = sf.parent(call)
+    return isinstance(parent, ast.withitem) and parent.context_expr is call
+
+
+def _thread_and_executor_findings(sf) -> list:
+    if "Thread(" not in sf.text and "Executor(" not in sf.text:
+        return []
+    findings: list = []
+    for model in class_models(sf):
+        tree = model.node
+        joined_attrs = shutdown_attrs = None  # computed on first hit
+        for fname, fn in model.methods.items():
+            joined_locals = shutdown_locals = None
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                base = _ctor_base(node)
+                if base == "Thread":
+                    if _is_true(_kw(node, "daemon")):
+                        continue
+                    parent = sf.parent(node)
+                    attr = None
+                    local = None
+                    if isinstance(parent, ast.Assign):
+                        t = parent.targets[0]
+                        attr = _self_attr(t)
+                        if isinstance(t, ast.Name):
+                            local = t.id
+                    if attr is not None:
+                        if joined_attrs is None:
+                            joined_attrs = _attr_method_calls(tree, "join")
+                        if attr not in joined_attrs:
+                            findings.append(sf.finding(
+                                "thread-unjoined", node,
+                                f"[{model.name}] self.{attr} is a "
+                                "non-daemon Thread never joined anywhere "
+                                "in the class — join it on the shutdown "
+                                "path or mark daemon=True",
+                            ))
+                    elif local is not None:
+                        if joined_locals is None:
+                            joined_locals = _local_method_calls(fn, "join")
+                        if local in joined_locals or \
+                                _local_escapes(fn, local, node):
+                            continue
+                        findings.append(sf.finding(
+                            "thread-unjoined", node,
+                            f"[{model.name}.{fname}] thread {local!r} is "
+                            "non-daemon and never joined in this "
+                            "function — join it or mark daemon=True",
+                        ))
+                    else:
+                        # Thread(...).start() with no handle at all
+                        findings.append(sf.finding(
+                            "thread-unjoined", node,
+                            f"[{model.name}.{fname}] non-daemon Thread "
+                            "started without keeping a handle — it can "
+                            "never be joined; mark daemon=True or bind it",
+                        ))
+                elif base in _EXECUTOR_CTORS:
+                    if _enclosing_with_names(sf, node):
+                        continue
+                    parent = sf.parent(node)
+                    attr = None
+                    local = None
+                    if isinstance(parent, ast.Assign):
+                        t = parent.targets[0]
+                        attr = _self_attr(t)
+                        if isinstance(t, ast.Name):
+                            local = t.id
+                    if shutdown_attrs is None:
+                        shutdown_attrs = _attr_method_calls(
+                            tree, "shutdown")
+                    if shutdown_locals is None:
+                        shutdown_locals = _local_method_calls(
+                            fn, "shutdown")
+                    if attr is not None and attr not in shutdown_attrs:
+                        findings.append(sf.finding(
+                            "executor-shutdown", node,
+                            f"[{model.name}] self.{attr} "
+                            f"({base}) is never shut down anywhere in "
+                            "the class — its workers outlive the owner; "
+                            "add a close()/shutdown() on the teardown "
+                            "path",
+                        ))
+                    elif local is not None and \
+                            local not in shutdown_locals and \
+                            not _local_escapes(fn, local, node):
+                        findings.append(sf.finding(
+                            "executor-shutdown", node,
+                            f"[{model.name}.{fname}] {base} {local!r} is "
+                            "never shut down — use `with` or call "
+                            "shutdown()",
+                        ))
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# manual lock acquire/release
+# --------------------------------------------------------------------------- #
+_LOCKISH = ("lock", "_lk", "mutex", "cv", "cond", "sem")
+
+
+def _lock_acquire_findings(sf) -> list:
+    if ".acquire(" not in sf.text:
+        return []
+    findings: list = []
+    for model in class_models(sf):
+        for fname, fn in model.methods.items():
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                ):
+                    continue
+                recv = node.func.value
+                recv_text = dotted(recv)
+                lockish = model.is_lock_name(recv) is not None or any(
+                    t in recv_text.lower() for t in _LOCKISH
+                )
+                if not lockish:
+                    continue
+
+                def match_release(n, _txt=recv_text):
+                    return (
+                        isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "release"
+                        and dotted(n.func.value) == _txt
+                    )
+
+                if release_guarded(sf, fn, node, match_release):
+                    continue
+                has_release = any(
+                    isinstance(n, ast.Call) and match_release(n)
+                    for n in ast.walk(fn)
+                )
+                detail = (
+                    "its release() is not inside a finally covering this "
+                    "acquire — one exception orphans the lock"
+                    if has_release else
+                    "no matching release() in this function — use "
+                    "`with`, or release in a finally"
+                )
+                findings.append(sf.finding(
+                    "lock-manual-release", node,
+                    f"[{model.name}.{fname}] manual {recv_text}."
+                    f"acquire(): {detail}",
+                ))
+    return findings
+
+
+def run(ctx: Context) -> list:
+    findings: list = []
+    for sf in ctx.files:
+        findings.extend(_thread_and_executor_findings(sf))
+        findings.extend(_lock_acquire_findings(sf))
+    findings.extend(Engine(ctx, [_FILE_SPEC, _SOCKET_SPEC]).run())
+    return findings
