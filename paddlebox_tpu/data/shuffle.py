@@ -346,6 +346,15 @@ class TcpShuffler:
         faults.inject("shuffle.exchange")  # chaos site: raise or hang
         rnd = self._round
         self._round += 1
+        # collective digest (see KvChannel.allgather): recorded before the
+        # sends so a wedged round still names (channel, seq, worker) in
+        # this worker's flight dump for the doctor's cross-rank check
+        from paddlebox_tpu.telemetry import flight
+
+        flight.record(
+            "collective", "shuffle.exchange",
+            channel="shuffle", seq=rnd, op="exchange", rank=self.worker_id,
+        )
         dest = route_ids(block, self.n_workers, self.mode, self.seed)
         parts = split_by_route(block, dest, self.n_workers)
         own = parts[self.worker_id]

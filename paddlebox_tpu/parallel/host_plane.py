@@ -166,6 +166,17 @@ class KvChannel:
         client = _client()
         s = self._seq
         self._seq += 1
+        # per-rank (channel, seq, op) collective digest into the flight
+        # ring BEFORE the wait: if this gather wedges, every rank's dump
+        # shows exactly which sequence it reached on which channel, and
+        # pbox_doctor's cross-rank check names the first divergence —
+        # the runtime witness for the spmd-* static rules
+        from paddlebox_tpu.telemetry import flight
+
+        flight.record(
+            "collective", "hostplane.allgather",
+            channel=self.name, seq=s, op="allgather", rank=self._rank,
+        )
         client.key_value_set(
             self._key(s, self._rank),
             base64.b64encode(x.tobytes()).decode("ascii"),

@@ -1242,3 +1242,458 @@ def test_full_run_wall_time_budget():
     elapsed = _time.monotonic() - t0
     assert r.returncode == 0, f"repo not clean:\n{r.stdout}"
     assert elapsed <= 5.0, f"pbox-lint --all took {elapsed:.2f}s (> 5s)"
+
+
+# --------------------------------------------------------------------------- #
+# SPMD safety (rules_spmd.py + spmd_catalog.py)
+# --------------------------------------------------------------------------- #
+from pbox_analyze import rules_spmd  # noqa: E402
+
+#: mirrors sharded_table.begin_pass with the census gather moved inside a
+#: rank guard — the seeded-bug shape from the acceptance criteria
+SPMD_SEEDED_BUG = """\
+    import jax
+
+
+    class ShardedTable:
+        def begin_pass(self, pass_keys):
+            if jax.process_index() == 0:
+                self.chan.allgather(pass_keys)
+            self.live = True
+"""
+
+
+def test_spmd_rank_divergence_bad(tmp_path):
+    findings = _run(rules_spmd, tmp_path, SPMD_SEEDED_BUG)
+    rules = {f.rule for f in findings}
+    assert "spmd-rank-divergence" in rules
+    assert "spmd-collective-sequence" in rules
+    div = [f for f in findings if f.rule == "spmd-rank-divergence"]
+    assert div[0].line == 7  # the allgather call
+    seq = [f for f in findings if f.rule == "spmd-collective-sequence"]
+    assert seq[0].line == 6  # the rank-conditional branch
+
+
+def test_spmd_rank_divergence_early_return(tmp_path):
+    src = """\
+        import jax
+
+        def export(table, x):
+            if jax.process_index() != 0:
+                return None
+            return host_allgather(x)
+    """
+    findings = _run(rules_spmd, tmp_path, src)
+    assert any(f.rule == "spmd-rank-divergence" and f.line == 6
+               for f in findings)
+
+
+def test_spmd_rank_divergence_through_callee(tmp_path):
+    src = """\
+        import jax
+
+        def helper(chan, x):
+            chan.allgather(x)
+
+        def drive(chan, x):
+            if jax.process_index() == 0:
+                helper(chan, x)
+    """
+    findings = _run(rules_spmd, tmp_path, src)
+    assert any(f.rule == "spmd-rank-divergence" and "helper" in f.message
+               for f in findings)
+
+
+def test_spmd_rank_divergence_env_seed(tmp_path):
+    src = """\
+        import os
+
+        def drive(chan, x):
+            if os.environ.get("PBOX_PROCESS_ID", "0") == "0":
+                chan.allgather(x)
+    """
+    findings = _run(rules_spmd, tmp_path, src)
+    assert any(f.rule == "spmd-rank-divergence" for f in findings)
+
+
+def test_spmd_rank_guarded_side_effects_are_legal(tmp_path):
+    # the donefile-write / rank-0 log-line / rank-label family: rank used
+    # for non-collective work produces ZERO findings, no suppressions
+    src = """\
+        import jax
+
+        def publish(entry, path):
+            if jax.process_index() == 0:
+                with open(path, "w") as fh:
+                    fh.write(entry)
+
+        def banner(merged):
+            if jax.process_index() == 0:
+                print(merged, flush=True)
+
+        def dump_suffix(multiproc):
+            return f"-r{jax.process_index()}" if multiproc else ""
+    """
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_spmd_all_paths_raise_branch_is_legal(tmp_path):
+    src = """\
+        import jax
+
+        def validate(mesh, x):
+            pid = jax.process_index()
+            if pid >= mesh:
+                raise RuntimeError("bad layout")
+            return host_allgather(x)
+    """
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_spmd_uniform_world_gate_is_legal(tmp_path):
+    # process_count is the same value on every rank — the standard
+    # `if is_multiprocess():` gate must never fire the rule
+    src = """\
+        import jax
+
+        def gather(x):
+            if jax.process_count() > 1:
+                return host_allgather(x)
+            return x
+    """
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_spmd_watchdog_peer_loop_shape_is_legal(tmp_path):
+    # watchdog._check_peers: `if rank == self.rank: continue` guards only
+    # non-collective abort bookkeeping (watchdog.py:488 acceptance shape)
+    src = """\
+        class W:
+            def check_peers(self, now):
+                for rank in range(self.world):
+                    if rank == self.rank:
+                        continue
+                    self.observe(rank, now)
+
+            def observe(self, rank, now):
+                self.seen[rank] = now
+    """
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_spmd_rank_divergence_suppressed(tmp_path):
+    src = SPMD_SEEDED_BUG.replace(
+        "self.chan.allgather(pass_keys)",
+        "# pbox-lint: ignore[spmd-rank-divergence, spmd-collective-sequence]"
+        " fixture reason\n"
+        "            self.chan.allgather(pass_keys)",
+    )
+    # the sequence finding lands on the `if` line; suppress it there too
+    src = src.replace(
+        "if jax.process_index() == 0:",
+        "if jax.process_index() == 0:"
+        "  # pbox-lint: ignore[spmd-collective-sequence] fixture reason",
+    )
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_spmd_sequence_order_swap(tmp_path):
+    # both arms gather on both channels but in opposite order: sequence
+    # divergence WITHOUT rank-divergence (nothing is skipped)
+    src = """\
+        import jax
+
+        def plan(a, b, x):
+            rank = jax.process_index()
+            if rank % 2 == 0:
+                a.allgather(x)
+                b.allgather(x)
+            else:
+                b.allgather(x)
+                a.allgather(x)
+    """
+    findings = _run(rules_spmd, tmp_path, src)
+    assert [f.rule for f in findings] == ["spmd-collective-sequence"]
+    assert findings[0].line == 5
+
+
+def test_spmd_sequence_loop_continue_skip(tmp_path):
+    src = """\
+        def drain(chan, items, rank):
+            for it in items:
+                if it.owner == rank:
+                    continue
+                chan.allgather(it)
+    """
+    findings = _run(rules_spmd, tmp_path, src)
+    assert any(f.rule == "spmd-collective-sequence" for f in findings)
+    assert any(f.rule == "spmd-rank-divergence" and f.line == 5
+               for f in findings)
+
+
+def test_spmd_sequence_same_both_arms_is_legal(tmp_path):
+    src = """\
+        import jax
+
+        def plan(chan, x, rank):
+            if rank == 0:
+                y = chan.allgather(x)
+            else:
+                y = chan.allgather(x)
+            return y
+    """
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_spmd_collective_on_thread_bad(tmp_path):
+    src = """\
+        import threading
+
+        class Stager:
+            def start(self):
+                self._t = threading.Thread(target=self._stage, daemon=True)
+                self._t.start()
+
+            def _stage(self):
+                host_allgather_varlen(self.keys)
+    """
+    findings = _run(rules_spmd, tmp_path, src)
+    assert [f.rule for f in findings] == ["spmd-collective-on-thread"]
+    assert findings[0].line == 5  # the Thread(...) edge
+    assert "host_allgather_varlen" in findings[0].message
+
+
+def test_spmd_collective_on_executor_submit(tmp_path):
+    src = """\
+        class Stager:
+            def kick(self):
+                self._pool.submit(self._job)
+
+            def _job(self):
+                host_allgather(self.keys)
+    """
+    findings = _run(rules_spmd, tmp_path, src)
+    assert [f.rule for f in findings] == ["spmd-collective-on-thread"]
+
+
+def test_spmd_kvchannel_on_thread_is_legal(tmp_path):
+    # KvChannel.allgather exists precisely to run off-thread (the
+    # feed-producer plans concurrently with the device step)
+    src = """\
+        import threading
+
+        class Producer:
+            def start(self):
+                self._t = threading.Thread(target=self._plan, daemon=True)
+                self._t.start()
+
+            def _plan(self):
+                self.chan.allgather(self.keys)
+    """
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_spmd_collective_on_thread_suppressed(tmp_path):
+    src = """\
+        import threading
+
+        class Stager:
+            def start(self):
+                # pbox-lint: ignore[spmd-collective-on-thread] fixture
+                self._t = threading.Thread(target=self._stage, daemon=True)
+                self._t.start()
+
+            def _stage(self):
+                host_allgather_varlen(self.keys)
+    """
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_spmd_mesh_axis_unbound(tmp_path):
+    src = """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            return jax.lax.psum(x, "seq")
+
+        def outer(x):
+            sm = shard_map(body, in_specs=("s",), out_specs=None,
+                           axis_names={"expert"})
+            return sm(x)
+    """
+    findings = _run(rules_spmd, tmp_path, src)
+    assert [f.rule for f in findings] == ["spmd-mesh-axis"]
+    assert findings[0].line == 5
+    assert "'seq'" in findings[0].message
+
+
+def test_spmd_mesh_axis_bound_through_constant_and_default(tmp_path):
+    # EXPERT_AXIS-style module constant flows through the param default
+    # and the axis_names set literal — the composed-mesh idiom
+    src = """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        EXPERT_AXIS = "expert"
+
+        def mix(h, axis_name=EXPERT_AXIS):
+            return jax.lax.psum(h, axis_name)
+
+        def body(h):
+            return mix(h)
+
+        def outer(h):
+            sm = shard_map(body, in_specs=("s",), out_specs=None,
+                           axis_names={EXPERT_AXIS})
+            return sm(h)
+    """
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_spmd_mesh_axis_unknown_mesh_is_conservative(tmp_path):
+    src = """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            return jax.lax.psum(x, "anything")
+
+        def outer(self, x):
+            sm = shard_map(body, mesh=self.mesh, in_specs=("s",),
+                           out_specs=None)
+            return sm(x)
+    """
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_spmd_mesh_axis_in_specs_arity(tmp_path):
+    src = """\
+        from jax.experimental.shard_map import shard_map
+
+        def body(a, b):
+            return a + b
+
+        def outer(mesh, a, b):
+            sm = shard_map(body, mesh=mesh, in_specs=("x", "y", "z"),
+                           out_specs=None)
+            return sm(a, b)
+    """
+    findings = _run(rules_spmd, tmp_path, src)
+    assert [f.rule for f in findings] == ["spmd-mesh-axis"]
+    assert "3 entr" in findings[0].message
+
+    good = src.replace('("x", "y", "z")', '("x", "y")')
+    assert _run(rules_spmd, tmp_path, good) == []
+
+
+def test_spmd_mesh_axis_suppressed(tmp_path):
+    src = """\
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        def body(x):
+            # pbox-lint: ignore[spmd-mesh-axis] fixture reason
+            return jax.lax.psum(x, "seq")
+
+        def outer(x):
+            sm = shard_map(body, in_specs=("s",), out_specs=None,
+                           axis_names={"expert"})
+            return sm(x)
+    """
+    assert _run(rules_spmd, tmp_path, src) == []
+
+
+def test_cli_names_spmd_rules_on_seeded_regression(tmp_path):
+    """Acceptance scenario: the seeded begin_pass bug is flagged by BOTH
+    spmd-rank-divergence and spmd-collective-sequence, naming file+line."""
+    bad = tmp_path / "regress.py"
+    bad.write_text(textwrap.dedent(SPMD_SEEDED_BUG))
+    r = subprocess.run(
+        [sys.executable, CLI, str(bad), "--rules", "spmd-*"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "spmd-rank-divergence" in r.stdout
+    assert "spmd-collective-sequence" in r.stdout
+    assert "regress.py:7" in r.stdout  # the moved allgather
+    assert "regress.py:6" in r.stdout  # the rank-conditional branch
+
+
+def test_cli_rules_glob_selects_spmd_family(tmp_path):
+    bad = tmp_path / "regress.py"
+    bad.write_text(
+        "import time\n"
+        "deadline = time.time() + 10.0\n"
+    )
+    # the glob selects only the spmd family: the clock regression is NOT
+    # reported under --rules spmd-*
+    r = subprocess.run(
+        [sys.executable, CLI, str(bad), "--rules", "spmd-*"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stdout
+    r = subprocess.run(
+        [sys.executable, CLI, "--rules", "nope-*"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 2
+
+
+def test_spmd_rules_listed():
+    r = subprocess.run(
+        [sys.executable, CLI, "--list-rules"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0
+    for rule in ("spmd-rank-divergence", "spmd-collective-sequence",
+                 "spmd-collective-on-thread", "spmd-mesh-axis"):
+        assert rule in r.stdout
+
+
+def test_spmd_repo_is_clean_without_suppressions():
+    """The acceptance bar: the four SPMD rules over the default roots
+    produce zero findings AND zero spmd suppressions were needed at the
+    existing rank-guarded non-collective sites (donefile writes, rank-0
+    log lines, watchdog.py peer loop)."""
+    r = subprocess.run(
+        [sys.executable, CLI, "--all", "--rules", "spmd-*", "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout
+    assert json.loads(r.stdout) == []
+    # no inline spmd ignores anywhere in the analyzed roots
+    for root in ("paddlebox_tpu", "tools"):
+        for d, _, fs in os.walk(os.path.join(REPO, root)):
+            for f in fs:
+                if not f.endswith(".py"):
+                    continue
+                with open(os.path.join(d, f), encoding="utf-8") as fh:
+                    assert "ignore[spmd" not in fh.read(), (
+                        f"unexpected spmd suppression in {d}/{f}"
+                    )
+
+
+def test_wrapper_cli_contract_survives_context_fields():
+    """The five thin tools/check_*.py wrappers monkeypatch-import the
+    framework: their module APIs and the Context surface they ride on
+    must survive new fields (here: Context.caches for the SPMD memos)."""
+    from pbox_analyze.core import Context as _Ctx
+
+    ctx = _Ctx(paths=[CLI])
+    assert hasattr(ctx, "caches") and isinstance(ctx.caches, dict)
+    assert hasattr(ctx, "files") and hasattr(ctx, "by_rel")
+
+    import check_env_flags
+    import check_fault_sites
+    import check_metric_names
+    import check_publish_dir
+    import check_span_names
+
+    assert callable(check_metric_names.scan_sources)
+    assert callable(check_metric_names.catalog_patterns)
+    assert isinstance(check_metric_names.scan_sources(), dict)
+    assert callable(check_span_names.scan_sources)
+    assert callable(check_env_flags.main)
+    assert callable(check_fault_sites.main)
+    assert callable(check_publish_dir.main)

@@ -346,6 +346,67 @@ def test_doctor_names_who_stalled_first(tmp_path):
     assert "STALLED FIRST" in _doctor().format_summary(report)
 
 
+def _coll(rank, channel, seq, op="allgather"):
+    return {"t": 10.0 + seq, "kind": "collective",
+            "name": "hostplane.allgather", "channel": channel,
+            "seq": seq, "op": op, "rank": rank}
+
+
+def test_doctor_names_first_collective_divergence(tmp_path):
+    # rank 1 skipped seq 2 on channel plan-7 (it has seq 3): the exact
+    # hang spmd-rank-divergence catches statically, reconstructed from
+    # production dumps
+    _write_dump(tmp_path, "flight-trainer-r0-pid10-stall-1.json",
+                rank=0, pid=10,
+                ring=[_coll(0, "plan-7", s) for s in range(4)])
+    _write_dump(tmp_path, "flight-trainer-r1-pid11-stall-2.json",
+                rank=1, pid=11,
+                ring=[_coll(1, "plan-7", s) for s in (0, 1, 3)])
+    report = _doctor().analyze(str(tmp_path))
+    first = report["collectives"]["first"]
+    assert first["rank"] == 1
+    assert first["channel"] == "plan-7"
+    assert first["seq"] == 2
+    assert first["kind"] == "skipped"
+    summary = _doctor().format_summary(report)
+    assert "COLLECTIVE DIVERGENCE" in summary
+    assert "rank 1" in summary and "'plan-7'" in summary and "seq 2" in summary
+
+
+def test_doctor_collective_op_mismatch_and_laggard(tmp_path):
+    # channel a: rank 2 issued a DIFFERENT op at seq 1; channel b: rank 0
+    # simply stopped at seq 0 while peers reached 2 (the wedged rank)
+    _write_dump(tmp_path, "flight-t-r0-pid20-stall-1.json", rank=0, pid=20,
+                ring=[_coll(0, "a", 0), _coll(0, "a", 1),
+                      _coll(0, "b", 0, op="exchange")])
+    _write_dump(tmp_path, "flight-t-r1-pid21-stall-2.json", rank=1, pid=21,
+                ring=[_coll(1, "a", 0), _coll(1, "a", 1)]
+                + [_coll(1, "b", s, op="exchange") for s in range(3)])
+    _write_dump(tmp_path, "flight-t-r2-pid22-stall-3.json", rank=2, pid=22,
+                ring=[_coll(2, "a", 0), _coll(2, "a", 1, op="exchange")]
+                + [_coll(2, "b", s, op="exchange") for s in range(3)])
+    report = _doctor().analyze(str(tmp_path))
+    divs = {d["channel"]: d for d in report["collectives"]["divergences"]}
+    assert divs["a"]["kind"] == "op-mismatch" and divs["a"]["rank"] == 2
+    assert divs["a"]["seq"] == 1
+    assert divs["b"]["kind"] == "behind" and divs["b"]["rank"] == 0
+    # first divergence overall: lowest seq wins
+    assert report["collectives"]["first"]["channel"] == "a"
+
+
+def test_doctor_collectives_clean_and_ring_truncation(tmp_path):
+    # matching digests -> no divergence; rank 1's ring evicted seq 0
+    # (history lost, not a skip) -> still no divergence
+    _write_dump(tmp_path, "flight-t-r0-pid30-stall-1.json", rank=0, pid=30,
+                ring=[_coll(0, "c", s) for s in range(3)])
+    _write_dump(tmp_path, "flight-t-r1-pid31-stall-2.json", rank=1, pid=31,
+                ring=[_coll(1, "c", s) for s in (1, 2)])
+    report = _doctor().analyze(str(tmp_path))
+    assert report["collectives"]["divergences"] == []
+    assert report["collectives"]["first"] is None
+    assert "COLLECTIVE DIVERGENCE" not in _doctor().format_summary(report)
+
+
 def test_doctor_lineage_lag_from_donefile_and_events(tmp_path):
     os.makedirs(tmp_path / "pub")
     with open(tmp_path / "pub" / "donefile.txt", "w") as fh:

@@ -187,6 +187,29 @@ def test_tcp_exchange():
 # --------------------------------------------------------------------------- #
 # tcp transport robustness (distributed-liveness tier)
 # --------------------------------------------------------------------------- #
+def test_tcp_exchange_records_collective_digest():
+    """Each exchange round leaves a (channel, seq, op) digest in the
+    flight ring (the pbox_doctor cross-rank witness).  A single-worker
+    shuffler exchanges with nobody but still stamps its round."""
+    from paddlebox_tpu.telemetry import flight
+
+    rec = flight.reset_for_tests()
+    s = TcpShuffler([("127.0.0.1", 0)], 0, timeout=1.0)
+    try:
+        for _ in range(2):
+            s.exchange(_block(seed=3))
+    finally:
+        s.close()
+        digests = [
+            r for r in rec.snapshot()
+            if r["kind"] == "collective" and r.get("channel") == "shuffle"
+        ]
+        flight.reset_for_tests()
+    assert [(d["seq"], d["op"], d["rank"]) for d in digests] == [
+        (0, "exchange", 0), (1, "exchange", 0),
+    ]
+
+
 def test_tcp_close_idempotent():
     s = TcpShuffler([("127.0.0.1", 0)], 0)
     s.start()

@@ -359,6 +359,33 @@ def test_kvchannel_completes_when_peers_answer(fake_world):
     ch.close()
 
 
+def test_kvchannel_records_collective_digest(fake_world):
+    """Every allgather leaves a (channel, seq, op) digest in the flight
+    ring — the runtime witness pbox_doctor's cross-rank check consumes."""
+    import base64
+
+    from paddlebox_tpu.telemetry import flight
+
+    rec = flight.reset_for_tests()
+    ch = host_plane.KvChannel("plan-w", timeout_s=2.0)
+    ch.POLL_S = 0.05
+    for s in range(2):
+        for r in (1, 2):
+            fake_world.store[f"pbox_hp/plan-w/{s}/{r}"] = (
+                base64.b64encode(np.asarray([r], np.int64).tobytes()).decode()
+            )
+        ch.allgather(np.asarray([0], dtype=np.int64))
+    digests = [
+        r for r in rec.snapshot()
+        if r["kind"] == "collective" and r.get("channel") == "plan-w"
+    ]
+    assert [(d["seq"], d["op"], d["rank"]) for d in digests] == [
+        (0, "allgather", 0), (1, "allgather", 0),
+    ]
+    ch.close()
+    flight.reset_for_tests()
+
+
 def test_kvchannel_wait_interrupted_by_watchdog_abort(fake_world):
     wd = Watchdog(FAST, rank=0, world=1).start()
     ch = host_plane.KvChannel("plan-9", timeout_s=30.0)

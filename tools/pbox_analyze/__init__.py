@@ -28,6 +28,9 @@ Layout:
   rules_drift.py   metric-name-drift, fault-site-drift, env-flag-drift,
                    span-name-drift (legacy function APIs preserved for
                    the tools/check_*.py thin wrappers)
+  rules_spmd.py    spmd-rank-divergence, spmd-collective-sequence,
+                   spmd-collective-on-thread, spmd-mesh-axis (catalog in
+                   spmd_catalog.py)
   publish.py       publish-dir (per-root, opt-in via --publish-root)
   cli.py           ``python tools/pbox_analyze.py --all --json ...``
 
@@ -47,6 +50,7 @@ from . import (  # noqa: F401
     rules_locks,
     rules_protocol,
     rules_resources,
+    rules_spmd,
     rules_threads,
     rules_tracer,
 )
@@ -59,6 +63,7 @@ PASS_MODULES = [
     rules_threads,
     rules_protocol,
     rules_resources,
+    rules_spmd,
     rules_except,
     rules_clock,
     rules_tracer,

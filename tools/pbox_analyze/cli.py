@@ -21,6 +21,7 @@ Modes:
 from __future__ import annotations
 
 import argparse
+import fnmatch
 import json
 import os
 import re
@@ -126,8 +127,23 @@ def main(argv=None) -> int:
 
     rules = None
     if args.rules:
-        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
-        unknown = rules - set(rules_catalog)
+        # globs select rule families: --rules 'spmd-*' runs the four
+        # SPMD passes, --rules 'protocol-*' the typestate specs
+        requested = {r.strip() for r in args.rules.split(",") if r.strip()}
+        rules = set()
+        unknown = set()
+        for pat in requested:
+            if any(ch in pat for ch in "*?["):
+                hits = {r for r in rules_catalog
+                        if fnmatch.fnmatchcase(r, pat)}
+                if hits:
+                    rules |= hits
+                else:
+                    unknown.add(pat)
+            elif pat in rules_catalog:
+                rules.add(pat)
+            else:
+                unknown.add(pat)
         if unknown:
             print(f"ERROR: unknown rule(s): {', '.join(sorted(unknown))} "
                   f"(see --list-rules)", file=sys.stderr)

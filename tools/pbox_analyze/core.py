@@ -127,6 +127,11 @@ class Context:
             paths = discover_files(repo, DEFAULT_ROOTS)
         self.files = [SourceFile(p, repo) for p in sorted(paths)]
         self.by_rel = {sf.rel: sf for sf in self.files}
+        # scratch space for pass-private memos (rank-taint tables,
+        # collective-sequence summaries, ...) so interprocedural passes
+        # stay inside the wall-time budget without new attributes per
+        # pass.  Passes key by their own module name.
+        self.caches: dict = {}
 
     def parse_errors(self) -> list:
         return [

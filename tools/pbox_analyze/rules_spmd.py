@@ -1,0 +1,857 @@
+"""SPMD safety: rank-divergence, collective-sequence, collective-on-thread
+and mesh-axis analysis for the multi-host plane.
+
+The dominant multi-host failure mode is the silent SPMD hang: one rank
+skips or reorders a collective and the fleet wedges until the watchdog
+aborts.  The invariants exist as comments — "every peer must allgather
+the same number of times in the same logical order"
+(parallel/host_plane.py:110), "the census allgather is a collective that
+must run on the main thread" (parallel/sharded_table.py:228) — and these
+four rules machine-check them on top of the PR-11 call graph:
+
+``spmd-rank-divergence``
+    Taint analysis seeded from ``jax.process_index()``/``axis_index()``,
+    rank/pid-named parameters and attributes, and rank-shaped env reads
+    (the catalog in :mod:`spmd_catalog`).  A collective — directly, or
+    through a resolved project call whose summary performs one — under
+    control flow conditioned on a rank-tainted value is flagged: some
+    ranks skip it and the peers wedge.  Recognized-legal escapes: rank
+    used only for labels/logging/slicing (taint that never reaches a
+    branch over a collective is free), ``rank == 0``-guarded
+    NON-collective side effects (donefile writes, log lines), and
+    branches whose rank-conditional arm raises on every path (the raise
+    is loud; the surviving ranks all still run the collective).
+    ``process_count()``/world conditions are rank-UNIFORM (same value on
+    every rank) — the ``if is_multiprocess():`` gate never fires this.
+
+``spmd-collective-sequence``
+    A path-sensitive abstraction of each function's ordered collective
+    sequence (channel identities included), joined at branches and
+    propagated through callee summaries.  Two branch arms — or a loop
+    iteration's ``continue``/``break`` path vs its fall-through — that
+    emit different collective sequences are flagged unless the branch
+    condition is provably rank-uniform (not rank-tainted).  This is the
+    machine check for host_plane.py:110: same count, same order, on
+    every rank.
+
+``spmd-collective-on-thread``
+    Collectives reachable through the call graph's thread-kinded edges
+    (``Thread(target=...)``, the staging/merge executor ``submit``s)
+    that are NOT host-side thread-tolerant (see the catalog) are errors:
+    two threads enqueueing device collectives in racing order across
+    processes is a cross-process deadlock — sharded_table.py:228
+    enforced.  ``KvChannel.allgather`` and ``TcpShuffler.exchange`` are
+    exempt by design; they exist precisely to run off-thread.
+
+``spmd-mesh-axis``
+    ``axis_name`` arguments to ``psum``/``pmean``/``ppermute``/
+    ``axis_index``/... must be bound by an enclosing ``shard_map``/
+    ``Mesh`` axis in some reachable caller (the composed
+    data x expert x seq meshes are the motivating surface), plus
+    in_specs-arity-vs-body-params checks at shard_map sites.  Axis names
+    resolve through parameter defaults and module constants
+    (``EXPERT_AXIS``/``SEQ_AXIS``/``DATA_AXIS``); a site whose mesh
+    cannot be resolved binds everything (conservative — missed findings,
+    never false ones).
+
+All summaries (rank taint, per-function collective sequences, bound
+axes) are memoized per function on the Context so a full ``--all`` run
+stays inside the 5s tier-1 wall-time budget.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import CallGraph
+from .core import Context, dotted
+from .spmd_catalog import (
+    AXIS_CONSUMERS,
+    DEVICE_COLLECTIVES,
+    FUNCTION_COLLECTIVES,
+    METHOD_COLLECTIVES,
+    RANK_ATTRS,
+    RANK_CALLS,
+    RANK_ENV_RE,
+    RANK_PARAMS,
+)
+
+RULES = {
+    "spmd-rank-divergence": (
+        "collective reachable under rank-conditional control flow — some "
+        "ranks skip it and the peers wedge (host_plane.py:110)"
+    ),
+    "spmd-collective-sequence": (
+        "branch arms / loop paths emit different collective sequences "
+        "under a condition not provably rank-uniform"
+    ),
+    "spmd-collective-on-thread": (
+        "device-entangled collective reachable through a Thread/executor "
+        "edge — collectives run on the main thread in lockstep "
+        "(sharded_table.py:228)"
+    ),
+    "spmd-mesh-axis": (
+        "collective axis_name not bound by any reaching shard_map/Mesh, "
+        "or shard_map in_specs arity vs body params mismatch"
+    ),
+}
+
+_SUMMARY_CAP = 12   # identities kept per function summary
+_TERMINAL = ("return", "raise", "continue", "break")
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+class _Event:
+    """One collective occurrence: identity (channel-qualified op), the
+    call node it fires at, and the spec / via-callee for messages."""
+
+    __slots__ = ("identity", "node", "spec", "via")
+
+    def __init__(self, identity, node, spec=None, via=None):
+        self.identity = identity
+        self.node = node
+        self.spec = spec
+        self.via = via  # callee func id when through a summary
+
+
+class Spmd:
+    """Shared analysis state for one Context (built once, memoized)."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.cg = CallGraph.of(ctx)
+        self._taint: dict = {}       # fid -> frozenset(tainted names)
+        self._summary: dict = {}     # fid -> tuple(identity, ...)
+        self._inprog: set = set()
+        self._direct: dict = {}      # fid -> [(identity, spec, node)]
+        self._reach: set | None = None
+
+    @classmethod
+    def of(cls, ctx: Context) -> "Spmd":
+        inst = getattr(ctx, "_spmd", None)
+        if inst is None:
+            inst = cls(ctx)
+            ctx._spmd = inst
+        return inst
+
+    # -- collective classification ----------------------------------------- #
+    def _receiver_class_names(self, fi, recv) -> set | None:
+        """Names along the project MRO of the receiver expression's class,
+        or None when the receiver does not resolve."""
+        cid = None
+        if isinstance(recv, ast.Name):
+            if recv.id in ("self", "cls") and fi.cls:
+                cid = fi.cls
+            else:
+                cid = self.cg._local_types(fi).get(recv.id)
+        elif (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and fi.cls
+        ):
+            cid = self.cg.attr_type(fi.cls, recv.attr)
+        if cid is None:
+            return None
+        names: set = set()
+        stack, seen = [cid], set()
+        while stack:
+            c = stack.pop()
+            if c in seen or c not in self.cg.classes:
+                continue
+            seen.add(c)
+            ci = self.cg.classes[c]
+            names.add(ci.name)
+            stack.extend(ci.bases)
+        return names
+
+    def classify(self, fi, call):
+        """(identity, spec) when ``call`` is a collective, else None."""
+        func = call.func
+        name = dotted(func)
+        base = _last(name) or (
+            func.attr if isinstance(func, ast.Attribute) else ""
+        )
+        if isinstance(func, ast.Attribute):
+            spec = METHOD_COLLECTIVES.get(func.attr)
+            if spec is not None:
+                cls_names = self._receiver_class_names(fi, func.value)
+                if cls_names is not None:
+                    if not (cls_names & spec.classes):
+                        spec = None
+                elif spec.require_class:
+                    spec = None
+                if spec is not None:
+                    recv = dotted(func.value) or "<expr>"
+                    return f"{recv}.{spec.op}", spec
+        if base in FUNCTION_COLLECTIVES:
+            # a method spelled .host_allgather(...) on a project object
+            # would resolve above; bare/dotted module calls land here
+            spec = FUNCTION_COLLECTIVES[base]
+            return spec.op, spec
+        if base in DEVICE_COLLECTIVES:
+            segs = set(name.split(".")) if name else set()
+            if not name or segs & {"jax", "lax"} or name == base:
+                from .spmd_catalog import CollectiveSpec
+
+                return f"lax.{base}", CollectiveSpec(op=base, kind="device")
+        return None
+
+    def direct_sites(self, fid) -> list:
+        """Collective calls in the function's own body (nested defs
+        excluded — they are their own graph nodes)."""
+        cached = self._direct.get(fid)
+        if cached is not None:
+            return cached
+        fi = self.cg.functions.get(fid)
+        out: list = []
+        if fi is not None:
+            for node in self.cg._shallow_walk(fi.node):
+                if isinstance(node, ast.Call):
+                    hit = self.classify(fi, node)
+                    if hit is not None:
+                        out.append((hit[0], hit[1], node))
+        self._direct[fid] = out
+        return out
+
+    # -- rank taint --------------------------------------------------------- #
+    def taint(self, fid) -> frozenset:
+        """Names in ``fid`` carrying a rank-varying value."""
+        cached = self._taint.get(fid)
+        if cached is not None:
+            return cached
+        fi = self.cg.functions.get(fid)
+        names: set = set()
+        if fi is not None:
+            fn = fi.node
+            args = fn.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if a.arg in RANK_PARAMS:
+                    names.add(a.arg)
+            changed = True
+            while changed:
+                changed = False
+                for node in ast.walk(fn):
+                    if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                             ast.AnnAssign)):
+                        continue
+                    if node.value is None or not _expr_rank_tainted(
+                            node.value, names):
+                        continue
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name) and n.id not in names:
+                                names.add(n.id)
+                                changed = True
+        out = frozenset(names)
+        self._taint[fid] = out
+        return out
+
+    # -- per-function collective sequence summaries ------------------------- #
+    def summary(self, fid) -> tuple:
+        """Ordered collective identities ``fid`` emits (capped), through
+        resolved callees.  Memoized; recursion yields ()."""
+        cached = self._summary.get(fid)
+        if cached is not None:
+            return cached
+        if fid in self._inprog:
+            return ()
+        fi = self.cg.functions.get(fid)
+        if fi is None:
+            return ()
+        self._inprog.add(fid)
+        try:
+            w = _SeqWalker(self, fi, collect=False)
+            events, _ = w.block(fi.node.body)
+            out = tuple(e.identity for e in events)[:_SUMMARY_CAP]
+        finally:
+            self._inprog.discard(fid)
+        self._summary[fid] = out
+        return out
+
+    def reach(self) -> set:
+        """Functions whose body emits a collective event, directly or
+        via a resolved call — the only ones worth walking."""
+        if self._reach is not None:
+            return self._reach
+        has = {fid for fid in self.cg.functions if self.direct_sites(fid)}
+        # reverse-propagate over call/ctor edges
+        rev: dict = {}
+        for caller, edges in self.cg.edges.items():
+            for e in edges:
+                if e.kind in ("call", "ctor"):
+                    rev.setdefault(e.callee, set()).add(caller)
+        frontier = list(has)
+        while frontier:
+            f = frontier.pop()
+            for caller in rev.get(f, ()):
+                if caller not in has:
+                    has.add(caller)
+                    frontier.append(caller)
+        self._reach = has
+        return has
+
+
+def _expr_rank_tainted(expr, names) -> bool:
+    """Does this expression read a rank-varying value?"""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in names:
+            return True
+        if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load):
+            if n.attr.lstrip("_") in RANK_ATTRS:
+                return True
+        if isinstance(n, ast.Call):
+            base = _last(dotted(n.func))
+            if base in RANK_CALLS:
+                return True
+            if base in ("get", "getenv"):
+                # os.environ.get("...RANK...") / os.getenv(...)
+                owner = dotted(n.func)
+                if "environ" in owner or base == "getenv":
+                    for a in n.args[:1]:
+                        if isinstance(a, ast.Constant) and isinstance(
+                                a.value, str) and RANK_ENV_RE.search(a.value):
+                            return True
+        if isinstance(n, ast.Subscript):
+            # os.environ["...RANK..."]
+            if "environ" in dotted(n.value):
+                sl = n.slice
+                if isinstance(sl, ast.Constant) and isinstance(
+                        sl.value, str) and RANK_ENV_RE.search(sl.value):
+                    return True
+    return False
+
+
+class _SeqWalker:
+    """Path-sensitive walk of one function body producing its ordered
+    collective-event sequence; with ``collect=True`` it also emits the
+    rank-divergence and collective-sequence findings."""
+
+    def __init__(self, eng: Spmd, fi, collect=True):
+        self.eng = eng
+        self.fi = fi
+        self.sf = fi.sf
+        self.collect = collect
+        self.findings: list = []
+        self._seen: set = set()
+        self.taint = eng.taint(fi.id) if collect else frozenset()
+
+    # -- findings ----------------------------------------------------------- #
+    def _emit(self, rule, node, message) -> None:
+        if not self.collect:
+            return
+        key = (rule, getattr(node, "lineno", 0), message[:60])
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(self.sf.finding(rule, node, message))
+
+    def _tainted(self, expr) -> bool:
+        return self.collect and expr is not None and _expr_rank_tainted(
+            expr, self.taint)
+
+    # -- expression events --------------------------------------------------- #
+    def _calls_in(self, expr):
+        out: list = []
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            if n is None or isinstance(
+                    n, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.ClassDef)):
+                continue
+            if isinstance(n, ast.Call):
+                out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        out.sort(key=lambda c: (c.lineno, c.col_offset))
+        return out
+
+    def expr_events(self, expr) -> list:
+        if expr is None:
+            return []
+        events: list = []
+        for call in self._calls_in(expr):
+            hit = self.eng.classify(self.fi, call)
+            if hit is not None:
+                events.append(_Event(hit[0], call, spec=hit[1]))
+                continue
+            tgt = self.eng.cg._resolve_call_target(
+                self.fi, self.eng.cg._local_types(self.fi), call.func)
+            if tgt is not None:
+                for ident in self.eng.summary(tgt):
+                    events.append(_Event(ident, call, via=tgt))
+        return events
+
+    def _stmt_expr_events(self, stmt) -> list:
+        events: list = []
+        for _, value in ast.iter_fields(stmt):
+            if isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.AST) and not isinstance(
+                            v, (ast.stmt, ast.ExceptHandler)):
+                        events += self.expr_events(v)
+            elif isinstance(value, ast.AST) and not isinstance(
+                    value, (ast.stmt, ast.ExceptHandler)):
+                events += self.expr_events(value)
+        return events
+
+    # -- block / statement walk --------------------------------------------- #
+    def block(self, stmts):
+        """(events, status) for a statement list; If statements fold the
+        remainder of the block into each arm so early-return/continue
+        shapes compare whole path suffixes.  Iterative over plain
+        statements so long bodies don't recurse per statement."""
+        events: list = []
+        for i, s0 in enumerate(stmts):
+            if isinstance(s0, ast.If):
+                ev, st = self._if(s0, stmts[i + 1:])
+                return events + ev, st
+            ev, st = self._simple(s0)
+            events += ev
+            if st != "fall":
+                return events, st
+        return events, "fall"
+
+    def _if(self, stmt, rest):
+        test_ev = self.expr_events(stmt.test)
+        b_ev, b_st = self.block(stmt.body)
+        o_ev, o_st = self.block(stmt.orelse)
+        r_ev, r_st = self.block(rest)
+
+        def path(ev, st):
+            if st == "fall":
+                return ev + r_ev, r_st
+            return ev, st
+
+        pb_ev, pb_st = path(b_ev, b_st)
+        po_ev, po_st = path(o_ev, o_st)
+
+        if self._tainted(stmt.test):
+            cond = self.sf.line_text(stmt.lineno)
+            # all-paths-raise escape: a rank-conditional arm that raises
+            # is loud, and every surviving rank still runs the other arm
+            arms = [(pb_ev, pb_st), (po_ev, po_st)]
+            live = [(ev, st) for ev, st in arms if st != "raise"]
+            if len(live) == 2:
+                ids_b = [e.identity for e in pb_ev]
+                ids_o = [e.identity for e in po_ev]
+                if ids_b != ids_o:
+                    self._emit(
+                        "spmd-collective-sequence", stmt,
+                        "branch arms emit different collective sequences "
+                        f"under rank-varying condition {cond!r}: "
+                        f"[{', '.join(ids_b) or '-'}] vs "
+                        f"[{', '.join(ids_o) or '-'}] — every rank must "
+                        "issue the same collectives in the same order "
+                        "(parallel/host_plane.py:110)",
+                    )
+                    # rank-divergence: collectives present on one path only
+                    self._divergent(pb_ev, po_ev, cond)
+                    self._divergent(po_ev, pb_ev, cond)
+
+        # representative continuation: prefer a falling, non-raise path
+        # with the most events (the multi-host arm of a uniform gate)
+        cands = [(pb_ev, pb_st), (po_ev, po_st)]
+        falling = [c for c in cands if c[1] == "fall"]
+        nonraise = [c for c in cands if c[1] != "raise"]
+        pick = max(falling or nonraise or cands, key=lambda c: len(c[0]))
+        return test_ev + pick[0], pick[1]
+
+    def _divergent(self, have, other, cond) -> None:
+        counts: dict = {}
+        for e in other:
+            counts[e.identity] = counts.get(e.identity, 0) + 1
+        for e in have:
+            if counts.get(e.identity, 0) > 0:
+                counts[e.identity] -= 1
+                continue
+            what = (
+                f"collective {e.identity}()"
+                if e.via is None else
+                f"call into {self.eng.cg.functions[e.via].name}() "
+                f"(performs collective {e.identity})"
+            )
+            self._emit(
+                "spmd-rank-divergence", e.node,
+                f"{what} runs on only SOME ranks — guarded by rank-varying "
+                f"condition {cond!r}; the peers that skip it leave every "
+                "other rank wedged in the gather "
+                "(parallel/host_plane.py:110)",
+            )
+
+    def _loop(self, stmt):
+        head = getattr(stmt, "test", None) or getattr(stmt, "iter", None)
+        head_ev = self.expr_events(head)
+        body_ev, _ = self.block(stmt.body)
+        if self._tainted(head):
+            for e in body_ev:
+                what = (
+                    f"collective {e.identity}()"
+                    if e.via is None else
+                    f"call into {self.eng.cg.functions[e.via].name}() "
+                    f"(performs collective {e.identity})"
+                )
+                self._emit(
+                    "spmd-rank-divergence", e.node,
+                    f"{what} inside a loop whose trip count is "
+                    f"rank-varying ({self.sf.line_text(stmt.lineno)!r}) — "
+                    "ranks iterate different numbers of times and the "
+                    "collective counts diverge",
+                )
+        if stmt.orelse:
+            else_ev, _ = self.block(stmt.orelse)
+            body_ev = body_ev + else_ev
+        return head_ev + body_ev, "fall"
+
+    def _try(self, stmt):
+        b_ev, b_st = self.block(stmt.body)
+        for h in stmt.handlers:
+            self.block(h.body)  # findings inside; exceptional events dropped
+        o_ev: list = []
+        if stmt.orelse and b_st == "fall":
+            o_ev, b_st = self.block(stmt.orelse)
+        f_ev: list = []
+        f_st = "fall"
+        if stmt.finalbody:
+            f_ev, f_st = self.block(stmt.finalbody)
+        st = f_st if f_st != "fall" else b_st
+        return b_ev + o_ev + f_ev, st
+
+    def _simple(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return [], "fall"  # separate scope
+        if isinstance(stmt, ast.Return):
+            return self.expr_events(stmt.value), "return"
+        if isinstance(stmt, ast.Raise):
+            return self._stmt_expr_events(stmt), "raise"
+        if isinstance(stmt, ast.Continue):
+            return [], "continue"
+        if isinstance(stmt, ast.Break):
+            return [], "break"
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            ev = []
+            for item in stmt.items:
+                ev += self.expr_events(item.context_expr)
+            b_ev, b_st = self.block(stmt.body)
+            return ev + b_ev, b_st
+        return self._stmt_expr_events(stmt), "fall"
+
+
+# --------------------------------------------------------------------------- #
+# spmd-collective-on-thread
+# --------------------------------------------------------------------------- #
+def _thread_findings(eng: Spmd) -> list:
+    findings: list = []
+    cg = eng.cg
+    seen: set = set()
+    for caller, edges in cg.edges.items():
+        fi = cg.functions[caller]
+        for e in edges:
+            is_thread = e.kind == "thread"
+            if not is_thread and e.kind == "callback":
+                f = e.node.func if isinstance(e.node, ast.Call) else None
+                is_thread = isinstance(f, ast.Attribute) and \
+                    f.attr == "submit"
+            if not is_thread:
+                continue
+            closure = {e.callee} | cg.transitive_callees(e.callee)
+            for fid in sorted(closure):
+                for identity, spec, node in eng.direct_sites(fid):
+                    if spec.thread_safe:
+                        continue
+                    site = cg.functions[fid]
+                    key = (fi.sf.rel, e.node.lineno, identity)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(fi.sf.finding(
+                        "spmd-collective-on-thread", e.node,
+                        f"thread-path entry {cg.functions[e.callee].name}() "
+                        f"reaches collective {identity} "
+                        f"({site.sf.rel}:{node.lineno}) — device-entangled "
+                        "collectives must run on the main thread in "
+                        "lockstep (parallel/sharded_table.py:228); route "
+                        "planning through a KvChannel or move the "
+                        "collective to the pass boundary"
+                        + (f" — {spec.why}" if spec.why else ""),
+                    ))
+    return findings
+
+
+# --------------------------------------------------------------------------- #
+# spmd-mesh-axis
+# --------------------------------------------------------------------------- #
+class _AxisPass:
+    def __init__(self, eng: Spmd):
+        self.eng = eng
+        self.cg = eng.cg
+        self._consts: dict = {}   # module -> {name: str}
+        self.findings: list = []
+
+    def _module_consts(self, mod) -> dict:
+        cached = self._consts.get(mod)
+        if cached is not None:
+            return cached
+        out: dict = {}
+        sf = self.cg.modules.get(mod)
+        if sf is not None:
+            for node in sf.tree.body:
+                if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Constant) and isinstance(
+                        node.value.value, str):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = node.value.value
+        self._consts[mod] = out
+        return out
+
+    def _const_str(self, fi, expr):
+        """Resolve an expression to a string constant: literal, module
+        constant (through import aliases), or a parameter's default."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if not isinstance(expr, (ast.Name, ast.Attribute)):
+            return None
+        name = dotted(expr)
+        if not name:
+            return None
+        head, _, rest = name.partition(".")
+        # a parameter with a resolvable constant default
+        if not rest:
+            args = fi.node.args
+            allp = args.posonlyargs + args.args
+            defaults = list(args.defaults)
+            offset = len(allp) - len(defaults)
+            for i, a in enumerate(allp):
+                if a.arg == head and i >= offset:
+                    return self._const_str(fi, defaults[i - offset])
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                if a.arg == head and d is not None:
+                    return self._const_str(fi, d)
+            local = self._module_consts(fi.module)
+            if head in local:
+                return local[head]
+        imports = self.cg.imports.get(fi.module, {})
+        if head in imports:
+            target = imports[head]
+            if rest:
+                return self._module_consts(target).get(rest.split(".")[0])
+            # 'from mod import CONST'
+            tmod, _, tname = target.rpartition(".")
+            if tmod:
+                return self._module_consts(tmod).get(tname)
+        return None
+
+    def _const_str_set(self, fi, expr):
+        if isinstance(expr, (ast.Set, ast.Tuple, ast.List)):
+            out = set()
+            for el in expr.elts:
+                v = self._const_str(fi, el)
+                if v is None:
+                    return None
+                out.add(v)
+            return out
+        v = self._const_str(fi, expr)
+        return {v} if v is not None else None
+
+    # -- shard_map sites ----------------------------------------------------- #
+    def _resolve_body(self, fi, expr):
+        """func id of a shard_map's body argument."""
+        if isinstance(expr, ast.Name):
+            for n in self.cg._shallow_walk(fi.node):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n.name == expr.id:
+                    return self.cg._by_node.get(id(n))
+            sym = self.cg.resolve_symbol(fi.module, expr.id)
+            if sym and sym[0] == "func":
+                return sym[1]
+        elif isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id in ("self", "cls") and fi.cls:
+                return self.cg.resolve_method(fi.cls, expr.attr)
+            sym = self.cg.resolve_symbol(fi.module, dotted(expr))
+            if sym and sym[0] == "func":
+                return sym[1]
+        return None
+
+    def _mesh_axes(self, fi, expr, depth=0):
+        """Axis names a mesh expression binds, or None (unknown = ⊤)."""
+        if depth > 3 or expr is None:
+            return None
+        if isinstance(expr, ast.Call):
+            base = _last(dotted(expr.func))
+            if base == "make_mesh":
+                for kw in expr.keywords:
+                    if kw.arg == "axis_name":
+                        v = self._const_str(fi, kw.value)
+                        return {v} if v else None
+                if len(expr.args) >= 3:
+                    v = self._const_str(fi, expr.args[2])
+                    return {v} if v else None
+                return {"data"}
+            if base == "make_composed_mesh":
+                inner = None
+                for kw in expr.keywords:
+                    if kw.arg == "inner_axis":
+                        inner = self._const_str(fi, kw.value)
+                if inner is None and len(expr.args) >= 3:
+                    inner = self._const_str(fi, expr.args[2])
+                return {"data", inner} if inner else None
+            if base == "Mesh" and len(expr.args) >= 2:
+                return self._const_str_set(fi, expr.args[1])
+            return None
+        if isinstance(expr, ast.Name):
+            # single local assignment to a resolvable mesh call
+            assigns = [
+                n for n in self.cg._shallow_walk(fi.node)
+                if isinstance(n, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == expr.id
+                        for t in n.targets)
+            ]
+            if len(assigns) == 1:
+                return self._mesh_axes(fi, assigns[0].value, depth + 1)
+        return None
+
+    def _site_axes(self, fi, call):
+        """(bound axes or None=⊤) for one shard_map call."""
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                return self._const_str_set(fi, kw.value)
+        mesh_expr = None
+        for kw in call.keywords:
+            if kw.arg == "mesh":
+                mesh_expr = kw.value
+        if mesh_expr is None and len(call.args) >= 2:
+            mesh_expr = call.args[1]
+        return self._mesh_axes(fi, mesh_expr)
+
+    def _check_specs_arity(self, fi, call, body_fid) -> None:
+        in_specs = None
+        for kw in call.keywords:
+            if kw.arg == "in_specs":
+                in_specs = kw.value
+        if isinstance(in_specs, ast.Name):
+            assigns = [
+                n for n in self.cg._shallow_walk(fi.node)
+                if isinstance(n, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == in_specs.id
+                        for t in n.targets)
+            ]
+            in_specs = assigns[0].value if len(assigns) == 1 else None
+        if not isinstance(in_specs, ast.Tuple):
+            return
+        n = len(in_specs.elts)
+        bf = self.cg.functions[body_fid]
+        args = bf.node.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        total = len(params)
+        required = total - len(args.defaults)
+        if args.vararg is not None:
+            return  # *args body takes anything
+        if not (required <= n <= total):
+            self.findings.append(fi.sf.finding(
+                "spmd-mesh-axis", call,
+                f"shard_map in_specs has {n} entr(y/ies) but body "
+                f"{bf.name}() takes {required}"
+                + (f"-{total}" if total != required else "")
+                + " positional parameter(s) — every body arg needs "
+                "exactly one spec",
+            ))
+
+    # -- driving ------------------------------------------------------------- #
+    def run(self) -> list:
+        cg = self.cg
+        # 1. shard_map sites: body fid -> list of bound-axes (None = ⊤)
+        bodies: dict = {}
+        for fid, fi in cg.functions.items():
+            for node in cg._shallow_walk(fi.node):
+                if not (isinstance(node, ast.Call)
+                        and _last(dotted(node.func)) == "shard_map"
+                        and node.args):
+                    continue
+                body_fid = self._resolve_body(fi, node.args[0])
+                if body_fid is None:
+                    continue
+                bodies.setdefault(body_fid, []).append(
+                    self._site_axes(fi, node))
+                self._check_specs_arity(fi, node, body_fid)
+        if not bodies:
+            return self.findings
+        # 2. axis uses per function reachable from some body
+        reach_axes: dict = {}  # fid -> None (⊤) | set of axes
+        for body_fid, axes_list in bodies.items():
+            closure = {body_fid} | cg.transitive_callees(
+                body_fid, kinds=("call", "ctor", "callback"))
+            for site_axes in axes_list:
+                for f in closure:
+                    if site_axes is None:
+                        reach_axes[f] = None
+                    elif f in reach_axes:
+                        if reach_axes[f] is not None:
+                            reach_axes[f] = reach_axes[f] | site_axes
+                    else:
+                        reach_axes[f] = set(site_axes)
+        for fid, bound in reach_axes.items():
+            if bound is None:
+                continue  # some reaching site binds an unknown mesh
+            fi = cg.functions[fid]
+            for node in cg._shallow_walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                base = _last(dotted(node.func))
+                pos = AXIS_CONSUMERS.get(base)
+                if pos is None:
+                    continue
+                axis_expr = None
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis_names"):
+                        axis_expr = kw.value
+                if axis_expr is None and len(node.args) > pos:
+                    axis_expr = node.args[pos]
+                axes = self._const_str_set(fi, axis_expr) \
+                    if axis_expr is not None else None
+                if not axes:
+                    continue  # unresolvable: conservative skip
+                missing = sorted(axes - bound)
+                if missing:
+                    self.findings.append(fi.sf.finding(
+                        "spmd-mesh-axis", node,
+                        f"{base}() uses axis name(s) "
+                        f"{', '.join(repr(m) for m in missing)} but every "
+                        "reaching shard_map binds only "
+                        f"{sorted(bound)} — the collective would fail to "
+                        "lower (bind the axis in the mesh/axis_names or "
+                        "pass the right axis_name through)",
+                    ))
+        return self.findings
+
+
+# --------------------------------------------------------------------------- #
+# pass driver
+# --------------------------------------------------------------------------- #
+def run(ctx: Context) -> list:
+    eng = Spmd.of(ctx)
+    findings: list = []
+    reach = eng.reach()
+    rel_files = {sf.rel for sf in ctx.files}
+    for fid, fi in eng.cg.functions.items():
+        if fid not in reach or fi.sf.rel not in rel_files:
+            continue
+        w = _SeqWalker(eng, fi, collect=True)
+        w.block(fi.node.body)
+        findings.extend(w.findings)
+    findings.extend(_thread_findings(eng))
+    findings.extend(_AxisPass(eng).run())
+    return findings

@@ -321,6 +321,79 @@ def lineage_report(data: dict) -> Dict[str, dict]:
     return lineages
 
 
+def collective_report(data: dict) -> dict:
+    """Cross-rank collective-sequence check over the (channel, seq, op)
+    digests ``KvChannel.allgather`` / ``TcpShuffler.exchange`` record
+    into the flight ring — the runtime witness for the static ``spmd-*``
+    rules: a hang ``spmd-rank-divergence`` would have caught at lint
+    time shows up here as one rank's digest stream stopping (or carrying
+    a different op) at a specific (channel, seq) while its peers moved
+    on.  The verdict names the FIRST diverging (rank, channel, seq).
+
+    Ring bounds are respected: per channel, sequences below the highest
+    per-rank *minimum* are ignored (an evicted early record is history
+    lost, not a skipped collective)."""
+    # channel -> rank -> {seq: op}
+    chans: Dict[str, Dict[int, Dict[int, str]]] = {}
+    for t, who, kind, name, rec in _iter_all_records(data):
+        if kind != "collective":
+            continue
+        ch, seq, rank = rec.get("channel"), rec.get("seq"), rec.get("rank")
+        if ch is None or seq is None or rank is None:
+            continue
+        op = rec.get("op") or name
+        chans.setdefault(str(ch), {}).setdefault(
+            int(rank), {})[int(seq)] = str(op)
+    divergences: List[dict] = []
+    summary: Dict[str, dict] = {}
+    for ch in sorted(chans):
+        ranks = chans[ch]
+        summary[ch] = {
+            "ranks": sorted(ranks),
+            "max_seq": {str(r): max(s) for r, s in ranks.items()},
+        }
+        if len(ranks) < 2:
+            continue
+        floor = max(min(s) for s in ranks.values())
+        ceiling = max(max(s) for s in ranks.values())
+        for seq in range(floor, ceiling + 1):
+            ops = {r: ranks[r].get(seq) for r in sorted(ranks)}
+            present = {r: o for r, o in ops.items() if o is not None}
+            absent = [r for r, o in ops.items() if o is None]
+            if len(set(present.values())) > 1:
+                # op mismatch: the minority rank is the diverger
+                counts: Dict[str, int] = {}
+                for o in present.values():
+                    counts[o] = counts.get(o, 0) + 1
+                minority = min(
+                    present, key=lambda r: (counts[present[r]], r)
+                )
+                divergences.append({
+                    "channel": ch, "seq": seq, "rank": minority,
+                    "kind": "op-mismatch",
+                    "ops": {str(r): o for r, o in present.items()},
+                })
+                break
+            if absent and present:
+                skipped = [
+                    r for r in absent
+                    if max(ranks[r]) > seq
+                ]
+                kind = "skipped" if skipped else "behind"
+                rank = (skipped or absent)[0]
+                divergences.append({
+                    "channel": ch, "seq": seq, "rank": rank,
+                    "kind": kind,
+                    "ops": {str(r): o for r, o in present.items()},
+                    "last_seq": max(ranks[rank]),
+                })
+                break
+    first = None
+    if divergences:
+        first = min(divergences, key=lambda d: (d["seq"], d["channel"]))
+    return {"channels": summary, "divergences": divergences, "first": first}
+
+
 def trace_report(data: dict, trace_id: Optional[str] = None) -> Dict[str, list]:
     """Records grouped by trace ID (all traces, or just one), each list
     wall-time ordered: a request's full cross-process path."""
@@ -381,6 +454,7 @@ def analyze(run_dir: str) -> dict:
         "stalls": stall_report(data),
         "crashes": crash_report(data),
         "lineage": lineage_report(data),
+        "collectives": collective_report(data),
         "traces": trace_report(data),
         "dump_reasons": sorted(
             {d.get("reason", "?") for d in data["dumps"]}
@@ -439,6 +513,21 @@ def format_summary(report: dict) -> str:
             f"REPLICA CRASH: replica {c['replica_id']} (pid {c['pid']}, "
             f"rc={c['returncode']}, port {c['port']}) at t={c['t']:.3f}; "
             f"{len(c['child_dumps'])} dump(s) left by the child"
+        )
+    div = report.get("collectives", {}).get("first")
+    if div is not None:
+        what = {
+            "op-mismatch": "issued a DIFFERENT op than its peers",
+            "skipped": "skipped this sequence (it has later ones)",
+            "behind": (
+                f"never got past seq {div.get('last_seq')} while peers "
+                "moved on"
+            ),
+        }.get(div["kind"], div["kind"])
+        lines.append(
+            f"COLLECTIVE DIVERGENCE: rank {div['rank']} on channel "
+            f"{div['channel']!r} at seq {div['seq']} — {what} "
+            f"(peers: {div.get('ops')})"
         )
     for lid, s in sorted(report["lineage"].items()):
         pub = s["published_at"]
