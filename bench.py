@@ -1285,6 +1285,381 @@ def stage_serving(backend) -> None:
           "backend": backend, **res})
 
 
+def _open_loop_http(port: int, body: bytes, qps: float, duration_s: float,
+                    path: str = "/score", n_threads: int = 16,
+                    timeout: float = 30.0) -> dict:
+    """Drive one open-loop load point: request i leaves at
+    ``start + i/qps`` no matter how request i-1 fared (closed-loop
+    generators hide overload by slowing down with the server).  Returns
+    p50/p99 of 200s, shed (429) and failed counts, achieved QPS."""
+    import http.client
+    import threading
+
+    n_requests = max(1, int(qps * duration_s))
+    idx = {"i": 0}
+    lat_ok: list = []
+    shed = failed = 0
+    lock = threading.Lock()
+    start = time.monotonic()
+
+    def worker():
+        nonlocal shed, failed
+        while True:
+            with lock:
+                i = idx["i"]
+                if i >= n_requests:
+                    return
+                idx["i"] = i + 1
+            delay = start + i / qps - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t1 = time.perf_counter()
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=timeout)
+                conn.request("POST", path, body=body)
+                r = conn.getresponse()
+                r.read()
+                status = r.status
+                conn.close()
+            # pbox-lint: ignore[swallowed-exception] failure is recorded:
+            # status=-1 counts as failed below
+            except Exception:
+                status = -1
+            dt = (time.perf_counter() - t1) * 1e3
+            with lock:
+                if status == 200:
+                    lat_ok.append(dt)
+                elif status == 429:
+                    shed += 1
+                else:
+                    failed += 1
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(min(n_threads, n_requests))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 120)
+    wall = time.monotonic() - start
+    lat_ok.sort()
+    n_ok = len(lat_ok)
+    return {
+        "target_qps": qps,
+        "requests": n_ok + shed + failed,
+        "ok": n_ok,
+        "shed": shed,
+        "failed": failed,
+        "p50_ms": round(lat_ok[n_ok // 2], 2) if n_ok else None,
+        "p99_ms": round(lat_ok[_rank(0.99, n_ok)], 2) if n_ok else None,
+        "achieved_qps": round((n_ok + shed + failed) / wall, 1),
+    }
+
+
+def bench_serving_sweep(qps_points, duration_s: float = 6.0,
+                        n_slots: int = 8, dense: int = 13,
+                        req_lines: int = 8, ins_per_file: int = 512,
+                        max_batch=None, compare_unbatched: bool = True,
+                        hidden=(64, 32)) -> dict:
+    """The p50/p99-vs-QPS curve (ROADMAP item 1): train a small CTR-DNN
+    once, export one artifact, then drive the OPEN-LOOP load through a
+    live ScoringServer at each target QPS — once with continuous
+    micro-batching (PBOX_SERVE_MAX_BATCH / ``max_batch``) and once with
+    the one-at-a-time baseline (max_batch=1), same artifact, same
+    request mix — so the batching win reads directly off the two curves
+    (batched p99 lower at fixed QPS; shed onset at higher QPS)."""
+    from paddlebox_tpu.config import (
+        SparseTableConfig,
+        TrainerConfig,
+        flags,
+    )
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.inference import ScoringServer, export_model
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.train.trainer import Trainer
+
+    B = 64
+    max_batch = int(flags.serve_max_batch if max_batch is None else max_batch)
+    res: dict = {"max_batch": max_batch, "duration_s": duration_s,
+                 "req_lines": req_lines}
+    with tempfile.TemporaryDirectory() as td:
+        conf = make_synth_config(n_sparse_slots=n_slots, dense_dim=dense,
+                                 batch_size=B, max_feasigns_per_ins=16)
+        files = write_synth_files(
+            td, n_files=1, ins_per_file=ins_per_file, n_sparse_slots=n_slots,
+            vocab_per_slot=10_000, dense_dim=dense, seed=13,
+        )
+        ds = PadBoxSlotDataset(conf, read_threads=2)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        tconf = SparseTableConfig(embedding_dim=8)
+        model = CtrDnn(n_slots, tconf.row_width, dense_dim=dense,
+                       hidden=tuple(hidden))
+        table = SparseTable(tconf, seed=0)
+        trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10),
+                          seed=0)
+        table.begin_pass(ds.unique_keys())
+        trainer.train_from_dataset(ds, table)
+        table.end_pass()
+        ds.close()
+        kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+        art = os.path.join(td, "artifact")
+        export_model(model, trainer.params, table, art, batch_size=B,
+                     key_capacity=kcap, dense_dim=dense,
+                     batch_buckets=[(8, max(kcap // 8, 64))],
+                     feed_conf=conf)
+        with open(files[0], "rb") as f:
+            body = b"\n".join(f.read().splitlines()[:req_lines]) + b"\n"
+
+        configs = [("batched", max_batch)]
+        if compare_unbatched and max_batch > 1:
+            configs.append(("unbatched", 1))
+        for label, mb in configs:
+            srv = ScoringServer(max_batch=mb)
+            srv.register("m", art, conf)
+            port = srv.start(port=0)
+            try:
+                for _ in range(5):  # compile + program-load warmup
+                    srv.score_lines(body, "m")
+                points = []
+                for q in qps_points:
+                    pt = _open_loop_http(port, body, float(q), duration_s)
+                    points.append(pt)
+                    emit({"metric": "serving_qps_sweep", "mode": label,
+                          "max_batch": mb, "value": pt["p99_ms"],
+                          "unit": "ms p99 (open loop)",
+                          "vs_baseline": None, **pt})
+                    log(f"sweep [{label} mb={mb}] qps={q}: p50 "
+                        f"{pt['p50_ms']}ms p99 {pt['p99_ms']}ms shed "
+                        f"{pt['shed']} achieved {pt['achieved_qps']}")
+                res[f"{label}_curve"] = points
+            finally:
+                srv.stop()
+    return res
+
+
+def stage_serving_sweep(backend, args) -> None:
+    points = [float(x) for x in args.qps_sweep.split(",") if x.strip()]
+    res = bench_serving_sweep(points, duration_s=args.sweep_seconds)
+    curve = res.get("batched_curve") or []
+    emit({"metric": "serving_qps_sweep_curve",
+          "value": curve[-1]["p99_ms"] if curve else None,
+          "unit": f"ms p99 @ {points[-1] if points else '?'} qps",
+          "vs_baseline": None, "backend": backend, **res})
+
+
+def bench_fleet_sweep(qps_points, duration_s: float = 6.0,
+                      n_replicas: int = 3, n_slots: int = 4,
+                      dense: int = 4) -> dict:
+    """The same open-loop sweep through a REAL fleet: N replica server
+    processes + router (no chaos — this measures the capacity curve, the
+    SIGKILL run stays bench_fleet's job).  Replica batching follows the
+    inherited env (PBOX_SERVE_MAX_BATCH), so driving this twice with the
+    flag flipped produces the fleet-level batched-vs-not curves."""
+    import http.client
+
+    from paddlebox_tpu.config import SparseTableConfig, TrainerConfig, flags
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.inference import export_model
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.serving_fleet import (
+        EJECTED,
+        FleetRouter,
+        ReplicaSupervisor,
+    )
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.train.trainer import Trainer
+
+    B = 64
+    res: dict = {"n_replicas": n_replicas, "duration_s": duration_s,
+                 "max_batch": flags.serve_max_batch}
+    with tempfile.TemporaryDirectory() as td:
+        conf = make_synth_config(n_sparse_slots=n_slots, dense_dim=dense,
+                                 batch_size=B, max_feasigns_per_ins=8)
+        files = write_synth_files(td, n_files=1, ins_per_file=2 * B,
+                                  n_sparse_slots=n_slots, vocab_per_slot=500,
+                                  dense_dim=dense, seed=17)
+        ds = PadBoxSlotDataset(conf, read_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        tconf = SparseTableConfig(embedding_dim=4)
+        model = CtrDnn(n_slots, tconf.row_width, dense_dim=dense,
+                       hidden=(16,))
+        table = SparseTable(tconf, seed=0)
+        trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10),
+                          seed=0)
+        table.begin_pass(ds.unique_keys())
+        trainer.train_from_dataset(ds, table)
+        table.end_pass()
+        ds.close()
+        kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+        art = os.path.join(td, "artifact")
+        export_model(model, trainer.params, table, art, batch_size=B,
+                     key_capacity=kcap, dense_dim=dense, feed_conf=conf)
+        with open(files[0], "rb") as f:
+            body = b"\n".join(f.read().splitlines()[:8]) + b"\n"
+
+        def argv_for(rid, port):
+            # --replicas 0: children inherit this env (see bench_fleet)
+            return [sys.executable, "-m", "paddlebox_tpu.serve",
+                    "--replicas", "0",
+                    "--artifact", art, "--port", str(port), "--cpu",
+                    "--max-queue", "64"]
+
+        sup = ReplicaSupervisor(n_replicas, argv_for,
+                                log_dir=os.path.join(td, "logs"))
+        sup.start()
+        router = FleetRouter(sup.endpoints(), probe_interval_s=0.3)
+        try:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 600:
+                router.probe_once()
+                if all(r.state != EJECTED for r in router.replicas):
+                    break
+                time.sleep(0.5)
+            else:
+                raise RuntimeError(
+                    "replicas never came healthy: "
+                    f"{[r.last_error for r in router.replicas]}")
+            port = router.start(port=0)
+            for _ in range(5):  # warm every replica's compile path
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                conn.request("POST", "/score", body=body)
+                conn.getresponse().read()
+                conn.close()
+            points = []
+            for q in qps_points:
+                pt = _open_loop_http(port, body, float(q), duration_s)
+                points.append(pt)
+                emit({"metric": "fleet_qps_sweep", "value": pt["p99_ms"],
+                      "unit": "ms p99 (open loop, router)",
+                      "vs_baseline": None, **pt})
+                log(f"fleet sweep qps={q}: p50 {pt['p50_ms']}ms p99 "
+                    f"{pt['p99_ms']}ms shed {pt['shed']} achieved "
+                    f"{pt['achieved_qps']}")
+            res["curve"] = points
+        finally:
+            router.stop()
+            sup.stop()
+    return res
+
+
+def stage_fleet_sweep(backend, args) -> None:
+    points = [float(x) for x in args.qps_sweep.split(",") if x.strip()]
+    res = bench_fleet_sweep(points, duration_s=args.sweep_seconds)
+    curve = res.get("curve") or []
+    emit({"metric": "fleet_qps_sweep_curve",
+          "value": curve[-1]["p99_ms"] if curve else None,
+          "unit": f"ms p99 @ {points[-1] if points else '?'} qps",
+          "vs_baseline": None, "backend": backend, **res})
+
+
+def _rank_auc(scores, labels) -> float:
+    """Tie-averaged rank AUC (Mann-Whitney), numpy only."""
+    s = np.asarray(scores, np.float64)
+    y = np.asarray(labels, np.float64)
+    n_pos = float(y.sum())
+    n_neg = float(len(y) - n_pos)
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(s, kind="mergesort")
+    ss = s[order]
+    ranks = np.empty(len(s), np.float64)
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and ss[j + 1] == ss[i]:
+            j += 1
+        ranks[order[i: j + 1]] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    return float(
+        (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    )
+
+
+def bench_quantized(n_slots: int = 8, dense: int = 13,
+                    embedding_dim: int = 64, ins_per_file: int = 1024,
+                    dtypes=("fp32", "int8", "fp8")) -> dict:
+    """Quantized-artifact evidence (ROADMAP item 1(b)): one trained
+    model exported at each embedding dtype, reporting sparse payload
+    bytes (the multi-TB delta-publish shrink) and the AUC of each
+    artifact's scores on the synthetic CTR eval vs its labels — the
+    acceptance bar is bytes <= ~30% of fp32 at production-shaped
+    embedding widths with |AUC delta| < 0.005."""
+    from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.inference import Predictor, export_model
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.train.trainer import Trainer
+
+    B = 128
+    res: dict = {"embedding_dim": embedding_dim}
+    with tempfile.TemporaryDirectory() as td:
+        conf = make_synth_config(n_sparse_slots=n_slots, dense_dim=dense,
+                                 batch_size=B, max_feasigns_per_ins=16)
+        files = write_synth_files(
+            td, n_files=1, ins_per_file=ins_per_file, n_sparse_slots=n_slots,
+            vocab_per_slot=5_000, dense_dim=dense, seed=29,
+        )
+        ds = PadBoxSlotDataset(conf, read_threads=2)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        tconf = SparseTableConfig(embedding_dim=embedding_dim)
+        model = CtrDnn(n_slots, tconf.row_width, dense_dim=dense,
+                       hidden=(64, 32))
+        table = SparseTable(tconf, seed=0)
+        trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10),
+                          seed=0)
+        table.begin_pass(ds.unique_keys())
+        trainer.train_from_dataset(ds, table)
+        table.end_pass()
+        kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+        labels = []
+        for batch in ds.batches(drop_last=False):
+            labels.extend(batch.labels[: batch.n_real_ins].tolist())
+        for dt in dtypes:
+            art = os.path.join(td, f"art-{dt}")
+            export_model(model, trainer.params, table, art, batch_size=B,
+                         key_capacity=kcap, dense_dim=dense,
+                         embedding_dtype=dt)
+            pred = Predictor.load(art)
+            scores = np.concatenate(list(pred.predict_dataset(ds)))
+            sp = os.path.join(art, "sparse")
+            payload = sum(
+                os.path.getsize(os.path.join(sp, f))
+                for f in os.listdir(sp) if not f.startswith("keys")
+            )
+            res[f"{dt}_payload_bytes"] = payload
+            res[f"{dt}_artifact_bytes"] = pred.artifact_bytes
+            res[f"{dt}_auc"] = round(_rank_auc(scores, labels), 6)
+        ds.close()
+    for dt in dtypes:
+        if dt == "fp32":
+            continue
+        res[f"{dt}_bytes_ratio"] = round(
+            res[f"{dt}_payload_bytes"] / res["fp32_payload_bytes"], 4)
+        res[f"{dt}_auc_delta"] = round(
+            abs(res[f"{dt}_auc"] - res["fp32_auc"]), 6)
+        log(f"quantized {dt}: payload {res[f'{dt}_payload_bytes']:,} B "
+            f"({res[f'{dt}_bytes_ratio']:.2%} of fp32), AUC "
+            f"{res[f'{dt}_auc']:.4f} (delta {res[f'{dt}_auc_delta']:.5f})")
+    return res
+
+
+def stage_quantized(backend) -> None:
+    res = bench_quantized()
+    emit({"metric": "quantized_artifact_bytes_ratio",
+          "value": res.get("int8_bytes_ratio"),
+          "unit": "int8/fp32 sparse payload bytes",
+          "vs_baseline": 1.0, "backend": backend, **res})
+
+
 def bench_fleet(n_replicas: int = 3, qps: float = 25.0,
                 duration_s: float = 12.0, kill_at_s: float = 4.0,
                 n_slots: int = 4, dense: int = 4):
@@ -2079,6 +2454,20 @@ def main() -> None:
                     help="open-loop target QPS for --fleet")
     ap.add_argument("--fleet-seconds", type=float, default=12.0,
                     help="load duration for --fleet")
+    ap.add_argument("--qps-sweep", default="",
+                    metavar="Q1,Q2,...",
+                    help="open-loop QPS sweep: with --serving drive one "
+                         "live ScoringServer (batched AND max_batch=1 "
+                         "baselines) at each target, with --fleet drive "
+                         "the 3-replica router; one emitted row per "
+                         "point (p50/p99/shed/achieved) — the "
+                         "p50/p99-vs-QPS curve")
+    ap.add_argument("--sweep-seconds", type=float, default=6.0,
+                    help="load duration per --qps-sweep point")
+    ap.add_argument("--quantized", action="store_true",
+                    help="quantized embedding artifacts: fp32 vs int8 "
+                         "vs fp8 sparse payload bytes + synthetic-CTR "
+                         "AUC delta")
     ap.add_argument("--streaming", action="store_true",
                     help="streaming online-learning loop: synthetic "
                          "append-rate stream -> StreamingTrainer -> "
@@ -2125,6 +2514,13 @@ def main() -> None:
 
     if args.ops:
         fail_metric, fail_unit = "ctr_op_microbench", "ms"
+    elif args.qps_sweep:
+        fail_metric = ("fleet_qps_sweep_curve" if args.fleet
+                       else "serving_qps_sweep_curve")
+        fail_unit = "ms p99 (open loop)"
+    elif args.quantized:
+        fail_metric = "quantized_artifact_bytes_ratio"
+        fail_unit = "int8/fp32 sparse payload bytes"
     elif args.serving:
         fail_metric = "serving_score_latency"
         fail_unit = "ms p50 (64-instance request)"
@@ -2174,6 +2570,17 @@ def main() -> None:
 
     if args.ops:
         stage_ops(backend, args)
+        return
+
+    if args.qps_sweep:
+        if args.fleet:
+            stage_fleet_sweep(backend, args)
+        else:
+            stage_serving_sweep(backend, args)
+        return
+
+    if args.quantized:
+        stage_quantized(backend)
         return
 
     if args.serving:

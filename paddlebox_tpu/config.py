@@ -125,6 +125,21 @@ class _Flags:
         # largest accepted /score request body; beyond it the server
         # answers 413 without reading the payload
         "serve_max_body_bytes": 8 << 20,
+        # continuous micro-batching at the admission gate: up to this many
+        # queued /score requests coalesce into ONE padded-bucket device
+        # call (dispatch cost amortizes across the queue).  1 = the
+        # one-at-a-time legacy path and the ablation baseline
+        # (PBOX_SERVE_MAX_BATCH=1)
+        "serve_max_batch": 8,
+        # how long a forming micro-batch may wait for more requests (ms)
+        # before it cuts; an idle queue never waits — the linger only
+        # spends latency when more traffic is demonstrably in flight
+        "serve_batch_linger_ms": 2.0,
+        # serving-artifact embedding payload dtype (export_serving_programs
+        # / export_model): "fp32" | "int8" | "fp8".  Quantized artifacts
+        # ship per-row scales and dequantize INSIDE the serving program's
+        # gather, so fp32 rows never materialize host-side
+        "embedding_dtype": "fp32",
         # fleet router health/freshness probe cadence per replica
         "fleet_probe_interval_s": 1.0,
         # pass-boundary pipelining kill switch (sparse/table.py): 0 forces

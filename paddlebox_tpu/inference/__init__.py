@@ -2,10 +2,14 @@ from paddlebox_tpu.inference.export import (
     export_model,
     export_serving_programs,
 )
-from paddlebox_tpu.inference.predictor import Predictor
+from paddlebox_tpu.inference.predictor import (
+    EmbeddingDtypeMismatch,
+    Predictor,
+)
 from paddlebox_tpu.inference.server import ScoringServer
 
 __all__ = [
+    "EmbeddingDtypeMismatch",
     "export_model",
     "export_serving_programs",
     "Predictor",

@@ -27,6 +27,7 @@ and ``serve.admission_wait_seconds`` (histogram of admitted waits).
 from __future__ import annotations
 
 import collections
+import logging
 import math
 import threading
 import time
@@ -45,6 +46,15 @@ _SHED = telemetry.counter(
 _ADMIT_WAIT = telemetry.histogram(
     "serve.admission_wait_seconds",
     help="queue wait of ADMITTED scoring requests",
+)
+_BATCH_SIZE = telemetry.histogram(
+    "serve.batch_size",
+    help="requests coalesced per micro-batch device call",
+)
+_BATCH_FALLBACKS = telemetry.counter(
+    "serve.batch_fallbacks",
+    help="coalesced batches whose combined call raised and re-scored "
+         "each request alone (per-request error isolation)",
 )
 
 
@@ -200,3 +210,218 @@ class AdmissionGate:
                     service_s - self._ewma_service_s
                 )
             self._cv.notify_all()
+
+
+# --------------------------------------------------------------------------- #
+# Continuous micro-batching: batch-at-dequeue coalescing of ADMITTED
+# requests.  The gate stays the admission/shed authority (every request
+# still holds exactly one admit()ed slot for its whole life — the ticket
+# protocol is untouched); what changes is what happens AFTER admission:
+# instead of each request dispatching its own padded-bucket device call,
+# concurrently admitted requests for the same model coalesce into ONE
+# combined `score_lines` call and the scores demultiplex back to the
+# waiting handlers in FIFO submission order.  Scoring stays per-instance
+# row-independent (padding/segment rules in predictor.py), so batched
+# scores are bit-exact vs sequential — pinned by tests/test_microbatch.py.
+# --------------------------------------------------------------------------- #
+_PENDING, _CLAIMED, _DONE = 0, 1, 2
+
+
+class _Job:
+    """One admitted request waiting for (or leading) a micro-batch."""
+
+    __slots__ = ("body", "deadline_at", "state", "scores", "clipped",
+                 "error", "service_s")
+
+    def __init__(self, body: bytes, deadline_at: Optional[float]):
+        self.body = body
+        self.deadline_at = deadline_at  # monotonic; None = no deadline
+        self.state = _PENDING
+        self.scores: Optional[list] = None
+        self.clipped = 0
+        self.error: Optional[BaseException] = None
+        self.service_s: Optional[float] = None
+
+
+class BatchCoalescer:
+    """Leader-elected micro-batcher for one ScoringServer.
+
+    Lifecycle of a request: the HTTP handler admits at the gate, then
+    submits a job here.  The first pending job for a model with no active
+    leader becomes the LEADER: it lingers up to ``linger_s`` for the
+    batch to fill (cutting immediately when nothing else is in flight —
+    an idle queue never waits), claims up to ``max_batch`` jobs FIFO,
+    sheds any whose deadline expired while the batch formed (429, never
+    scored), and scores the rest through ONE ``server.score_lines`` call
+    — which pins ONE predictor snapshot for the whole batch, so a
+    concurrent hot swap can never split a batch across two predictors.
+    Followers wait; the leader demultiplexes scores (and per-request
+    clipped-instance attribution) back to them.
+
+    Error isolation: a combined call that raises (one request's
+    malformed payload would otherwise fail its batch mates) falls back
+    to scoring each request alone, reproducing exact per-request error
+    semantics.
+    """
+
+    def __init__(self, server, max_batch: int, linger_s: float):
+        self._server = server
+        self.max_batch = max(1, int(max_batch))
+        self.linger_s = max(0.0, float(linger_s))
+        self._cv = threading.Condition()
+        self._pending: dict = {}  # model name -> FIFO [_Job, ...]
+        self._leading: set = set()  # models with an active batch leader
+        self._inside = 0  # jobs submitted here and not yet returned
+
+    # -- request-thread entry ------------------------------------------------ #
+    def score(self, body: bytes, name: Optional[str],
+              deadline_at: Optional[float]) -> _Job:
+        """Coalesce-and-score one admitted request; returns its finished
+        job (scores + clipped count + measured batch service time).
+        Raises the per-request error (ShedRequest for a deadline that
+        expired mid-linger, parse/model errors otherwise)."""
+        server = self._server
+        with server._meta_lock:
+            model = name or server._default
+            if model not in server._models:
+                raise KeyError(name)
+        job = _Job(body, deadline_at)
+        with self._cv:
+            self._pending.setdefault(model, []).append(job)
+            self._inside += 1
+            self._cv.notify_all()
+        try:
+            while True:
+                batch = None
+                with self._cv:
+                    # wait until our job finished, or the model has no
+                    # leader and our job is still pending (then lead)
+                    while job.state != _DONE and (
+                        job.state != _PENDING or model in self._leading
+                    ):
+                        # bounded wait: insurance against a lost wakeup,
+                        # never a pacing mechanism
+                        self._cv.wait(0.05)
+                    if job.state == _DONE:
+                        break
+                    self._leading.add(model)
+                    batch = self._cut_batch_locked(model)
+                try:
+                    self._run_batch(model, batch)
+                finally:
+                    with self._cv:
+                        self._leading.discard(model)
+                        for j in batch:
+                            j.state = _DONE
+                            if j.error is None and j.scores is None:
+                                # belt-and-braces: a leader crash between
+                                # claim and demux must not strand mates
+                                j.error = RuntimeError(
+                                    "micro-batch leader failed before demux"
+                                )
+                        self._cv.notify_all()
+        finally:
+            with self._cv:
+                self._inside -= 1
+        if job.error is not None:
+            raise job.error
+        return job
+
+    # -- leader internals ---------------------------------------------------- #
+    def _cut_batch_locked(self, model: str) -> list:
+        """Linger (cv held) until the forming batch fills, the linger
+        window expires, or no further request is in flight; then claim
+        up to ``max_batch`` jobs FIFO."""
+        q = self._pending[model]
+        gate = self._server.gate
+        deadline = time.monotonic() + self.linger_s
+        while len(q) < self.max_batch:
+            # an idle queue never waits: linger only while more requests
+            # are demonstrably in flight (admitted at the gate but not
+            # yet submitted here, or still queued behind the gate)
+            if gate.active() <= self._inside and gate.queue_depth() == 0:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            self._cv.wait(remaining)
+        batch = q[: self.max_batch]
+        del q[: self.max_batch]
+        for j in batch:
+            j.state = _CLAIMED
+        return batch
+
+    def _run_batch(self, model: str, batch: list) -> None:
+        """Shed expired jobs, score the rest as ONE combined call, and
+        demultiplex scores/clipped attribution back per request."""
+        server = self._server
+        now = time.monotonic()
+        live, counts, all_lines = [], [], []
+        for j in batch:
+            if j.deadline_at is not None and now > j.deadline_at:
+                # the deadline expired while the batch formed (queued or
+                # mid-linger): shed with 429, never scored — same
+                # contract as the gate's in-queue deadline shed
+                _SHED.inc(reason="deadline")
+                j.error = ShedRequest(
+                    "deadline", server.gate.estimated_wait_s())
+                continue
+            try:
+                lines = [ln for ln in j.body.decode().splitlines()
+                         if ln.strip()]
+            except UnicodeDecodeError as e:
+                j.error = e  # per-request 400; batch mates unaffected
+                continue
+            live.append(j)
+            counts.append(len(lines))
+            all_lines.extend(lines)
+        if not live:
+            return
+        t0 = time.perf_counter()
+        try:
+            combined = ("\n".join(all_lines) + "\n").encode()
+            scores = server.score_lines(combined, model)
+            if len(scores) != len(all_lines):
+                raise ValueError(
+                    f"scorer returned {len(scores)} scores for "
+                    f"{len(all_lines)} lines; cannot demultiplex"
+                )
+        except Exception:
+            # one bad request must not fail its batch mates: re-score
+            # each alone so the error lands on exactly the request that
+            # caused it.  Counted + logged — a sustained rate here means
+            # batches keep degrading to sequential and the win is gone.
+            _BATCH_FALLBACKS.inc()
+            logging.getLogger(__name__).debug(
+                "micro-batch combined call failed; re-scoring %d "
+                "request(s) individually", len(live), exc_info=True,
+            )
+            self._score_individually(live, model)
+            return
+        dt = time.perf_counter() - t0
+        _BATCH_SIZE.observe(len(live))
+        clipped_ids = getattr(server._tls, "clipped_ids", None) or ()
+        lo = 0
+        for j, n in zip(live, counts):
+            j.scores = scores[lo: lo + n]
+            j.clipped = sum(1 for i in clipped_ids if lo <= i < lo + n)
+            j.service_s = dt
+            lo += n
+        if len(live) > 1:
+            # score_lines counted the combined call as ONE request; the
+            # per-model serving counters describe client requests
+            server._count_extra_requests(model, len(live) - 1)
+
+    def _score_individually(self, live: list, model: str) -> None:
+        """Fallback when the combined call raises: score each request
+        alone so errors (malformed lines, schema mismatches) attach to
+        exactly the request that caused them — sequential semantics."""
+        server = self._server
+        for j in live:
+            t0 = time.perf_counter()
+            try:
+                j.scores = server.score_lines(j.body, model)
+                j.clipped = getattr(server._tls, "clipped", 0)
+                j.service_s = time.perf_counter() - t0
+            except Exception as e:
+                j.error = e

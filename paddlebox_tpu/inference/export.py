@@ -35,7 +35,25 @@ import jax.export  # noqa: F401  -- on jax 0.4.x the submodule is not an
 import jax.numpy as jnp
 import numpy as np
 
+from paddlebox_tpu.inference import quant
+
 FORMAT_VERSION = 1
+
+
+def resolve_embedding_dtype(embedding_dtype, row_width: int,
+                             cvm_offset: int) -> str:
+    """Normalize the artifact dtype choice: None reads the flag shim
+    (PBOX_EMBEDDING_DTYPE), and a row with no embedx columns has nothing
+    to quantize — the decision is config-global so every rank of a
+    multi-host export writes the same shard layout."""
+    from paddlebox_tpu.config import flags
+
+    dtype = quant.validate_dtype(
+        flags.embedding_dtype if embedding_dtype is None else embedding_dtype
+    )
+    if dtype != "fp32" and row_width - int(cvm_offset) - 1 <= 0:
+        dtype = "fp32"
+    return dtype
 
 
 def export_serving_programs(
@@ -50,6 +68,10 @@ def export_serving_programs(
     rank_offset_cols: int = 0,
     batch_buckets=None,
     feed_conf=None,
+    embedding_dtype=None,
+    cvm_offset: int = 2,
+    create_threshold: float = 0.0,
+    pull_embedx_scale: float = 1.0,
 ) -> list:
     """Lower + serialize the serving program ladder for ``model`` with
     ``params`` frozen in, writing ``serving*.stablehlo`` files into
@@ -61,6 +83,14 @@ def export_serving_programs(
     programs are small (dense params + lowered HLO) while the sparse
     snapshot is the multi-GB part, so a per-pass delta publish ships
     fresh programs + touched sparse rows and never the whole table.
+
+    embedding_dtype ("fp32" | "int8" | "fp8"; None reads
+    PBOX_EMBEDDING_DTYPE): with a quantized dtype the program takes
+    ``(head f32, embedx_q, scales f32)`` instead of f32 rows and fuses
+    the dequantization INTO the gathered-rows assembly on device — f32
+    rows never materialize host-side, and create_threshold /
+    pull_embedx_scale (host-resolve semantics of the f32 path) fold into
+    the same fused compute so pull parity holds either way.
     """
     uses_rank = getattr(model, "uses_rank_offset", False)
     uses_seq = getattr(model, "uses_seq_pos", False)
@@ -70,6 +100,14 @@ def export_serving_programs(
             "model consumes rank_offset: pass rank_offset_cols "
             "(DataFeedConfig.rank_offset_cols) so the serving program can "
             "take the PV-merged rank matrix as input"
+        )
+    edtype = resolve_embedding_dtype(embedding_dtype, row_width, cvm_offset)
+    co = int(cvm_offset)
+    n_embedx = row_width - co - 1
+    if edtype == "fp8" and not hasattr(jnp, "float8_e4m3fn"):
+        raise ValueError(
+            "embedding_dtype='fp8' needs jax float8_e4m3fn support, which "
+            "this jax build lacks — use 'int8' or 'fp32'"
         )
     os.makedirs(out_dir, exist_ok=True)
     frozen = jax.tree.map(jnp.asarray, params)
@@ -90,10 +128,10 @@ def export_serving_programs(
         )
     bucket_meta = []
     for B, K in buckets:
-        # extras ride in a fixed order after the three core inputs:
+        # extras ride in a fixed order after the core inputs:
         # rank_offset (when used), then seq_pos (when used) — the
         # Predictor assembles args in the same order
-        def serve(rows, key_segments, dense, *extras, B=B):
+        def model_kw(extras):
             kw = {}
             i = 0
             if uses_rank:
@@ -101,16 +139,52 @@ def export_serving_programs(
                 i += 1
             if uses_seq:
                 kw["seq_pos"] = extras[i]
-            logits = model.apply(frozen, rows, key_segments, dense, B, **kw)
+            return kw
+
+        def serve(rows, key_segments, dense, *extras, B=B):
+            logits = model.apply(frozen, rows, key_segments, dense, B,
+                                 **model_kw(extras))
+            return jax.nn.sigmoid(logits)
+
+        def serve_quant(head, embedx_q, scales, key_segments, dense,
+                        *extras, B=B):
+            # dequant FUSED into the program's row assembly: the host
+            # gathers quantized bytes + per-row scales, the device does
+            # `q * scale` — with pull_embedx_scale folded into the scale
+            # and create_threshold's visibility mask applied to
+            # embed_w + embedx exactly as the f32 host resolve does
+            emb = embedx_q.astype(jnp.float32) \
+                * (scales * pull_embedx_scale)[:, None]
+            if create_threshold > 0.0:
+                visible = (head[:, 0] >= create_threshold).astype(
+                    jnp.float32)[:, None]
+                emb = emb * visible
+                head = jnp.concatenate(
+                    [head[:, :co], head[:, co:] * visible], axis=1)
+            rows = jnp.concatenate([head, emb], axis=1)
+            logits = model.apply(frozen, rows, key_segments, dense, B,
+                                 **model_kw(extras))
             return jax.nn.sigmoid(logits)
 
         # lower for both serving platforms: a TPU-trained artifact must run
         # on a CPU-only serving host too
-        in_shapes = [
-            jax.ShapeDtypeStruct((K, row_width), jnp.float32),
-            jax.ShapeDtypeStruct((K,), jnp.int32),
-            jax.ShapeDtypeStruct((B, dense_dim), jnp.float32),
-        ]
+        if edtype == "fp32":
+            fn = serve
+            in_shapes = [
+                jax.ShapeDtypeStruct((K, row_width), jnp.float32),
+                jax.ShapeDtypeStruct((K,), jnp.int32),
+                jax.ShapeDtypeStruct((B, dense_dim), jnp.float32),
+            ]
+        else:
+            fn = serve_quant
+            qdt = jnp.int8 if edtype == "int8" else jnp.float8_e4m3fn
+            in_shapes = [
+                jax.ShapeDtypeStruct((K, co + 1), jnp.float32),
+                jax.ShapeDtypeStruct((K, n_embedx), qdt),
+                jax.ShapeDtypeStruct((K,), jnp.float32),
+                jax.ShapeDtypeStruct((K,), jnp.int32),
+                jax.ShapeDtypeStruct((B, dense_dim), jnp.float32),
+            ]
         if uses_rank:
             in_shapes.append(
                 jax.ShapeDtypeStruct((B, rank_offset_cols), jnp.int32)
@@ -119,7 +193,7 @@ def export_serving_programs(
             in_shapes.append(
                 jax.ShapeDtypeStruct((B, seq_len), jnp.int32)
             )
-        exp = jax.export.export(jax.jit(serve), platforms=("cpu", "tpu"))(
+        exp = jax.export.export(jax.jit(fn), platforms=("cpu", "tpu"))(
             *in_shapes
         )
         # the primary bucket keeps the legacy filename so pre-bucket
@@ -147,6 +221,7 @@ def export_model(
     key_capacity: int,
     dense_dim: int,
     quantize: bool = False,
+    embedding_dtype=None,
     rank_offset_cols: int = 0,
     batch_buckets=None,
     feed_conf=None,
@@ -158,10 +233,17 @@ def export_model(
     table: SparseTable/ShardedSparseTable OUTSIDE a pass (end_pass first) —
     its host store is snapshotted.  Multi-host callers export per-process
     shard files (rank in the filename) and merge at load.
-    quantize: store the snapshot's embedx columns as int8 with one global
-    scale (~4x smaller artifact — the reference's quantized xbox model
-    publish, box_wrapper.cu FeaturePullValueGpuQuant; counters + embed_w
-    stay f32 exactly as there).
+    quantize: LEGACY int8 snapshot with one global scale per shard,
+    dequantized host-side at load (~4x smaller artifact — the reference's
+    quantized xbox model publish, box_wrapper.cu
+    FeaturePullValueGpuQuant; counters + embed_w stay f32 exactly as
+    there).  Superseded by embedding_dtype, which wins when both are set.
+    embedding_dtype ("fp32" | "int8" | "fp8"; None reads
+    PBOX_EMBEDDING_DTYPE): per-ROW-scale quantized artifact whose rows
+    stay quantized end to end — on disk, in predictor memory, across the
+    host gather — with dequant fused into the serving program (see
+    export_serving_programs) and delta publishes shipping quantized rows
+    + scales (the multi-TB path shrinks ~4x).
     rank_offset_cols: for rank_offset-consuming models (RankCtrDnn), the
     feed's rank-offset matrix column count (DataFeedConfig.rank_offset_cols)
     — exported as a fourth program input.
@@ -203,8 +285,19 @@ def export_model(
     # the artifact format must be GLOBAL (every rank writes the same shard
     # layout or Predictor.load breaks): decide off config, never off this
     # rank's row count — rows with no embedx columns have nothing to quantize
-    quantize = quantize and (w - co - 1) > 0
-    if quantize:
+    edtype = resolve_embedding_dtype(embedding_dtype, w, co)
+    quantize = quantize and edtype == "fp32" and (w - co - 1) > 0
+    if edtype != "fp32":
+        # per-row-scale quantized snapshot: rows stay quantized all the
+        # way to the serving program (dequant-on-gather); empty shards
+        # write empty arrays so the loader sees a uniform format
+        head, q, scales = quant.quantize_rows(vals, co, edtype)
+        np.save(os.path.join(out_dir, "sparse", f"head-{pid:05d}.npy"), head)
+        np.save(os.path.join(out_dir, "sparse", f"embedx_q-{pid:05d}.npy"),
+                quant.store_q(q))
+        np.save(os.path.join(out_dir, "sparse", f"scales-{pid:05d}.npy"),
+                scales)
+    elif quantize:
         # embedx columns (everything past embed_w) -> int8 with one scale
         # PER SHARD FILE (each process knows only its own rows); counters +
         # embed_w stay f32 (reference quant layout).  Empty shards write
@@ -232,6 +325,9 @@ def export_model(
         dense_dim=dense_dim, row_width=w,
         rank_offset_cols=rank_offset_cols, batch_buckets=batch_buckets,
         feed_conf=feed_conf,
+        embedding_dtype=edtype, cvm_offset=co,
+        create_threshold=conf.create_threshold,
+        pull_embedx_scale=conf.pull_embedx_scale,
     )
 
     B = bucket_meta[0]["batch_size"]
@@ -251,6 +347,7 @@ def export_model(
         "create_threshold": conf.create_threshold,
         "pull_embedx_scale": conf.pull_embedx_scale,
         "quantized": bool(quantize),
+        "embedding_dtype": edtype,
         "rank_offset_cols": rank_offset_cols if uses_rank else 0,
         "seq_len": seq_len,
     }

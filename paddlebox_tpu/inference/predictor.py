@@ -16,8 +16,13 @@ pooling segment_sum), so bucket choice never changes the scores.
 The embedding resolve duplicates training's pull semantics exactly
 (sparse/table.py pull_rows): missing/padding keys read zero rows,
 create_threshold hides embeddings of under-shown features, and
-pull_embedx_scale descales a quantized table — all applied here on the
-host gather since serving has no device-resident table.
+pull_embedx_scale descales a quantized table.  For fp32 artifacts all of
+that happens here on the host gather; for per-row-scale quantized
+artifacts (``embedding_dtype`` int8/fp8) the host gathers quantized
+bytes + scales and the exported program applies dequant + threshold +
+descale on device — fp32 rows never materialize host-side, so predictor
+memory, gather bandwidth and delta-publish bytes all shrink ~4x
+(DLRM inference is embedding-bandwidth-bound, PAPERS.md).
 """
 
 from __future__ import annotations
@@ -25,23 +30,41 @@ from __future__ import annotations
 import glob
 import json
 import os
-from typing import Iterator
+from typing import Iterator, Optional
 
 import numpy as np
 
 from paddlebox_tpu.data.feed import HostBatch
+from paddlebox_tpu.inference import quant
+
+
+class EmbeddingDtypeMismatch(ValueError):
+    """A delta's embedding dtype does not match the live artifact's — a
+    merge would corrupt the table (fp32 rows spliced into int8 storage or
+    vice versa).  Structured so the Syncer's fallback ladder catches it
+    and full-reloads instead of applying."""
 
 
 class Predictor:
-    def __init__(self, meta: dict, keys: np.ndarray, values: np.ndarray,
-                 artifact_dir: str, bucket_files: list) -> None:
+    def __init__(self, meta: dict, keys: np.ndarray,
+                 values: Optional[np.ndarray], artifact_dir: str,
+                 bucket_files: list, *, head: Optional[np.ndarray] = None,
+                 embedx_q: Optional[np.ndarray] = None,
+                 scales: Optional[np.ndarray] = None) -> None:
         """bucket_files: [(batch_size, key_capacity, filename), ...].
         Programs deserialize lazily on first use (each embeds the full
         frozen dense params — eager loading would scale serving-host
-        startup with ladder size, not traffic)."""
+        startup with ladder size, not traffic).
+
+        Exactly one storage form is populated: ``values`` ([n, W] f32,
+        fp32 artifacts) or the quantized triple ``head`` ([n, co+1] f32)
+        + ``embedx_q`` ([n, E] int8/fp8) + ``scales`` ([n] f32)."""
         self.meta = meta
         self._keys = keys  # sorted uint64
-        self._values = values  # [n, W] f32
+        self._values = values  # [n, W] f32 (fp32 artifacts only)
+        self._head = head
+        self._q = embedx_q
+        self._scales = scales
         self._dir = artifact_dir
         self._buckets = bucket_files
         self._programs: dict = {}  # filename -> deserialized exported
@@ -55,6 +78,30 @@ class Predictor:
     def bucket_shapes(self) -> list:
         """[(batch_size, key_capacity), ...] of the exported ladder."""
         return [(b, k) for b, k, _ in self._buckets]
+
+    @property
+    def embedding_dtype(self) -> str:
+        """The dtype serving the embedding payload ("fp32" for legacy
+        global-scale artifacts too: those dequantize at load, so their
+        in-memory and on-device form IS f32)."""
+        return self.meta.get("embedding_dtype", "fp32")
+
+    @property
+    def _quantized(self) -> bool:
+        return self._values is None
+
+    @property
+    def artifact_bytes(self) -> int:
+        """In-memory sparse payload bytes — the footprint/bandwidth the
+        quantized format shrinks; surfaces in /models and the fleet view
+        so the win is observable end to end."""
+        n = int(self._keys.nbytes)
+        if self._quantized:
+            n += int(self._head.nbytes + self._q.nbytes
+                     + self._scales.nbytes)
+        else:
+            n += int(self._values.nbytes)
+        return n
 
     def _program(self, fname: str):
         import jax
@@ -73,27 +120,45 @@ class Predictor:
         sp = os.path.join(artifact_dir, "sparse")
         key_files = sorted(glob.glob(os.path.join(sp, "keys-*.npy")))
         keys = np.concatenate([np.load(p) for p in key_files])
-        if meta.get("quantized"):
-            # per-shard [head f32 | embedx int8 * scale] -> f32 rows
+        edtype = meta.get("embedding_dtype", "fp32")
+        order = np.argsort(keys)  # per-process shards -> one sorted table
+        keys = keys[order]
+        head = embedx_q = scales = values = None
+        if edtype != "fp32":
+            # per-row-scale quantized artifact: rows stay quantized in
+            # memory; the serving program dequantizes on gather
+            heads, qs, scs = [], [], []
+            for kf in key_files:
+                pid = kf[-9:-4]
+                heads.append(np.load(os.path.join(sp, f"head-{pid}.npy")))
+                qs.append(quant.load_q(
+                    np.load(os.path.join(sp, f"embedx_q-{pid}.npy")),
+                    edtype,
+                ))
+                scs.append(np.load(os.path.join(sp, f"scales-{pid}.npy")))
+            head = np.concatenate(heads)[order]
+            embedx_q = np.concatenate(qs)[order]
+            scales = np.concatenate(scs)[order]
+        elif meta.get("quantized"):
+            # legacy per-shard global scale: [head f32 | embedx int8 *
+            # scale] dequantized to f32 rows at load time
             shards = []
             for kf in key_files:
                 pid = kf[-9:-4]
-                head = np.load(os.path.join(sp, f"head-{pid}.npy"))
+                h = np.load(os.path.join(sp, f"head-{pid}.npy"))
                 q = np.load(os.path.join(sp, f"embedx_q-{pid}.npy"))
                 scale = float(np.load(os.path.join(sp, f"scale-{pid}.npy")))
                 shards.append(
                     np.concatenate(
-                        [head, q.astype(np.float32) * scale], axis=1
+                        [h, q.astype(np.float32) * scale], axis=1
                     )
                 )
-            values = np.concatenate(shards) if shards else np.empty(
+            values = (np.concatenate(shards) if shards else np.empty(
                 (0, meta["row_width"]), np.float32
-            )
+            ))[order]
         else:
             val_files = sorted(glob.glob(os.path.join(sp, "values-*.npy")))
-            values = np.concatenate([np.load(p) for p in val_files])
-        order = np.argsort(keys)  # per-process shards -> one sorted table
-        keys, values = keys[order], values[order]
+            values = np.concatenate([np.load(p) for p in val_files])[order]
         # pre-bucket artifacts carry no "buckets" entry: synthesize one
         bucket_meta = meta.get("buckets") or [{
             "batch_size": meta["batch_size"],
@@ -104,30 +169,103 @@ class Predictor:
             (int(bm["batch_size"]), int(bm["key_capacity"]), bm["file"])
             for bm in bucket_meta
         ]
-        return cls(meta, keys, values, artifact_dir, bucket_files)
+        return cls(meta, keys, values, artifact_dir, bucket_files,
+                   head=head, embedx_q=embedx_q, scales=scales)
 
     # -- delta hot-apply (build-aside) -------------------------------------- #
-    def with_delta(self, keys: np.ndarray, values: np.ndarray,
+    def with_delta(self, keys: np.ndarray, values: np.ndarray = None,
                    program_dir: str = None,
-                   bucket_meta: list = None) -> "Predictor":
+                   bucket_meta: list = None, *,
+                   head: np.ndarray = None, embedx_q: np.ndarray = None,
+                   scales: np.ndarray = None,
+                   embedding_dtype: str = "fp32") -> "Predictor":
         """A NEW Predictor with delta rows merged in; ``self`` is never
         mutated, so in-flight predict() calls keep a consistent snapshot
         and the caller swaps the returned object in atomically (the
         serving_sync syncer's hot-apply path).
 
         keys: uint64 delta keys (need not be sorted; deduped by last
-        occurrence order after sort).  values: [n, row_width] f32 rows —
-        existing keys are REPLACED (delta rows carry the full current
-        row, not an increment, matching SparseTable.pop_delta), genuinely
-        new keys are inserted preserving the sorted-keys invariant the
-        searchsorted resolve depends on.
+        occurrence order after sort).  For an fp32 artifact pass
+        ``values`` ([n, row_width] f32); for a quantized one pass the
+        quantized triple (``head`` + ``embedx_q`` + ``scales``) with the
+        matching ``embedding_dtype``.  Existing keys are REPLACED (delta
+        rows carry the full current row, not an increment, matching
+        SparseTable.pop_delta), genuinely new keys are inserted
+        preserving the sorted-keys invariant the searchsorted resolve
+        depends on.  A dtype that does not match the live artifact's is
+        a :class:`EmbeddingDtypeMismatch` — a structured refusal, never
+        a corrupt merge; the Syncer answers it with a full reload.
 
         program_dir/bucket_meta: when the delta shipped re-frozen serving
         programs (publisher publish_delta with model+params), point the
         new predictor at them; otherwise the existing programs (and their
         deserialization cache) are shared — sparse-only freshness.
         """
+        quant.validate_dtype(embedding_dtype)
+        if embedding_dtype != self.embedding_dtype:
+            raise EmbeddingDtypeMismatch(
+                f"delta rows are {embedding_dtype} but the live artifact "
+                f"serves {self.embedding_dtype}: chains cannot mix "
+                "embedding dtypes — republish a base"
+            )
         dk = np.ascontiguousarray(np.asarray(keys, dtype=np.uint64))
+        if self._quantized:
+            dvs = self._check_quant_delta(dk, head, embedx_q, scales)
+        else:
+            dvs = (self._check_fp32_delta(dk, values),)
+        order = np.argsort(dk, kind="stable")
+        dk = dk[order]
+        dvs = [d[order] for d in dvs]
+        if dk.shape[0] and np.any(dk[1:] == dk[:-1]):
+            # keep the LAST row per duplicate key (newest write wins)
+            last = np.ones(dk.shape[0], bool)
+            last[:-1] = dk[1:] != dk[:-1]
+            dk = dk[last]
+            dvs = [d[last] for d in dvs]
+        n = self._keys.shape[0]
+        if n and dk.shape[0]:
+            pos = np.searchsorted(self._keys, dk)
+            pos_c = np.minimum(pos, n - 1)
+            found = self._keys[pos_c] == dk
+        else:
+            pos = np.zeros(dk.shape[0], np.int64)
+            found = np.zeros(dk.shape[0], bool)
+        olds = ((self._head, self._q, self._scales) if self._quantized
+                else (self._values,))
+        news = []
+        for old, dv in zip(olds, dvs):
+            new = old.copy()
+            if found.any():
+                new[pos[found]] = dv[found]
+            if (~found).any():
+                # insertion points keep the sort order
+                new = np.insert(new, pos[~found], dv[~found], axis=0)
+            news.append(new)
+        if (~found).any():
+            new_keys = np.insert(self._keys, pos[~found], dk[~found])
+        else:
+            new_keys = self._keys
+        kw = (dict(head=news[0], embedx_q=news[1], scales=news[2])
+              if self._quantized else {})
+        new_values = None if self._quantized else news[0]
+        if program_dir is not None:
+            bm = bucket_meta or self.meta.get("buckets") or []
+            buckets = [
+                (int(b["batch_size"]), int(b["key_capacity"]), b["file"])
+                for b in bm
+            ] or list(self._buckets)
+            out = Predictor(self.meta, new_keys, new_values, program_dir,
+                            buckets, **kw)
+        else:
+            out = Predictor(self.meta, new_keys, new_values, self._dir,
+                            list(self._buckets), **kw)
+            out._programs = self._programs  # share the deserialized cache
+        return out
+
+    def _check_fp32_delta(self, dk: np.ndarray,
+                          values: np.ndarray) -> np.ndarray:
+        if values is None:
+            raise ValueError("fp32 artifact: with_delta needs `values`")
         dv = np.asarray(values, dtype=np.float32)
         w = int(self.meta["row_width"])
         if dv.ndim != 2 or dv.shape[1] < w:
@@ -139,54 +277,47 @@ class Predictor:
             raise ValueError(
                 f"delta keys/values disagree: {dk.shape[0]} vs {dv.shape[0]}"
             )
-        order = np.argsort(dk, kind="stable")
-        dk, dv = dk[order], dv[order]
-        if dk.shape[0] and np.any(dk[1:] == dk[:-1]):
-            # keep the LAST row per duplicate key (newest write wins)
-            last = np.ones(dk.shape[0], bool)
-            last[:-1] = dk[1:] != dk[:-1]
-            dk, dv = dk[last], dv[last]
-        n = self._keys.shape[0]
-        if n and dk.shape[0]:
-            pos = np.searchsorted(self._keys, dk)
-            pos_c = np.minimum(pos, n - 1)
-            found = self._keys[pos_c] == dk
-        else:
-            pos = np.zeros(dk.shape[0], np.int64)
-            found = np.zeros(dk.shape[0], bool)
-        new_vals = self._values.copy()
-        if found.any():
-            new_vals[pos[found]] = dv[found]
-        if (~found).any():
-            ins_at = pos[~found]  # insertion points keep the sort order
-            new_keys = np.insert(self._keys, ins_at, dk[~found])
-            new_vals = np.insert(new_vals, ins_at, dv[~found], axis=0)
-        else:
-            new_keys = self._keys
-        if program_dir is not None:
-            bm = bucket_meta or self.meta.get("buckets") or []
-            buckets = [
-                (int(b["batch_size"]), int(b["key_capacity"]), b["file"])
-                for b in bm
-            ] or list(self._buckets)
-            out = Predictor(self.meta, new_keys, new_vals, program_dir,
-                            buckets)
-        else:
-            out = Predictor(self.meta, new_keys, new_vals, self._dir,
-                            list(self._buckets))
-            out._programs = self._programs  # share the deserialized cache
-        return out
+        return dv
+
+    def _check_quant_delta(self, dk: np.ndarray, head, embedx_q, scales):
+        if head is None or embedx_q is None or scales is None:
+            raise ValueError(
+                "quantized artifact: with_delta needs head + embedx_q + "
+                "scales"
+            )
+        co = int(self.meta["cvm_offset"])
+        e = int(self.meta["row_width"]) - co - 1
+        dh = np.asarray(head, dtype=np.float32)
+        dq = np.asarray(embedx_q)
+        ds = np.asarray(scales, dtype=np.float32)
+        if dh.shape != (dk.shape[0], co + 1) \
+                or dq.shape != (dk.shape[0], e) \
+                or ds.shape != (dk.shape[0],):
+            raise ValueError(
+                f"quantized delta shapes disagree with the artifact: head "
+                f"{dh.shape} q {dq.shape} scales {ds.shape} for "
+                f"{dk.shape[0]} keys (co={co}, embedx={e})"
+            )
+        if dq.dtype != self._q.dtype:
+            raise EmbeddingDtypeMismatch(
+                f"delta embedx dtype {dq.dtype} != artifact {self._q.dtype}"
+            )
+        return dh, dq, ds
 
     # -- feature resolve (host) -------------------------------------------- #
+    def _find(self, batch_keys: np.ndarray, n_keys: int):
+        bk = batch_keys[:n_keys]
+        pos = np.searchsorted(self._keys, bk)
+        pos_c = np.minimum(pos, self._keys.shape[0] - 1)
+        found = self._keys[pos_c] == bk
+        return pos_c, found
+
     def _resolve_rows(self, batch_keys: np.ndarray, n_keys: int,
                       key_capacity: int) -> np.ndarray:
         m = self.meta
         rows = np.zeros((key_capacity, m["row_width"]), dtype=np.float32)
         if n_keys and self._keys.shape[0]:
-            bk = batch_keys[:n_keys]
-            pos = np.searchsorted(self._keys, bk)
-            pos_c = np.minimum(pos, self._keys.shape[0] - 1)
-            found = self._keys[pos_c] == bk
+            pos_c, found = self._find(batch_keys, n_keys)
             got = self._values[pos_c] * found[:, None]
             co = m["cvm_offset"]
             if m["pull_embedx_scale"] != 1.0:
@@ -196,6 +327,28 @@ class Predictor:
                 got[:, co:] *= visible[:, None]
             rows[:n_keys] = got
         return rows
+
+    def _resolve_rows_quant(self, batch_keys: np.ndarray, n_keys: int,
+                            key_capacity: int):
+        """Quantized gather: (head, embedx_q, scales) padded to the
+        bucket's key capacity.  No dequant, no threshold, no descale —
+        all three are fused into the serving program; missing keys read
+        zero head + zero scale, so their dequantized row is zero exactly
+        like the fp32 path's."""
+        m = self.meta
+        co = int(m["cvm_offset"])
+        e = int(m["row_width"]) - co - 1
+        head = np.zeros((key_capacity, co + 1), np.float32)
+        q = np.zeros((key_capacity, e), self._q.dtype)
+        sc = np.zeros((key_capacity,), np.float32)
+        if n_keys and self._keys.shape[0]:
+            pos_c, found = self._find(batch_keys, n_keys)
+            head[:n_keys] = self._head[pos_c] * found[:, None]
+            got_q = self._q[pos_c].copy()
+            got_q[~found] = 0
+            q[:n_keys] = got_q
+            sc[:n_keys] = self._scales[pos_c] * found
+        return head, q, sc
 
     def _pick_bucket(self, b: int, nk: int):
         """Cheapest fitting bucket by padded work (B * K), not first-fit —
@@ -243,7 +396,6 @@ class Predictor:
         nk = int(batch.n_keys)
         B, K, exported = self._pick_bucket(b, nk)
 
-        rows = self._resolve_rows(batch.keys, nk, K)
         # segments: the real keys' ids are ins * S + slot with ins < b <= B,
         # valid under bucket B too; padding ids land out of range (B * S)
         # and are dropped by the pooling segment_sum
@@ -251,7 +403,12 @@ class Predictor:
         segs[:nk] = np.asarray(batch.key_segments[:nk], np.int32)
         dense = np.zeros((B, m["dense_dim"]), np.float32)
         dense[:b] = np.asarray(batch.dense[:b], np.float32)
-        args = [rows, segs, dense]
+        if self._quantized:
+            head, q, sc = self._resolve_rows_quant(batch.keys, nk, K)
+            args = [head, q, sc, segs, dense]
+        else:
+            rows = self._resolve_rows(batch.keys, nk, K)
+            args = [rows, segs, dense]
         if m.get("rank_offset_cols", 0):
             if batch.rank_offset is None:
                 raise ValueError(

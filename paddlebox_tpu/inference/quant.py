@@ -1,0 +1,107 @@
+"""Quantized embedding-artifact helpers: per-row scales, int8/fp8 codecs.
+
+DLRM inference is embedding-bandwidth-bound ("Dissecting Embedding Bag
+Performance in DLRM Inference", "At-Scale Sparse DNN Inference",
+PAPERS.md), so the serving artifact's sparse payload dtype is a memory-
+footprint, gather-bandwidth AND multi-TB delta-publish lever all at
+once.  The format:
+
+  * head columns ``[show, clk, ..., embed_w]`` (``cvm_offset + 1`` of
+    them) stay f32 — counters feed feature admission
+    (``create_threshold``) and must compare exactly (the reference's
+    quantized xbox publish keeps them f32 too,
+    box_wrapper.cu FeaturePullValueGpuQuant);
+  * embedx columns quantize symmetrically with ONE f32 scale PER ROW
+    (``scale = amax(|row|) / dtype_max``; an all-zero row stores scale
+    1.0 so dequant is well-defined) — row-wise deterministic, so a delta
+    row quantizes bit-identically to the same row in a full export
+    (the delta round-trip equality tests/test_quantized_artifacts.py
+    pins);
+  * dequant is fused into the serving program's gather
+    (``export_serving_programs``): the program takes (head, embedx_q,
+    scales) and computes ``embedx_q.astype(f32) * scale`` on device —
+    f32 rows never materialize host-side.
+
+int8 uses the symmetric [-127, 127] grid; fp8 is ``float8_e4m3fn``
+(finite max 448) via ml_dtypes, stored on disk as raw uint8 bytes so
+``np.save`` needs no custom-dtype support.  This module is numpy-only
+(ml_dtypes lazily) so every serving-side consumer can import it without
+jax.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+QUANT_DTYPES = ("fp32", "int8", "fp8")
+FP8_MAX = 448.0  # float8_e4m3fn largest finite value
+INT8_MAX = 127.0
+
+
+def validate_dtype(name: str) -> str:
+    if name not in QUANT_DTYPES:
+        raise ValueError(
+            f"embedding_dtype must be one of {QUANT_DTYPES}, got {name!r}"
+        )
+    return name
+
+
+def fp8_numpy_dtype() -> np.dtype:
+    """The float8_e4m3fn numpy dtype (ml_dtypes ships with jax)."""
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.float8_e4m3fn)
+
+
+def quantize_rows(values: np.ndarray, cvm_offset: int,
+                  embedding_dtype: str):
+    """Split f32 rows ``[n, W]`` into ``(head f32 [n, co+1],
+    embedx_q [n, W-co-1], scales f32 [n])``.  Row-wise deterministic —
+    the same row always produces the same quantized bytes, whatever
+    export (full or delta) it rides in."""
+    validate_dtype(embedding_dtype)
+    if embedding_dtype == "fp32":
+        raise ValueError("quantize_rows: fp32 rows need no quantization")
+    values = np.asarray(values, dtype=np.float32)
+    co = int(cvm_offset)
+    if values.shape[1] <= co + 1:
+        raise ValueError(
+            f"rows of width {values.shape[1]} have no embedx columns past "
+            f"cvm_offset {co}; nothing to quantize"
+        )
+    head = np.ascontiguousarray(values[:, : co + 1])
+    embedx = values[:, co + 1:]
+    amax = (np.abs(embedx).max(axis=1) if embedx.shape[0]
+            else np.zeros((0,), np.float32))
+    qmax = INT8_MAX if embedding_dtype == "int8" else FP8_MAX
+    scales = np.where(amax > 0, amax / qmax, 1.0).astype(np.float32)
+    scaled = embedx / scales[:, None]
+    if embedding_dtype == "int8":
+        q = np.clip(np.round(scaled), -INT8_MAX, INT8_MAX).astype(np.int8)
+    else:
+        q = scaled.astype(fp8_numpy_dtype())
+    return head, q, scales
+
+
+def dequantize_rows(head: np.ndarray, q: np.ndarray,
+                    scales: np.ndarray) -> np.ndarray:
+    """The host-side inverse (test oracle + tooling; serving dequantizes
+    inside the exported program)."""
+    emb = q.astype(np.float32) * np.asarray(scales, np.float32)[:, None]
+    return np.concatenate([np.asarray(head, np.float32), emb], axis=1)
+
+
+def store_q(q: np.ndarray) -> np.ndarray:
+    """Disk form of a quantized embedx block: int8 stores natively, fp8
+    as raw uint8 bytes (np.save has no custom-dtype support)."""
+    if q.dtype == np.int8:
+        return q
+    return q.view(np.uint8)
+
+
+def load_q(raw: np.ndarray, embedding_dtype: str) -> np.ndarray:
+    """Inverse of :func:`store_q` given the artifact's declared dtype."""
+    validate_dtype(embedding_dtype)
+    if embedding_dtype == "int8":
+        return np.asarray(raw, dtype=np.int8)
+    return np.asarray(raw, dtype=np.uint8).view(fp8_numpy_dtype())

@@ -34,7 +34,11 @@ from typing import Optional
 from paddlebox_tpu import telemetry
 from paddlebox_tpu.telemetry import context as trace_context
 from paddlebox_tpu.config import DataFeedConfig, flags
-from paddlebox_tpu.inference.admission import AdmissionGate, ShedRequest
+from paddlebox_tpu.inference.admission import (
+    AdmissionGate,
+    BatchCoalescer,
+    ShedRequest,
+)
 from paddlebox_tpu.inference.predictor import Predictor
 from paddlebox_tpu.utils.monitor import stats
 
@@ -105,6 +109,11 @@ def _entry_health(e) -> dict:
         "age_seconds": age,
         "seq": version.get("seq"),
         "lineage": version.get("lineage"),
+        # the quantization win, observable per replica: in-memory sparse
+        # payload bytes + the embedding dtype serving them (getattr-
+        # guarded: stub predictors in tests carry neither)
+        "artifact_bytes": getattr(e.predictor, "artifact_bytes", None),
+        "embedding_dtype": getattr(e.predictor, "embedding_dtype", None),
     }
 
 
@@ -150,11 +159,22 @@ class ScoringServer:
     def __init__(self, max_queue: Optional[int] = None,
                  max_concurrency: Optional[int] = None,
                  request_deadline_ms: Optional[float] = None,
-                 max_body_bytes: Optional[int] = None) -> None:
+                 max_body_bytes: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 batch_linger_ms: Optional[float] = None) -> None:
         """Admission/parsing knobs default from the flag shim
         (PBOX_SERVE_MAX_QUEUE / PBOX_SERVE_MAX_CONCURRENCY /
-        PBOX_REQUEST_DEADLINE_MS / PBOX_SERVE_MAX_BODY_BYTES) so a fleet
-        is tuned with env vars, no code changes."""
+        PBOX_REQUEST_DEADLINE_MS / PBOX_SERVE_MAX_BODY_BYTES /
+        PBOX_SERVE_MAX_BATCH / PBOX_SERVE_BATCH_LINGER_MS) so a fleet
+        is tuned with env vars, no code changes.
+
+        max_batch > 1 turns on continuous micro-batching on the HTTP
+        path: up to that many concurrently admitted requests coalesce
+        into ONE padded-bucket device call (admission.BatchCoalescer) —
+        the gate then admits ``max_concurrency * max_batch`` requests at
+        once (a whole forming batch counts as one scoring call in
+        flight), and its EWMA tracks per-BATCH service time, so the
+        shed math keeps estimating per-request waits correctly."""
         self._models: dict[str, ModelEntry] = {}
         self._default: Optional[str] = None
         self._lock = threading.Lock()  # serializes scoring (device work)
@@ -165,13 +185,24 @@ class ScoringServer:
             flags.serve_max_body_bytes if max_body_bytes is None
             else max_body_bytes
         )
+        self.max_batch = max(1, int(
+            flags.serve_max_batch if max_batch is None else max_batch
+        ))
+        linger_ms = float(
+            flags.serve_batch_linger_ms
+            if batch_linger_ms is None else batch_linger_ms
+        )
         self.gate = AdmissionGate(
             max_concurrency=int(flags.serve_max_concurrency
                                 if max_concurrency is None
-                                else max_concurrency),
+                                else max_concurrency) * self.max_batch,
             max_queue=int(flags.serve_max_queue
                           if max_queue is None else max_queue),
             default_deadline_s=(deadline_ms / 1e3 if deadline_ms else None),
+        )
+        self._coalescer = (
+            BatchCoalescer(self, self.max_batch, linger_ms / 1e3)
+            if self.max_batch > 1 else None
         )
         # degraded-mode advertisements: reason -> detail.  The server
         # keeps serving while any are set; /healthz carries them so the
@@ -346,6 +377,8 @@ class ScoringServer:
         lens = np.diff(block.key_offsets[:: block.n_sparse_slots])
         buckets = predictor.bucket_shapes
         clipped = 0
+        clipped_ids: list = []  # global instance indices that clipped —
+        # the micro-batch coalescer attributes them back per request
 
         def score_ids(ids) -> list:
             nonlocal clipped
@@ -363,6 +396,7 @@ class ScoringServer:
             batch = builder.build(block, ids)
             if builder.dropped_keys > d0:
                 clipped += len(ids)
+                clipped_ids.extend(int(i) for i in ids)
             return [float(s) for s in predictor.predict(batch)]
 
         with self._lock, telemetry.span(
@@ -374,10 +408,20 @@ class ScoringServer:
         if clipped:
             _CLIPPED.inc(clipped, model=entry.name)
         self._tls.clipped = clipped
+        self._tls.clipped_ids = clipped_ids
         with self._meta_lock:
             entry.requests += 1
             entry.instances += len(scores)
         return scores
+
+    def _count_extra_requests(self, name: str, n: int) -> None:
+        """The coalescer scored ``n + 1`` client requests as one combined
+        score_lines call; keep the per-model request counter describing
+        CLIENT requests, not device calls."""
+        with self._meta_lock:
+            entry = self._models.get(name)
+            if entry is not None:
+                entry.requests += n
 
     # -- http -------------------------------------------------------------- #
     def _handler(self):
@@ -465,6 +509,10 @@ class ScoringServer:
                             "published_at": v.get("published_at"),
                             "age_seconds": age,
                             "lineage": v.get("lineage"),
+                            "artifact_bytes": getattr(
+                                e.predictor, "artifact_bytes", None),
+                            "embedding_dtype": getattr(
+                                e.predictor, "embedding_dtype", None),
                         }
                     self._send(200, {"models": models,
                                      "default": server._default})
@@ -561,8 +609,10 @@ class ScoringServer:
                     body = self._read_body()
                     if body is None:
                         return
+                    t_arrival = time.monotonic()
+                    deadline_s = self._deadline_s()
                     try:
-                        server.gate.admit(self._deadline_s())
+                        server.gate.admit(deadline_s)
                     except ShedRequest as shed:
                         # overload: refuse LOUDLY and cheaply at admission
                         # (429 + Retry-After) instead of queuing past the
@@ -575,14 +625,44 @@ class ScoringServer:
                             headers={"Retry-After": shed.retry_after_header},
                         )
                         return
-                    t_score = time.perf_counter()
+                    service_s = None
                     try:
-                        server._tls.clipped = 0
-                        scores = server.score_lines(body, name)
+                        try:
+                            if server._coalescer is not None:
+                                # continuous micro-batching: the request's
+                                # deadline stays anchored at ARRIVAL, so
+                                # gate-queue time and linger time both
+                                # count against it
+                                deadline_at = (
+                                    t_arrival + deadline_s
+                                    if deadline_s and deadline_s > 0
+                                    else None
+                                )
+                                job = server._coalescer.score(
+                                    body, name, deadline_at)
+                                scores, clipped = job.scores, job.clipped
+                                service_s = job.service_s
+                            else:
+                                t_score = time.perf_counter()
+                                server._tls.clipped = 0
+                                scores = server.score_lines(body, name)
+                                clipped = getattr(server._tls, "clipped", 0)
+                                service_s = time.perf_counter() - t_score
+                        except ShedRequest as shed:
+                            # the deadline expired while the micro-batch
+                            # formed: shed with 429, never scored
+                            self._send(
+                                429,
+                                {"error": f"overloaded: {shed.reason}",
+                                 "retry_after_s":
+                                     round(shed.retry_after_s, 3)},
+                                headers={"Retry-After":
+                                         shed.retry_after_header},
+                            )
+                            return
                     finally:
-                        server.gate.release(time.perf_counter() - t_score)
+                        server.gate.release(service_s)
                     payload = {"scores": scores}
-                    clipped = getattr(server._tls, "clipped", 0)
                     if clipped:
                         # surfaced only when capacity actually truncated
                         # features: callers alert on its presence

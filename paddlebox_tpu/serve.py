@@ -93,6 +93,15 @@ def _build_parser() -> argparse.ArgumentParser:
                          "+ Retry-After (clients override via the "
                          "X-Request-Deadline-Ms header; default "
                          "PBOX_REQUEST_DEADLINE_MS, 0 = no deadline)")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="continuous micro-batching width: up to this "
+                         "many queued /score requests coalesce into one "
+                         "device call (default PBOX_SERVE_MAX_BATCH; 1 = "
+                         "one-at-a-time)")
+    ap.add_argument("--batch-linger-ms", type=float, default=None,
+                    help="max wait for a forming micro-batch to fill "
+                         "(default PBOX_SERVE_BATCH_LINGER_MS; an idle "
+                         "queue never waits)")
     ap.add_argument("--log-dir", default=None,
                     help="fleet mode: write per-replica logs here")
     return ap
@@ -125,6 +134,10 @@ def _replica_argv(args, replica_id: int, port: int) -> list:
         argv += ["--max-queue", str(args.max_queue)]
     if args.request_deadline_ms is not None:
         argv += ["--request-deadline-ms", str(args.request_deadline_ms)]
+    if args.max_batch is not None:
+        argv += ["--max-batch", str(args.max_batch)]
+    if args.batch_linger_ms is not None:
+        argv += ["--batch-linger-ms", str(args.batch_linger_ms)]
     return argv
 
 
@@ -185,6 +198,8 @@ def main(argv=None) -> None:
     server = ScoringServer(
         max_queue=args.max_queue,
         request_deadline_ms=args.request_deadline_ms,
+        max_batch=args.max_batch,
+        batch_linger_ms=args.batch_linger_ms,
     )
     for spec in args.artifact:
         name, sep, path = spec.partition("=")

@@ -139,7 +139,11 @@ class ReplicaHandle:
             "queue_depth": self.health.get("queue_depth"),
             "models": {
                 n: {"seq": m.get("seq"), "age_seconds": m.get("age_seconds"),
-                    "lineage": m.get("lineage")}
+                    "lineage": m.get("lineage"),
+                    # the quantization byte win per replica, straight off
+                    # the probe payload (_entry_health)
+                    "artifact_bytes": m.get("artifact_bytes"),
+                    "embedding_dtype": m.get("embedding_dtype")}
                 for n, m in models.items()
             },
         }
@@ -315,6 +319,16 @@ class FleetRouter:
         """Forward one client request with failover.  Returns (status,
         body, headers) for the handler to relay.
 
+        Deadline-aware retry math: with an ``X-Request-Deadline-Ms``
+        header, every retry decision charges the time already burned in
+        earlier attempts against the client's budget — the forwarded
+        header carries only the REMAINING milliseconds (so a replica's
+        admission gate, which under micro-batching estimates queue +
+        linger waits against that number, sheds on what is actually
+        left), and once the budget is spent the router stops failing
+        over (a replica would shed it anyway; retrying is pure waste)
+        and answers the best shed seen, else 504.
+
         Tracing: each forward attempt runs under its own ``fleet.attempt``
         child span of the active trace context, and the forwarded
         ``traceparent`` header carries that attempt's span — the replica's
@@ -323,10 +337,18 @@ class FleetRouter:
         under ONE trace ID.  The response names the replica that actually
         served in ``X-PBox-Replica``."""
         t0 = time.perf_counter()
+        deadline_ms = _deadline_ms_header(headers)
         candidates = self.route_candidates()
         shed: Optional[Tuple[int, bytes, dict]] = None
         tried = 0
+        expired = False
         for r in candidates:
+            remaining_ms = None
+            if deadline_ms is not None:
+                remaining_ms = deadline_ms - (time.perf_counter() - t0) * 1e3
+                if remaining_ms <= 0:
+                    expired = True
+                    break
             tried += 1
             try:
                 with telemetry.span("fleet.attempt", replica=r.addr,
@@ -339,6 +361,9 @@ class FleetRouter:
                     if attempt_ctx is not None:
                         fwd[trace_context.TRACEPARENT_HEADER] = \
                             attempt_ctx.to_traceparent()
+                    if remaining_ms is not None:
+                        fwd["X-Request-Deadline-Ms"] = \
+                            f"{max(remaining_ms, 1.0):.0f}"
                     status, data, hdrs = self._forward(
                         r, method, path, body, fwd)
             except Exception as e:
@@ -374,6 +399,18 @@ class FleetRouter:
             _REQUESTS.inc(outcome="shed")
             _ROUTE_SECONDS.observe(time.perf_counter() - t0, outcome="shed")
             return shed
+        if expired:
+            # the client's deadline died during routing/failover with no
+            # replica having shed it: 504, not 429 — "your budget ran
+            # out here", distinguishable from "we are overloaded"
+            _REQUESTS.inc(outcome="deadline")
+            _ROUTE_SECONDS.observe(time.perf_counter() - t0,
+                                   outcome="deadline")
+            return 504, json.dumps({
+                "error": "request deadline exhausted during fleet "
+                         "routing/failover",
+                "deadline_ms": deadline_ms,
+            }).encode(), {"Content-Type": "application/json"}
         _REQUESTS.inc(outcome="no_replica")
         _ROUTE_SECONDS.observe(time.perf_counter() - t0,
                                outcome="no_replica")
@@ -516,3 +553,16 @@ def _retry_after(headers: dict) -> float:
         return float(headers.get("Retry-After", "inf"))
     except ValueError:
         return float("inf")
+
+
+def _deadline_ms_header(headers: dict) -> Optional[float]:
+    """The client's positive deadline budget, or None (absent/garbage —
+    a malformed hint must not turn a routable request into an error)."""
+    raw = headers.get("X-Request-Deadline-Ms")
+    if raw is None:
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        return None
+    return ms if ms > 0 else None

@@ -26,6 +26,7 @@ upload verified, so a failed publish re-ships the same rows next time.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import os
@@ -62,6 +63,19 @@ _PUBLISHED = telemetry.counter(
 _GATED = telemetry.counter(
     "publish.gated", help="publishes held back by the health gate"
 )
+_PUBLISH_BYTES = telemetry.counter(
+    "publish.bytes",
+    help="bytes uploaded per published model unit, by kind — the "
+         "quantized-artifact byte win, observable at publish time",
+)
+
+
+def _dir_bytes(local: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(local):
+        for f in files:
+            total += os.path.getsize(os.path.join(root, f))
+    return total
 
 
 class PublishError(RuntimeError):
@@ -152,6 +166,7 @@ class Publisher:
         dense_dim: int,
         feed_conf=None,
         quantize: bool = False,
+        embedding_dtype=None,
         rank_offset_cols: int = 0,
         batch_buckets=None,
         metrics: Optional[dict] = None,
@@ -161,6 +176,14 @@ class Publisher:
         """Export + publish a full serving artifact; restarts the delta
         chain.  Returns the donefile entry, or None when the health gate
         held it back.
+
+        embedding_dtype ("fp32" | "int8" | "fp8"; None reads
+        PBOX_EMBEDDING_DTYPE): the artifact's quantized-embedding format
+        (inference/quant.py).  It anchors the CHAIN's dtype: every delta
+        published on this base ships rows in the same dtype, and a
+        consumer refuses to merge a mismatched delta
+        (EmbeddingDtypeMismatch → Syncer full reload), so a chain can
+        never mix dtypes into a corrupt table.
 
         lineage: the producing pass/window identity (``pass12``, ``w3-7``)
         — carried through the donefile into the syncer's applied version
@@ -172,8 +195,13 @@ class Publisher:
         meta = dict(meta or {})
         if lineage is not None:
             meta["lineage"] = str(lineage)
-        from paddlebox_tpu.inference.export import export_model
+        from paddlebox_tpu.inference.export import (
+            resolve_embedding_dtype,
+            export_model,
+        )
 
+        edtype = resolve_embedding_dtype(
+            embedding_dtype, table.conf.row_width, table.conf.cvm_offset)
         with telemetry.span("publish.base", tag=tag), \
                 _PUBLISH_SECONDS.time(kind="base"):
             local = os.path.join(self.staging, f"base-{tag}")
@@ -183,22 +211,29 @@ class Publisher:
                 model, params, table, local,
                 batch_size=batch_size, key_capacity=key_capacity,
                 dense_dim=dense_dim, quantize=quantize,
+                embedding_dtype=edtype,
                 rank_offset_cols=rank_offset_cols,
                 batch_buckets=batch_buckets, feed_conf=feed_conf,
             )
             write_manifest(local, "manifest.json", recursive=True)
-            self._upload(local, f"base-{tag}", site="publish.upload")
+            self._upload(local, f"base-{tag}", site="publish.upload",
+                         kind="base")
             self._export_kw = {
                 "batch_size": batch_size, "key_capacity": key_capacity,
                 "dense_dim": dense_dim, "row_width": table.conf.row_width,
                 "rank_offset_cols": rank_offset_cols,
                 "batch_buckets": batch_buckets, "feed_conf": feed_conf,
+                "embedding_dtype": edtype,
+                "cvm_offset": table.conf.cvm_offset,
+                "create_threshold": table.conf.create_threshold,
+                "pull_embedx_scale": table.conf.pull_embedx_scale,
             }
             entry = PublishEntry(
                 seq=self.next_seq, kind="base", tag=tag, dir=f"base-{tag}",
                 base_tag=tag, prev_tag=self.last_tag,
                 published_at=time.time(), n_rows=int(table.n_features),
-                has_programs=True, meta=dict(meta or {}),
+                has_programs=True, embedding_dtype=edtype,
+                n_bytes=_dir_bytes(local), meta=dict(meta or {}),
             )
             self._append_donefile(entry)
             # a new base anchors a fresh chain: rows tracked so far are
@@ -235,6 +270,11 @@ class Publisher:
         the next publish ships them again (at-least-once delivery of
         every touched row).
 
+        Delta rows ship in the CHAIN's embedding dtype (the base entry's
+        ``embedding_dtype``): a quantized chain publishes per-row-scale
+        quantized rows (head + embedx_q + scales — the multi-TB path
+        shrinks ~4x), never f32 rows a consumer would refuse to merge.
+
         lineage: producing pass/window identity (see publish_base)."""
         if self._gated(metrics):
             return None
@@ -253,23 +293,35 @@ class Publisher:
                     "a publish_base): pass batch_size/key_capacity/"
                     "dense_dim explicitly"
                 )
-            kw = {**(self._export_kw or {}), **export_overrides}
+        kw = {**(self._export_kw or {}), **export_overrides}
+        edtype = kw.get("embedding_dtype") or self._chain_dtype()
         with telemetry.span("publish.delta", tag=tag), \
                 _PUBLISH_SECONDS.time(kind="delta"):
+            from paddlebox_tpu.inference import quant
             from paddlebox_tpu.inference.export import (
                 export_serving_programs,
             )
 
             state = table.delta_state_dict()
             w = table.conf.row_width
+            co = table.conf.cvm_offset
             keys = np.asarray(state["keys"], dtype=np.uint64)
             values = np.asarray(state["values"], dtype=np.float32)[:, :w]
             local = os.path.join(self.staging, f"delta-{tag}")
             if os.path.exists(local):
                 shutil.rmtree(local)
             os.makedirs(local)
-            np.savez(os.path.join(local, DELTA_ROWS_NAME),
-                     keys=keys, values=values)
+            if edtype != "fp32":
+                # quantize row-wise with the shared codec: a delta row's
+                # bytes are identical to the same row in a full export,
+                # so base + deltas == fresh full export stays bit-exact
+                head, q, scales = quant.quantize_rows(values, co, edtype)
+                np.savez(os.path.join(local, DELTA_ROWS_NAME),
+                         keys=keys, head=head, embedx_q=quant.store_q(q),
+                         scales=scales)
+            else:
+                np.savez(os.path.join(local, DELTA_ROWS_NAME),
+                         keys=keys, values=values)
             buckets = []
             if with_programs:
                 buckets = export_serving_programs(
@@ -281,23 +333,32 @@ class Publisher:
                     rank_offset_cols=kw.get("rank_offset_cols", 0),
                     batch_buckets=kw.get("batch_buckets"),
                     feed_conf=kw.get("feed_conf"),
+                    embedding_dtype=edtype,
+                    cvm_offset=kw.get("cvm_offset", co),
+                    create_threshold=kw.get(
+                        "create_threshold", table.conf.create_threshold),
+                    pull_embedx_scale=kw.get(
+                        "pull_embedx_scale", table.conf.pull_embedx_scale),
                 )
             entry = PublishEntry(
                 seq=self.next_seq, kind="delta", tag=tag,
                 dir=f"delta-{tag}", base_tag=self.base_tag,
                 prev_tag=self.last_tag, published_at=time.time(),
                 n_rows=int(keys.shape[0]), has_programs=bool(buckets),
-                meta=dict(meta or {}),
+                embedding_dtype=edtype, meta=dict(meta or {}),
             )
             with open(os.path.join(local, DELTA_META_NAME), "w") as fh:
                 json.dump({
                     "kind": "delta", "tag": tag, "seq": entry.seq,
                     "base_tag": entry.base_tag, "prev_tag": entry.prev_tag,
                     "row_width": w, "n_rows": entry.n_rows,
+                    "embedding_dtype": edtype,
                     "buckets": buckets, "published_at": entry.published_at,
                 }, fh)
             write_manifest(local, "manifest.json", recursive=True)
-            self._upload(local, f"delta-{tag}", site="publish.delta")
+            entry = dataclasses.replace(entry, n_bytes=_dir_bytes(local))
+            self._upload(local, f"delta-{tag}", site="publish.delta",
+                         kind="delta")
             self._append_donefile(entry)
             table.clear_delta()  # only once the entry is visible
             _PUBLISHED.inc(kind="delta")
@@ -307,8 +368,18 @@ class Publisher:
             )
             return entry
 
+    def _chain_dtype(self) -> str:
+        """The live chain's embedding dtype: the newest base entry's.
+        A resumed publisher (no publish_base this process) reads it off
+        the donefile so its deltas keep matching the chain."""
+        for e in reversed(self._entries):
+            if e.kind == "base":
+                return getattr(e, "embedding_dtype", "fp32") or "fp32"
+        return "fp32"
+
     # -- transport ---------------------------------------------------------- #
-    def _upload(self, local: str, basename: str, site: str) -> None:
+    def _upload(self, local: str, basename: str, site: str,
+                kind: str = "base") -> None:
         dest = os.path.join(self.root, basename)
         retry_call(self.fs.mkdir, self.root, site="publish.mkdir")
 
@@ -321,6 +392,10 @@ class Publisher:
                 verify_checkpoint_dir(dest, fs=self.fs)
 
         retry_call(upload_once, site=site)
+        # counted only after the verified upload: publish.bytes describes
+        # bytes that actually LANDED, so the fp32-vs-quantized byte win
+        # reads straight off the counter
+        _PUBLISH_BYTES.inc(_dir_bytes(local), kind=kind)
 
     def _append_donefile(self, entry: PublishEntry) -> None:
         """Append locally, then upload the whole donefile — LAST, after

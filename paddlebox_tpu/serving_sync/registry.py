@@ -59,6 +59,13 @@ class PublishEntry:
     published_at: float
     n_rows: int = 0
     has_programs: bool = True  # delta shipped re-frozen serving programs
+    # the chain's embedding payload dtype (inference/quant.py): a base
+    # anchors it, every delta on the chain must match — pre-quantization
+    # donefiles parse with the fp32 default
+    embedding_dtype: str = "fp32"
+    # bytes of the published unit as staged+verified (the multi-TB-path
+    # shrink, readable straight off the donefile)
+    n_bytes: int = 0
     meta: dict = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
@@ -121,6 +128,8 @@ class ModelVersion:
     # (PublishEntry.meta["lineage"]): the attribution hook — which
     # training window is this served model made of?
     lineage_id: Optional[str] = None
+    # the chain's embedding payload dtype (set by the applied base)
+    embedding_dtype: str = "fp32"
 
     @property
     def tag(self) -> str:
@@ -154,6 +163,7 @@ class ModelVersion:
             "published_at": self.published_at,
             "applied_at": self.applied_at,
             "lineage": self.lineage_id,
+            "embedding_dtype": self.embedding_dtype,
         }
 
 

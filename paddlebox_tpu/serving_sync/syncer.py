@@ -292,6 +292,7 @@ class Syncer:
             name=self.name, base_tag=entry.tag, seq=entry.seq,
             published_at=entry.published_at, applied_at=time.time(),
             lineage_id=entry.meta.get("lineage"),
+            embedding_dtype=predictor.embedding_dtype,
         )
         self._install(version, predictor, feed_conf=feed_conf)
         _APPLIED.inc(kind="base")
@@ -309,14 +310,31 @@ class Syncer:
                 f"delta {entry.tag}: row_width {dmeta.get('row_width')} != "
                 f"live artifact {w}"
             )
-        with np.load(os.path.join(local, DELTA_ROWS_NAME)) as d:
-            keys, values = d["keys"], d["values"]
         buckets = dmeta.get("buckets") or []
-        new_predictor = predictor.with_delta(
-            keys, values,
-            program_dir=local if buckets else None,
-            bucket_meta=buckets or None,
-        )
+        edtype = dmeta.get("embedding_dtype", "fp32")
+        # Predictor.with_delta refuses a dtype that doesn't match the live
+        # artifact (EmbeddingDtypeMismatch) — that structured refusal
+        # lands in poll_once's apply-failure handler and full-reloads,
+        # never a corrupt fp32-into-int8 merge
+        with np.load(os.path.join(local, DELTA_ROWS_NAME)) as d:
+            if edtype != "fp32":
+                from paddlebox_tpu.inference import quant
+
+                new_predictor = predictor.with_delta(
+                    d["keys"],
+                    program_dir=local if buckets else None,
+                    bucket_meta=buckets or None,
+                    head=d["head"],
+                    embedx_q=quant.load_q(d["embedx_q"], edtype),
+                    scales=d["scales"],
+                    embedding_dtype=edtype,
+                )
+            else:
+                new_predictor = predictor.with_delta(
+                    d["keys"], d["values"],
+                    program_dir=local if buckets else None,
+                    bucket_meta=buckets or None,
+                )
         self._install(version.extend(entry), new_predictor)
         _APPLIED.inc(kind="delta")
 

@@ -49,10 +49,14 @@ class _StubPredictor:
 
 
 def _stub_server(service_ms=1.0, max_queue=64, max_concurrency=1,
-                 deadline_ms=None, tag=0.5):
+                 deadline_ms=None, tag=0.5, max_batch=1):
     """A REAL ScoringServer (HTTP stack, admission gate, drain, degraded
     flags) whose scoring is a stub: `tag` per line after `service_ms` of
-    simulated device time under the real scoring lock."""
+    simulated device time under the real scoring lock.  max_batch
+    defaults to 1 (not the production flag default): the shed/deadline
+    pins below were calibrated for the one-at-a-time admission math —
+    micro-batched admission has its own coverage in
+    tests/test_microbatch.py."""
     conf = DataFeedConfig(
         slots=(SlotConfig("click", type="float", is_dense=True),
                SlotConfig("s0")),
@@ -60,7 +64,8 @@ def _stub_server(service_ms=1.0, max_queue=64, max_concurrency=1,
     )
     srv = ScoringServer(max_queue=max_queue,
                         max_concurrency=max_concurrency,
-                        request_deadline_ms=deadline_ms)
+                        request_deadline_ms=deadline_ms,
+                        max_batch=max_batch)
     srv.register_predictor("stub", _StubPredictor(), conf)
 
     def score_lines(text, name=None):
@@ -387,6 +392,59 @@ def test_router_http_front_door_and_fleet_view():
         router.stop()
         srv_a.stop()
         srv_b.stop()
+
+
+def test_router_deadline_aware_retry_math():
+    """Deadline-aware failover: the forwarded X-Request-Deadline-Ms
+    carries only the REMAINING client budget (a replica's admission gate
+    must shed against what is actually left, not the original number),
+    and once the budget is spent mid-failover the router stops retrying
+    and answers 504 instead of burning more replicas."""
+    seen = {}
+    srv = _stub_server(tag=1.0)
+    orig = srv.score_lines
+
+    def recording_score(text, name=None):
+        return orig(text, name)
+
+    srv.score_lines = recording_score
+    p = srv.start(port=0)
+    router = FleetRouter([f"127.0.0.1:{p}"], probe_interval_s=60)
+    try:
+        router.probe_once()
+        # capture what the replica actually receives: forward through a
+        # recording proxy of router._forward
+        orig_fwd = router._forward
+
+        def capture_forward(r, method, path, body, headers):
+            seen["deadline"] = headers.get("X-Request-Deadline-Ms")
+            return orig_fwd(r, method, path, body, headers)
+
+        router._forward = capture_forward
+        st, data, _ = router.route_request(
+            "POST", "/score", BODY, {"X-Request-Deadline-Ms": "30000"})
+        assert st == 200
+        fwd = float(seen["deadline"])
+        # remaining budget, not the original: strictly less, same order
+        assert 0 < fwd <= 30000
+        router._forward = orig_fwd
+
+        # an already-spent budget never reaches a replica: 504, zero
+        # attempts (scoring is idempotent but not free)
+        calls = {"n": 0}
+
+        def counting_forward(r, method, path, body, headers):
+            calls["n"] += 1
+            return orig_fwd(r, method, path, body, headers)
+
+        router._forward = counting_forward
+        st, data, _ = router.route_request(
+            "POST", "/score", BODY, {"X-Request-Deadline-Ms": "0.000001"})
+        assert st == 504 and b"deadline" in data
+        assert calls["n"] == 0
+    finally:
+        router.stop()
+        srv.stop()
 
 
 def test_router_caps_body_at_front_door():
