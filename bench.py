@@ -75,16 +75,30 @@ def telemetry_summary(max_counters: int = 40) -> dict:
                     "p50_ms": round((s["p50"] or 0) * 1e3, 3),
                     "p99_ms": round((s["p99"] or 0) * 1e3, 3),
                 }
-    return {"counters": counters, "stage_ms": stages}
+    # per-stage XLA compile counts (the retrace witness): a steady-state
+    # bench row should show each stage compiling during warmup and NEVER
+    # again — a growing count across rows is the silent-retrace regression
+    # the jit-retrace-hazard lint pass exists to prevent
+    from paddlebox_tpu.telemetry.compiles import compiles_by_stage
+
+    return {"counters": counters, "stage_ms": stages,
+            "jit_compiles": compiles_by_stage()}
 
 
-def emit_unavailable(error: str, metric: str, unit: str) -> None:
+def emit_unavailable(error: str, metric: str, unit: str,
+                     kind: str = "backend_init_failed",
+                     attempts: int = 0, elapsed_s: float = 0.0) -> None:
     """The backend-failure diagnostic line: value null can never pass as a
     measurement, but the artifact's last JSON line explains itself (and
     names the metric+unit the run was FOR, so a driver keying on either
-    still matches)."""
+    still matches).  ``error_kind``/``attempts``/``elapsed_s`` make the
+    axon stale-lease triage machine-readable: a driver can distinguish a
+    hang (``backend_init_hang`` — re-run after the lease expires) from a
+    refused init (retry later) without parsing prose."""
     emit({"metric": metric, "value": None, "unit": unit,
           "vs_baseline": None, "backend": "unavailable",
+          "error_kind": kind, "attempts": attempts,
+          "elapsed_s": round(elapsed_s, 1),
           "error": error[:300]})
 
 
@@ -97,34 +111,42 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
     The axon TPU tunnel is a single-client resource with two failure modes:
     (a) "Unable to initialize backend ... UNAVAILABLE" at first device query
     — retried with backoff; (b) a silent HANG inside the first device query
+    — or the first COMPILE after it (the lease can wedge either RPC) —
     when the server side holds a stale client lease (observed r3: >3h of
     hanging jax.devices() after an abrupt client kill).  The hang is inside
     a C call no Python timeout can interrupt, so a watchdog thread turns it
     into a diagnosable exit instead of the driver's mute rc=124.
     round 2 post-mortem: VERDICT.md weak #2 — bench died at backend init
-    with zero retry and the round recorded no perf number at all.
+    with zero retry and the round recorded no perf number at all; BENCH_r01
+    -r05: every round lost to exactly this hang, hence the first-compute
+    probe — a backend that enumerates devices but cannot run ``1+1`` within
+    the deadline is DOWN, and the round should say so and exit re-runnably.
     """
     import threading
 
     import jax
 
     done = threading.Event()
-    # per-ATTEMPT deadline, bumped around each device query so legitimate
-    # slow-failing retries and backoff sleeps never trip it — only a single
-    # query exceeding hang_timeout does
-    state = {"deadline": time.monotonic() + hang_timeout}
+    t_start = time.monotonic()
+    # per-ATTEMPT monotonic deadline, bumped around each device query /
+    # probe so legitimate slow-failing retries and backoff sleeps never
+    # trip it — only a single hung call exceeding hang_timeout does
+    state = {"deadline": time.monotonic() + hang_timeout, "attempt": 0,
+             "phase": "device query"}
 
     def watchdog():
         while not done.wait(5.0):
             if time.monotonic() > state["deadline"]:
-                log(f"FATAL: one backend init attempt hung "
+                log(f"FATAL: backend {state['phase']} hung "
                     f">{hang_timeout:.0f}s (axon tunnel holds a stale client "
                     "lease?) — exiting so the driver records a diagnosable "
                     "failure, not a timeout")
                 # a parseable diagnostic beats a bare rc=3
                 emit_unavailable(
-                    "axon backend init hung (stale client lease); no "
-                    "measurement taken", metric, unit,
+                    f"axon backend {state['phase']} hung (stale client "
+                    "lease); no measurement taken", metric, unit,
+                    kind="backend_init_hang", attempts=state["attempt"],
+                    elapsed_s=time.monotonic() - t_start,
                 )
                 os._exit(3)
 
@@ -132,9 +154,26 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
     try:
         last = None
         for attempt in range(1, max_tries + 1):
+            state["attempt"] = attempt
             try:
+                state["phase"] = "device query"
                 state["deadline"] = time.monotonic() + hang_timeout
                 devs = jax.devices()
+                # first-compute probe under the same deadline: a stale
+                # lease can pass enumeration and wedge the first real
+                # dispatch — probe with a trivial op so the hang (or
+                # error) lands HERE, attributably, not minutes into the
+                # first measured stage
+                state["phase"] = "first-compute probe"
+                state["deadline"] = time.monotonic() + hang_timeout
+                import jax.numpy as jnp
+
+                float(jnp.ones((), jnp.float32) + 1.0)
+                from paddlebox_tpu.telemetry.compiles import (
+                    install_compile_listener,
+                )
+
+                install_compile_listener()
                 log(f"backend ok (attempt {attempt}): "
                     f"{[f'{d.platform}:{d.id}' for d in devs]}")
                 return devs
@@ -145,13 +184,14 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
                 if attempt == max_tries:
                     break  # no further attempt: don't sleep the backoff
                 delay = base_delay * attempt
-                log(f"backend init failed (attempt {attempt}/{max_tries}): "
-                    f"{e!r} — retrying in {delay:.0f}s")
+                log(f"backend init failed (attempt {attempt}/{max_tries}, "
+                    f"{state['phase']}): {e!r} — retrying in {delay:.0f}s")
                 state["deadline"] = time.monotonic() + delay + hang_timeout
                 time.sleep(delay)
         emit_unavailable(
             f"backend init failed after {max_tries} tries: {last!r}",
-            metric, unit,
+            metric, unit, kind="backend_init_failed", attempts=max_tries,
+            elapsed_s=time.monotonic() - t_start,
         )
         raise RuntimeError(
             f"backend unavailable after {max_tries} tries: {last!r}"
@@ -501,6 +541,9 @@ def _ablation_times(trainer, model, tconf, params, opt_state, values, g2sum,
         stages.append(("plus_push_dup", make_with_push(False),
                        (0, 1, 2, 3)))
     for name, fn, donate in stages:
+        # pbox-lint: ignore[jit-retrace-hazard] ablation harness: each
+        # stage jits its own distinct fn ONCE, then times many cached
+        # dispatches of it — the wrap is per stage, not per step
         jf = jax.jit(fn, donate_argnums=donate)
         # snapshot ONLY the donated leaves (copying the whole table for the
         # dense-only stage would transiently double table memory)
@@ -2253,7 +2296,7 @@ def stage_trainer_path(backend, args, tconf, trconf, n_slots, dense, bsz,
             ds.close()
     emit({"metric": f"{args.model}_trainer_path_samples_per_sec",
           "value": round(sps, 1), "unit": "samples/sec", "vs_baseline": None,
-          "backend": backend})
+          "backend": backend, "telemetry": telemetry_summary()})
 
 
 def stage_ops(backend, args) -> None:

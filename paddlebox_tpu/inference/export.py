@@ -193,6 +193,9 @@ def export_serving_programs(
             in_shapes.append(
                 jax.ShapeDtypeStruct((B, seq_len), jnp.int32)
             )
+        # pbox-lint: ignore[jit-retrace-hazard] one-time artifact build:
+        # each shape bucket AOT-exports its own frozen program here;
+        # serving dispatches the deserialized programs, never this jit
         exp = jax.export.export(jax.jit(fn), platforms=("cpu", "tpu"))(
             *in_shapes
         )

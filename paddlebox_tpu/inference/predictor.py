@@ -108,6 +108,9 @@ class Predictor:
         import jax.export  # noqa: F401  -- explicit: not reachable via the
         # bare `jax` import on 0.4.x (AttributeError without it)
 
+        from paddlebox_tpu.telemetry.compiles import install_compile_listener
+
+        install_compile_listener()
         if fname not in self._programs:
             with open(os.path.join(self._dir, fname), "rb") as f:
                 self._programs[fname] = jax.export.deserialize(f.read())
@@ -453,7 +456,13 @@ class Predictor:
             sp = np.full((B, T), K, np.int32)
             sp[:b, :Ts] = np.where(src[:b] < nk, src[:b], K)
             args.append(sp)
-        preds = np.asarray(exported.call(*args))
+        # each exported bucket program compiles exactly once (warmup);
+        # the stage scope attributes that compile — and any unexpected
+        # steady-state retrace — to serve.predict in jit.compiles
+        from paddlebox_tpu.telemetry.compiles import stage_scope
+
+        with stage_scope("serve.predict"):
+            preds = np.asarray(exported.call(*args))
         return preds[:b]
 
     def predict_dataset(self, dataset) -> Iterator[np.ndarray]:

@@ -54,6 +54,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddlebox_tpu.telemetry.compiles import counted_jit
+
 _TILE = 32  # max rows per grid step (pow2; shrinks to divide small inputs)
 
 
@@ -119,7 +121,7 @@ def _gather_kernel(idx_ref, values_ref, out_ref, scratch, sems, *, tile):
     out_ref[:] = scratch[cur]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@counted_jit(stage="pallas.pull_rows", static_argnames=("interpret",))
 def pallas_pull_rows(values: jax.Array, idx: jax.Array,
                      interpret: bool = False) -> jax.Array:
     """values: [P, W] (HBM); idx: int32 [K].  Returns [K, W] — identical to
@@ -205,7 +207,7 @@ def _scatter_kernel(idx_ref, delta_ref, values_ref, out_ref, rows, sems,
         ).wait()
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@counted_jit(stage="pallas.scatter_add", static_argnames=("interpret",))
 def pallas_scatter_add(values: jax.Array, idx: jax.Array, delta: jax.Array,
                        interpret: bool = False) -> jax.Array:
     """In-place ``values[idx] += delta`` (donating values via aliasing).
@@ -264,7 +266,7 @@ def _gather_slots_kernel(idx_ref, table_ref, out_ref, scratch, sems, *, tile):
     out_ref[:] = jnp.where((ids >= 0)[:, None], scratch[:], 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@counted_jit(stage="pallas.gather_slots", static_argnames=("interpret",))
 def pallas_gather_slots(table: jax.Array, slots: jax.Array,
                         interpret: bool = False) -> jax.Array:
     """table: [C, W] (HBM); slots: int32 [K], negative = miss.  Returns
@@ -325,7 +327,7 @@ def _scatter_rows_kernel(idx_ref, rows_ref, table_ref, out_ref, sems, *,
             cp.wait()
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@counted_jit(stage="pallas.scatter_rows", static_argnames=("interpret",))
 def pallas_scatter_rows(table: jax.Array, slots: jax.Array, rows: jax.Array,
                         interpret: bool = False) -> jax.Array:
     """In-place ``table[slots] = rows`` (donating table via aliasing).
@@ -387,7 +389,7 @@ def _sorted_search_kernel(nreal_ref, hay_ref, q_ref, out_ref, *, cbits,
     out_ref[:] = jnp.where(found, pos, -1).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@counted_jit(stage="pallas.sorted_search", static_argnames=("interpret",))
 def pallas_sorted_search(hay: jax.Array, n_real: jax.Array, q: jax.Array,
                          interpret: bool = False) -> jax.Array:
     """hay: uint32 [C, 2] — (hi, lo) halves of uint64 keys, sorted by the

@@ -39,6 +39,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddlebox_tpu.telemetry.compiles import counted_jit
 from paddlebox_tpu.utils.jax_compat import axis_size, pcast
 
 PIPE_AXIS = "pipe"
@@ -251,7 +252,8 @@ class PipelineTrainer:
             in_specs=(spec, spec, rep, rep, rep),
             out_specs=(spec, spec, spec),
         )
-        return jax.jit(mapped, donate_argnums=(0, 1))
+        return counted_jit(
+            mapped, stage="pipeline.step", donate_argnums=(0, 1))
 
     def train_step(self, x_mb: np.ndarray, y_mb: np.ndarray,
                    mask_mb: Optional[np.ndarray] = None) -> float:

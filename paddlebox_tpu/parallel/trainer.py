@@ -62,6 +62,7 @@ from paddlebox_tpu.parallel.multiprocess import (
 from paddlebox_tpu.parallel.sharded_table import ShardedBatchPlan, ShardedSparseTable
 from paddlebox_tpu.sparse.optimizer import sparse_adagrad_update
 from paddlebox_tpu.sparse.table import gather_rows, scatter_add_rows
+from paddlebox_tpu.telemetry.compiles import counted_jit
 from paddlebox_tpu.utils import faults
 from paddlebox_tpu.train.slot_policy import (
     normalize_slot_mask,
@@ -427,7 +428,8 @@ class MultiChipTrainer:
             out_specs=(spec,) * n_out,
             axis_names={DATA_AXIS},
         )
-        return jax.jit(mapped, donate_argnums=(0, 1, 2, 3, 4))
+        return counted_jit(
+            mapped, stage="spmd.step", donate_argnums=(0, 1, 2, 3, 4))
 
     def _build_sync(self):
         """K-step param sync: average drifted replicas (reference: SyncParam
@@ -451,7 +453,7 @@ class MultiChipTrainer:
             body, mesh=self.mesh, in_specs=(spec, spec),
             out_specs=(spec, spec), axis_names={DATA_AXIS},
         )
-        return jax.jit(mapped, donate_argnums=(0, 1))
+        return counted_jit(mapped, stage="spmd.sync", donate_argnums=(0, 1))
 
     # -- dense persistence -------------------------------------------------- #
     def dense_state(self) -> tuple:
@@ -486,8 +488,9 @@ class MultiChipTrainer:
         """Fresh buffers for a donated-state continuation (works on
         non-fully-addressable multi-host arrays, where jnp.array would not)."""
         if self._copy_fn is None:
-            self._copy_fn = jax.jit(
-                lambda t: jax.tree.map(lambda x: x + jnp.zeros((), x.dtype), t)
+            self._copy_fn = counted_jit(
+                lambda t: jax.tree.map(lambda x: x + jnp.zeros((), x.dtype), t),
+                stage="spmd.copy",
             )
         return self._copy_fn(tree)
 
@@ -944,7 +947,7 @@ class MultiChipTrainer:
             body, mesh=self.mesh, in_specs=(spec,) * 4, out_specs=spec,
             axis_names={DATA_AXIS},
         )
-        return jax.jit(mapped, donate_argnums=(2,))
+        return counted_jit(mapped, stage="spmd.eval", donate_argnums=(2,))
 
     def evaluate(self, dataset, table: ShardedSparseTable,
                  drop_last: bool = False) -> dict:

@@ -76,3 +76,11 @@ from paddlebox_tpu.telemetry.flight import (  # noqa: F401
     install_signal_dump,
     set_process_name,
 )
+from paddlebox_tpu.telemetry.compiles import (  # noqa: F401
+    CountedJit,
+    compiles_by_stage,
+    counted_jit,
+    install_compile_listener,
+    stage_scope,
+    total_compiles,
+)

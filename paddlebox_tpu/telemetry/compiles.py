@@ -1,0 +1,197 @@
+"""Compile-event witness: per-stage ``jit.compiles`` telemetry.
+
+The serving fast path (PR 13) and both trainer paths are built on one
+promise: after warmup, a steady-state step is a CACHED dispatch — no
+trace, no XLA compile, no host sync hidden inside the call.  A silent
+recompile per step (a shape-varying argument, a python scalar flipping
+weak types, a fresh ``jax.jit`` wrapper built inside the loop) costs
+tens of milliseconds on CPU and minutes at pod scale, and nothing in the
+metrics surface showed it.  This module is the runtime half of the
+``jit-retrace-hazard`` static pass (tools/pbox_analyze): the static rule
+catches the shapes that retrace, and this witness proves at runtime —
+and pins in tier-1 — that steady-state passes and steady-state serving
+trigger ZERO retraces after warmup.
+
+Mechanism: ``jax.monitoring`` emits one
+``/jax/core/compile/backend_compile_duration`` event per XLA backend
+compile, synchronously on the thread that triggered it.  The installed
+listener attributes each event to the innermost active *stage* (a
+thread-local scope string: ``train.step``, ``spmd.step``,
+``serve.predict`` ...) and feeds two metrics:
+
+  * ``jit.compiles`` (counter, label ``stage``) — backend compiles per
+    stage; steady state means the per-stage count stops moving;
+  * ``jit.compile_seconds`` (histogram, label ``stage``) — where the
+    compile wall time goes (warmup cost is real and worth seeing).
+
+``counted_jit(fn, stage=..., **jit_kwargs)`` is the adoption surface:
+a drop-in ``jax.jit`` replacement whose calls run inside the stage
+scope, so every compile its dispatch triggers lands on the right label.
+It also tracks the wrapper's own trace-cache size, so ``retraces()``
+answers "how many distinct signatures has this step seen" without
+scraping counters.  Code that calls pre-compiled artifacts directly
+(the predictor's ``exported.call``) uses ``stage_scope`` alone.
+
+jax is imported lazily — this module must stay importable (and the
+metric names registerable) on jax-free hosts like the analyzer's bare
+checkout and the serving-side quant tooling.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from paddlebox_tpu.telemetry import metrics
+
+#: the one event that fires exactly when XLA compiles something new and
+#: never on a cache hit — the whole witness keys on it.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+#: stage attributed to compiles outside any scope (import-time warmup,
+#: library internals) — visible, not silently dropped.
+UNTAGGED = "untagged"
+
+_COMPILES = metrics.counter(
+    "jit.compiles",
+    "XLA backend compiles by stage (zero per stage in steady state)",
+)
+_COMPILE_SECONDS = metrics.histogram(
+    "jit.compile_seconds", "XLA backend compile wall time by stage",
+)
+
+_tls = threading.local()
+_install_lock = threading.Lock()
+_installed = False
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_stage() -> str:
+    st = _stack()
+    return st[-1] if st else UNTAGGED
+
+
+class stage_scope:
+    """Attribute backend compiles on this thread to ``stage`` while the
+    scope is active.  Reentrant; innermost scope wins."""
+
+    def __init__(self, stage: str):
+        self.stage = stage
+
+    def __enter__(self):
+        _stack().append(self.stage)
+        return self
+
+    def __exit__(self, *exc):
+        st = _stack()
+        if st:
+            st.pop()
+        return False
+
+
+def _on_event(event: str, duration_secs: float, **kwargs) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    stage = current_stage()
+    _COMPILES.inc(stage=stage)
+    _COMPILE_SECONDS.observe(duration_secs, stage=stage)
+
+
+def install_compile_listener() -> bool:
+    """Register the jax.monitoring listener (idempotent, thread-safe).
+    Returns False when jax or the monitoring API is unavailable — the
+    witness degrades to no-op counters, never an import error."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return True
+        try:
+            from jax import monitoring
+        # pbox-lint: ignore[swallowed-exception] capability probe: a
+        # jax-free or pre-monitoring build runs without the witness
+        except Exception:
+            return False
+        register = getattr(
+            monitoring, "register_event_duration_secs_listener", None)
+        if register is None:
+            return False
+        register(_on_event)
+        _installed = True
+        return True
+
+
+def compiles_by_stage() -> dict:
+    """{stage: backend-compile count} — the bench-row / pin read surface."""
+    out: dict = {}
+    for key, cell in _COMPILES.series().items():
+        stage = dict(key).get("stage", UNTAGGED)
+        out[stage] = out.get(stage, 0) + int(cell[0])
+    return out
+
+
+def total_compiles() -> int:
+    return sum(compiles_by_stage().values())
+
+
+class CountedJit:
+    """``jax.jit`` with a stage label: every dispatch runs inside
+    ``stage_scope(stage)`` so the listener attributes its compiles, and
+    the wrapper tracks its own trace-cache growth (``retraces()``).
+
+    Forwards everything else (``lower``, ``clear_cache``, ``__name__``,
+    ...) to the underlying jitted callable, so existing call sites and
+    the static analyzer's jit-binding detection keep working unchanged.
+    """
+
+    def __init__(self, fn, stage: str, **jit_kwargs):
+        import jax
+
+        install_compile_listener()
+        self._jitted = jax.jit(fn, **jit_kwargs)
+        self.stage = stage
+        self._seen_cache = 0
+
+    def __call__(self, *args, **kwargs):
+        with stage_scope(self.stage):
+            out = self._jitted(*args, **kwargs)
+        self._bump_cache()
+        return out
+
+    def _bump_cache(self) -> None:
+        size_fn = getattr(self._jitted, "_cache_size", None)
+        if size_fn is None:
+            return
+        try:
+            n = int(size_fn())
+        # pbox-lint: ignore[swallowed-exception] capability probe: the
+        # private cache-size API may vanish; the listener still counts
+        except Exception:
+            return
+        if n > self._seen_cache:
+            self._seen_cache = n
+
+    def retraces(self) -> int:
+        """Distinct signatures this wrapper has traced (0 before first
+        call; steady state means this stops growing)."""
+        self._bump_cache()
+        return self._seen_cache
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+
+def counted_jit(fn=None, *, stage: str, **jit_kwargs):
+    """Drop-in ``jax.jit`` replacement with per-stage compile telemetry.
+
+    Usable directly (``counted_jit(f, stage="train.step",
+    donate_argnums=(0,))``) or as a decorator factory
+    (``@counted_jit(stage="pallas.gather", static_argnames=("n",))``).
+    """
+    if fn is None:
+        return lambda f: CountedJit(f, stage=stage, **jit_kwargs)
+    return CountedJit(fn, stage=stage, **jit_kwargs)

@@ -36,6 +36,7 @@ from paddlebox_tpu.metrics.auc import (
 from paddlebox_tpu.metrics.variants import MetricGroup
 from paddlebox_tpu.models.layers import bce_with_logits
 from paddlebox_tpu.sparse.table import SparseTable, pull_rows, push_and_update
+from paddlebox_tpu.telemetry.compiles import counted_jit
 from paddlebox_tpu.utils import faults
 from paddlebox_tpu.utils.monitor import stats
 
@@ -466,8 +467,10 @@ class Trainer:
                 )
                 return (*state, loss, finite, primary)
 
-            return jax.jit(guarded, donate_argnums=(0, 1, 2, 3, 4))
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
+            return counted_jit(
+                guarded, stage="train.step", donate_argnums=(0, 1, 2, 3, 4))
+        return counted_jit(
+            step, stage="train.step", donate_argnums=(0, 1, 2, 3, 4))
 
     def _build_scan_step(self):
         """k steps in ONE dispatch: lax.scan over stacked feeds.  Amortizes
@@ -537,7 +540,8 @@ class Trainer:
                 params, opt_state, values, g2sum, mstate, losses, finites,
             )
 
-        return jax.jit(scan_fn, donate_argnums=(0, 1, 2, 3, 4))
+        return counted_jit(
+            scan_fn, stage="train.scan", donate_argnums=(0, 1, 2, 3, 4))
 
     def _init_mstate(self, auc_state=None) -> dict:
         """Fresh metric state, or continuation: pass the previous pass's
@@ -819,6 +823,10 @@ class Trainer:
                     if wd is not None:
                         wd.report("step")
                     k = int(loss_k.shape[0])
+                    # pbox-lint: ignore[host-sync-in-hot-loop] nan gate
+                    # (FLAGS_check_nan_inf analog): the finite flags must
+                    # be read per dispatch to stop/skip; the scan path
+                    # amortizes this one sync over k steps
                     fin = np.asarray(finites)
                     if check_nan and not fin.all():
                         if skip_batches:
@@ -852,6 +860,10 @@ class Trainer:
                 if wd is not None:
                     wd.report("step")
                 prof.step_done()
+                # pbox-lint: ignore[host-sync-in-hot-loop] nan gate: with
+                # check_nan on, the per-step finite readback IS the
+                # feature (opt-in; default-off config pays nothing —
+                # `check_nan and` short-circuits before bool(finite))
                 if check_nan and not bool(finite):
                     if skip_batches:
                         # the guarded step already returned the pre-batch
@@ -986,7 +998,7 @@ class Trainer:
             auc = update_auc_state(auc, preds, batch["labels"], batch["ins_mask"])
             return auc
 
-        return jax.jit(step, donate_argnums=(2,))
+        return counted_jit(step, stage="train.eval", donate_argnums=(2,))
 
     def evaluate(self, dataset, table: SparseTable, drop_last: bool = False) -> dict:
         """Forward-only pass: no table/param updates, streaming AUC only —

@@ -1697,3 +1697,486 @@ def test_wrapper_cli_contract_survives_context_fields():
     assert callable(check_env_flags.main)
     assert callable(check_fault_sites.main)
     assert callable(check_publish_dir.main)
+
+
+# --------------------------------------------------------------------------- #
+# numerics & recompilation safety (rules_numerics.py + num_catalog.py)
+# --------------------------------------------------------------------------- #
+from pbox_analyze import rules_numerics  # noqa: E402
+
+
+# -- num-dtype-flow ---------------------------------------------------------- #
+BAD_DEQUANT = """\
+    import numpy as np
+    from paddlebox_tpu.inference.quant import quantize_rows
+
+    def publish(values):
+        head, codes, scales = quantize_rows(values, 2, "int8")
+        rows = codes.astype(np.float32) * scales[:, None]
+        return rows
+"""
+
+
+def test_dtype_flow_bad_dequant_outside_fused_gather(tmp_path):
+    (finding,) = _run(rules_numerics, tmp_path, BAD_DEQUANT)
+    assert finding.rule == "num-dtype-flow"
+    assert finding.line == 6
+    assert "fused gather" in finding.message
+
+
+def test_dtype_flow_good_codes_stay_quantized(tmp_path):
+    src = """\
+        import numpy as np
+        from paddlebox_tpu.inference.quant import quantize_rows
+
+        def publish(values):
+            head, codes, scales = quantize_rows(values, 2, "int8")
+            np.save("head.npy", head)
+            np.save("codes.npy", codes)
+            np.save("scales.npy", scales)
+    """
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+def test_dtype_flow_bad_merge_mixing(tmp_path):
+    src = """\
+        import numpy as np
+
+        def merge(values, embedx_q):
+            head = values.astype(np.float32)
+            return np.concatenate([head, embedx_q], axis=1)
+    """
+    (finding,) = _run(rules_numerics, tmp_path, src)
+    assert finding.rule == "num-dtype-flow"
+    assert "EmbeddingDtypeMismatch" in finding.message
+
+
+def test_dtype_flow_good_merge_same_dtype(tmp_path):
+    src = """\
+        import numpy as np
+
+        def merge(a, b):
+            x = a.astype(np.float32)
+            y = b.astype(np.float32)
+            return np.concatenate([x, y], axis=1)
+    """
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+def test_dtype_flow_suppressed(tmp_path):
+    src = BAD_DEQUANT.replace(
+        "        rows = codes.astype(np.float32) * scales[:, None]",
+        "        # pbox-lint: ignore[num-dtype-flow] fixture reason\n"
+        "        rows = codes.astype(np.float32) * scales[:, None]",
+    )
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+# -- num-key-width ----------------------------------------------------------- #
+BAD_KEY_CAST = """\
+    import numpy as np
+
+    def bucketize(keys):
+        return keys.astype(np.float32) / 7.0
+"""
+
+
+def test_key_width_bad_float_cast(tmp_path):
+    findings = _run(rules_numerics, tmp_path, BAD_KEY_CAST)
+    assert findings and all(f.rule == "num-key-width" for f in findings)
+    assert findings[0].line == 4
+    assert "2^53" in findings[0].message
+
+
+@pytest.mark.parametrize("expr,needle", [
+    ("np.int64(batch.keys)", "sign"),
+    ("keys * 0.5", "float arithmetic"),
+    ("jnp.asarray(keys)", "uint32"),
+    ("float(keys[0])", "2^53"),
+])
+def test_key_width_bad_sink_family(tmp_path, expr, needle):
+    src = f"""\
+        import numpy as np
+        import jax.numpy as jnp
+
+        def f(keys, batch):
+            return {expr}
+    """
+    findings = _run(rules_numerics, tmp_path, src)
+    assert findings, expr
+    assert findings[0].rule == "num-key-width"
+    assert needle in findings[0].message
+
+
+def test_key_width_good_split_convention(tmp_path):
+    """The split itself — shift/mask with np.uint64 then narrow — is the
+    sanctioned uint64->uint32 path (ops/pallas_sparse.py split_u64)."""
+    src = """\
+        import numpy as np
+
+        def split_u64(keys):
+            keys = np.asarray(keys, dtype=np.uint64)
+            out = np.empty((keys.shape[0], 2), np.uint32)
+            out[:, 0] = (keys >> np.uint64(32)).astype(np.uint32)
+            out[:, 1] = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            return out
+    """
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+def test_key_width_good_comparisons_and_searchsorted(tmp_path):
+    src = """\
+        import numpy as np
+
+        def resolve(keys, batch_keys):
+            pos = np.searchsorted(keys, batch_keys)
+            found = keys[np.minimum(pos, keys.shape[0] - 1)] == batch_keys
+            return pos, found
+    """
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+def test_key_width_bad_32bit_recombine(tmp_path):
+    src = """\
+        from paddlebox_tpu.ops.pallas_sparse import split_u64
+
+        def roundtrip(keys):
+            pairs = split_u64(keys)
+            hi = pairs[:, 0]
+            lo = pairs[:, 1]
+            return (hi << 32) | lo
+    """
+    (finding,) = _run(rules_numerics, tmp_path, src)
+    assert finding.rule == "num-key-width"
+    assert "np.uint64(hi)" in finding.message
+
+
+def test_key_width_suppressed(tmp_path):
+    src = BAD_KEY_CAST.replace(
+        "    return keys.astype(np.float32) / 7.0",
+        "    # pbox-lint: ignore[num-key-width] fixture reason\n"
+        "    return keys.astype(np.float32) / 7.0",
+    )
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+# -- jit-retrace-hazard ------------------------------------------------------ #
+BAD_FRESH_WRAPPER = """\
+    import jax
+
+    def merge(tree):
+        return jax.jit(lambda t: t)(tree)
+"""
+
+
+def test_retrace_bad_fresh_wrapper_per_call(tmp_path):
+    """The merge_device_axis bug this PR fixed: jit built and invoked in
+    one expression retraces on every call."""
+    (finding,) = _run(rules_numerics, tmp_path, BAD_FRESH_WRAPPER)
+    assert finding.rule == "jit-retrace-hazard"
+    assert finding.line == 4
+
+
+def test_retrace_bad_wrap_in_loop(tmp_path):
+    src = """\
+        import jax
+
+        def f(fns, x):
+            for fn in fns:
+                g = jax.jit(fn)
+                x = g(x)
+            return x
+    """
+    (finding,) = _run(rules_numerics, tmp_path, src)
+    assert finding.rule == "jit-retrace-hazard"
+    assert "loop" in finding.message
+
+
+def test_retrace_bad_shape_varying_arg(tmp_path):
+    src = """\
+        import jax
+        import numpy as np
+
+        step = jax.jit(lambda x: x)
+
+        def f(batch):
+            return step(np.unique(batch))
+    """
+    (finding,) = _run(rules_numerics, tmp_path, src)
+    assert finding.rule == "jit-retrace-hazard"
+    assert "padded-bucket" in finding.message
+
+
+def test_retrace_bad_python_scalar_arg(tmp_path):
+    src = """\
+        import jax
+
+        step = jax.jit(lambda x, n: x)
+
+        def f(x, ys):
+            return step(x, len(ys))
+    """
+    (finding,) = _run(rules_numerics, tmp_path, src)
+    assert finding.rule == "jit-retrace-hazard"
+    assert "scalar" in finding.message
+
+
+def test_retrace_bad_closure_captured_device_array(tmp_path):
+    src = """\
+        import jax
+        import jax.numpy as jnp
+
+        def build(w):
+            scale = jnp.asarray(w)
+
+            def body(x):
+                return x * scale
+
+            return jax.jit(body)
+    """
+    (finding,) = _run(rules_numerics, tmp_path, src)
+    assert finding.rule == "jit-retrace-hazard"
+    assert "scale" in finding.message and "constant" in finding.message
+
+
+def test_retrace_good_cached_factory_and_padded_args(tmp_path):
+    """The repo's own discipline: build the wrapper once through a
+    factory, pad feeds to a fixed buffer before dispatch."""
+    src = """\
+        import jax
+        import numpy as np
+
+        class T:
+            def _build(self):
+                return jax.jit(lambda x: x)
+
+            def go(self, feeds):
+                self._fn = self._build()
+                buf = np.zeros(1024)
+                for f in feeds:
+                    buf[: f.size] = f
+                    self._fn(buf)
+    """
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+def test_retrace_suppressed(tmp_path):
+    src = BAD_FRESH_WRAPPER.replace(
+        "    return jax.jit(lambda t: t)(tree)",
+        "    # pbox-lint: ignore[jit-retrace-hazard] fixture reason\n"
+        "    return jax.jit(lambda t: t)(tree)",
+    )
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+# -- host-sync-in-hot-loop --------------------------------------------------- #
+BAD_HOT_SYNC = """\
+    import jax
+
+    step = jax.jit(lambda x: x)
+
+    def train(feeds):
+        for dev in feeds:
+            loss = step(dev)
+            x = jax.device_get(loss)
+        return x
+"""
+
+
+def test_host_sync_bad_device_get_in_hot_loop(tmp_path):
+    (finding,) = _run(rules_numerics, tmp_path, BAD_HOT_SYNC)
+    assert finding.rule == "host-sync-in-hot-loop"
+    assert finding.line == 8
+
+
+def test_host_sync_bad_float_in_batches_loop(tmp_path):
+    src = """\
+        import jax
+
+        step = jax.jit(lambda x: x)
+
+        def train(ds):
+            out = []
+            for batch in ds.batches():
+                loss = step(batch)
+                out.append(float(loss))
+            return out
+    """
+    (finding,) = _run(rules_numerics, tmp_path, src)
+    assert finding.rule == "host-sync-in-hot-loop"
+
+
+def test_host_sync_bad_through_callee_summary(tmp_path):
+    """The 133-candidate-site reality: the sync hides one call down.
+    The callee summary carries it back to the hot-loop call site."""
+    src = """\
+        import jax
+        import numpy as np
+
+        step = jax.jit(lambda x: x)
+
+        def readback(v):
+            return np.asarray(v)
+
+        def train(ds):
+            for batch in ds.batches():
+                loss = step(batch)
+                r = readback(loss)
+            return r
+    """
+    (finding,) = _run(rules_numerics, tmp_path, src)
+    assert finding.rule == "host-sync-in-hot-loop"
+    assert "readback" in finding.message
+
+
+def test_host_sync_good_pass_boundary_and_prof_guard(tmp_path):
+    """The two designed idioms: D2H after the loop (pass boundary), and
+    a profiling-gated readback inside it — neither needs an annotation."""
+    src = """\
+        import jax
+        import numpy as np
+
+        step = jax.jit(lambda x: x)
+
+        def train(ds, prof):
+            for batch in ds.batches():
+                loss = step(batch)
+                if prof.enabled:
+                    loss.block_until_ready()
+            return float(loss)
+    """
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+def test_host_sync_good_shape_read_is_not_a_sync(tmp_path):
+    src = """\
+        import jax
+
+        step = jax.jit(lambda x: x)
+
+        def train(feeds):
+            n = 0
+            for dev in feeds:
+                loss = step(dev)
+                n += int(loss.shape[0])
+            return n
+    """
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+def test_host_sync_suppressed(tmp_path):
+    src = BAD_HOT_SYNC.replace(
+        "        x = jax.device_get(loss)",
+        "        # pbox-lint: ignore[host-sync-in-hot-loop] fixture reason\n"
+        "        x = jax.device_get(loss)",
+    )
+    assert _run(rules_numerics, tmp_path, src) == []
+
+
+# -- CLI / tooling ----------------------------------------------------------- #
+def test_cli_names_num_key_width_on_seeded_regression(tmp_path):
+    """Acceptance scenario: a seeded uint64->float regression exits
+    non-zero and the output names rule, file and line via the CLI."""
+    bad = tmp_path / "regress.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def shard_of(keys, n):\n"
+        "    return keys.astype(np.float64) % n\n"
+    )
+    r = subprocess.run(
+        [sys.executable, CLI, str(bad)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "num-key-width" in r.stdout
+    assert "regress.py:3" in r.stdout
+
+
+def test_cli_rules_glob_selects_num_and_jit_families(tmp_path):
+    bad = tmp_path / "regress.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "import jax\n"
+        "def f(keys, tree):\n"
+        "    jax.jit(lambda t: t)(tree)\n"
+        "    return keys * 0.5\n"
+    )
+    r = subprocess.run(
+        [sys.executable, CLI, str(bad), "--rules", "num-*"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "num-key-width" in r.stdout
+    assert "jit-retrace-hazard" not in r.stdout
+    r = subprocess.run(
+        [sys.executable, CLI, str(bad), "--rules", "jit-*"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "jit-retrace-hazard" in r.stdout
+    assert "num-key-width" not in r.stdout
+
+
+def test_changed_mode_picks_up_numerics_rules(tmp_path, monkeypatch, capsys):
+    """--changed REF reports a new-rule finding when its line is in the
+    diff, and filters it out when only other lines were touched."""
+    from pbox_analyze import cli as cli_mod
+
+    bad = tmp_path / "touched.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def f(keys):\n"
+        "    return keys.astype(np.float32)\n"
+    )
+    rel = os.path.relpath(str(bad), cli_mod.REPO)
+
+    monkeypatch.setattr(cli_mod, "_changed_lines", lambda ref: {rel: {3}})
+    rc = cli_mod.main(["--changed", "HEAD", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "num-key-width" in out
+
+    monkeypatch.setattr(cli_mod, "_changed_lines", lambda ref: {rel: {1}})
+    rc = cli_mod.main(["--changed", "HEAD", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "num-key-width" not in out
+
+
+def test_numerics_rules_listed():
+    r = subprocess.run(
+        [sys.executable, CLI, "--list-rules"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0
+    for rule in ("num-dtype-flow", "num-key-width", "jit-retrace-hazard",
+                 "host-sync-in-hot-loop"):
+        assert rule in r.stdout
+
+
+def test_numerics_repo_is_clean(tmp_path):
+    """The acceptance bar: the four numerics rules over the default roots
+    produce zero findings (intentional sites carry inline reasons; the
+    baseline stays empty)."""
+    r = subprocess.run(
+        [sys.executable, CLI, "--all", "--json",
+         "--rules", "num-*,jit-*,host-sync-in-hot-loop"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout
+    assert json.loads(r.stdout) == []
+
+
+def test_numerics_memos_live_in_context_caches(tmp_path):
+    """Per-function dtype envs and sync summaries are memoized under
+    Context.caches so repeated pass runs (and the wall-time budget) don't
+    re-derive them."""
+    ctx = _ctx(tmp_path, BAD_KEY_CAST)
+    rules_numerics.run(ctx)
+    cache = ctx.caches.get("numerics")
+    assert cache is not None
+    assert cache["dtype_env"], "dtype envs must be memoized per function"
+    # second run hits the memo table (same object, no rebuild)
+    envs = cache["dtype_env"]
+    rules_numerics.run(ctx)
+    assert ctx.caches["numerics"]["dtype_env"] is envs

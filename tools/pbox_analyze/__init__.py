@@ -31,6 +31,8 @@ Layout:
   rules_spmd.py    spmd-rank-divergence, spmd-collective-sequence,
                    spmd-collective-on-thread, spmd-mesh-axis (catalog in
                    spmd_catalog.py)
+  rules_numerics.py num-dtype-flow, num-key-width, jit-retrace-hazard,
+                   host-sync-in-hot-loop (catalog in num_catalog.py)
   publish.py       publish-dir (per-root, opt-in via --publish-root)
   cli.py           ``python tools/pbox_analyze.py --all --json ...``
 
@@ -48,6 +50,7 @@ from . import (  # noqa: F401
     rules_drift,
     rules_except,
     rules_locks,
+    rules_numerics,
     rules_protocol,
     rules_resources,
     rules_spmd,
@@ -64,6 +67,7 @@ PASS_MODULES = [
     rules_protocol,
     rules_resources,
     rules_spmd,
+    rules_numerics,
     rules_except,
     rules_clock,
     rules_tracer,
