@@ -1220,6 +1220,254 @@ def _rank(q: float, n: int) -> int:
     return max(0, min(n - 1, math.ceil(q * n) - 1))
 
 
+def _hostplane_census_arm(n_ranks, n_passes, censuses, placement, codec,
+                          hot_capacity, cache_rows) -> dict:
+    """One census-wire ablation arm over a simulated n-rank fleet
+    (threads + InProcessCensusGroup — real multi-process JAX collectives
+    can't run on the CPU backend; the wire logic is rank-identical).
+    Returns bytes/pass, gather latencies and the agreed census sizes."""
+    import threading
+
+    from paddlebox_tpu.parallel.census import (
+        CensusExchange, FleetCacheMirror, InProcessCensusGroup,
+    )
+    from paddlebox_tpu.sparse.placement import PlacementPlanner
+
+    group = InProcessCensusGroup(n_ranks)
+    out = {r: None for r in range(n_ranks)}
+    gather_s: list = []
+
+    def rank_fn(r):
+        planner = mirror = None
+        if placement == "hybrid":
+            planner = PlacementPlanner(
+                hot_capacity=hot_capacity, update_interval=1
+            )
+            if cache_rows:
+                mirror = FleetCacheMirror(n_ranks, cache_rows, 0.8)
+        ex = CensusExchange(group.transport(r), planner=planner,
+                            mirror=mirror, codec=codec)
+        pks, wire, raw = [], [], []
+        for p in range(n_passes):
+            t0 = time.perf_counter()
+            pk = ex.exchange(censuses[p][r])
+            if r == 0:
+                gather_s.append(time.perf_counter() - t0)
+            pks.append(pk)
+            wire.append(ex.last_wire_bytes)
+            raw.append(ex.last_raw_bytes)
+        out[r] = (pks, wire, raw)
+
+    threads = [
+        threading.Thread(target=rank_fn, args=(r,), daemon=True)
+        for r in range(n_ranks)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # fleet agreement is the correctness floor of the whole arm
+    for p in range(n_passes):
+        for r in range(1, n_ranks):
+            assert np.array_equal(out[0][0][p], out[r][0][p]), (
+                f"census divergence at pass {p} rank {r}"
+            )
+    # steady state: skip pass 0 (dictionary is empty, everything is cold)
+    tail = range(1, n_passes)
+    bytes_pp = [sum(out[r][1][p] for r in range(n_ranks)) for p in tail]
+    raw_pp = [sum(out[r][2][p] for r in range(n_ranks)) for p in tail]
+    lat = sorted(gather_s[1:])
+    return {
+        "bytes_per_pass": round(sum(bytes_pp) / max(len(bytes_pp), 1), 1),
+        "raw_bytes_per_pass": round(sum(raw_pp) / max(len(raw_pp), 1), 1),
+        "gather_p50_ms": round(lat[_rank(0.5, len(lat))] * 1e3, 3),
+        "gather_p99_ms": round(lat[_rank(0.99, len(lat))] * 1e3, 3),
+        "census_rows": int(out[0][0][-1].shape[0]),
+    }
+
+
+def bench_hostplane(n_passes: int, tconf0, trconf, n_slots: int, dense: int,
+                    bsz: int, ins_per_pass: int, hidden,
+                    vocab_per_slot: int = 4000, zipf_a: float = 1.3,
+                    n_ranks: int = 2) -> dict:
+    """Host-plane hybrid-parallelism ablation (ISSUE 15 acceptance).
+
+    Three measurements off the same Zipf-skewed key universe (real CTR
+    traffic's hot head):
+
+      1. census wire bytes/pass over a simulated ``n_ranks`` fleet, in
+         three arms — ``hash_raw`` (the legacy O(working set) baseline),
+         ``hash_varint`` (codec only) and ``planned_varint`` (placement
+         planner + fleet cache mirrors: dictionary keys ride as BITS, only
+         the cold tail ships as varint deltas) — plus gather p50/p99;
+      2. shuffle wire: one routed RecordBlock serialized legacy vs varint
+         (the key-column compression TcpShuffler ships);
+      3. the bit-exact check: the SAME dataset trained through the
+         MultiChipTrainer with placement off (``hash``) vs the full wire
+         path on (``loopback`` — census encode->decode in begin_pass),
+         final stores compared key-for-key, float-for-float.
+
+    CPU-admissible by construction (ROADMAP bench caveat): no device
+    collective runs; the wire plane is the thing being measured.
+    """
+    import dataclasses
+
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.parallel import (
+        MultiChipTrainer, ShardedSparseTable, make_mesh,
+    )
+
+    res: dict = {}
+    rng = np.random.default_rng(17)
+    # per-pass, per-rank local censuses: a shared Zipf-hot head every rank
+    # sees every pass + a cold uniform tail per rank per pass
+    censuses = []
+    for p in range(max(n_passes, 4)):
+        per_rank = []
+        for r in range(n_ranks):
+            draws = rng.zipf(zipf_a, ins_per_pass * 4).astype(np.uint64)
+            hot = draws % np.uint64(vocab_per_slot)
+            cold = rng.integers(
+                vocab_per_slot, vocab_per_slot * 8,
+                ins_per_pass // 4, dtype=np.uint64,
+            )
+            per_rank.append(np.unique(np.concatenate([hot, cold])))
+        censuses.append(per_rank)
+    n_census_passes = len(censuses)
+    cache_rows = max(tconf0.hbm_cache_rows // (n_ranks * 8), 1024)
+    for arm, placement, codec in (
+        ("hash_raw", "hash", "raw"),
+        ("hash_varint", "hash", "varint"),
+        ("planned_varint", "hybrid", "varint"),
+    ):
+        a = _hostplane_census_arm(
+            n_ranks, n_census_passes, censuses, placement, codec,
+            hot_capacity=tconf0.placement_hot_capacity,
+            cache_rows=cache_rows,
+        )
+        for k, v in a.items():
+            res[f"{arm}_{k}"] = v
+        log(f"hostplane census {arm}: {a['bytes_per_pass']:.0f} B/pass "
+            f"(raw equivalent {a['raw_bytes_per_pass']:.0f}), gather p50 "
+            f"{a['gather_p50_ms']:.2f} ms p99 {a['gather_p99_ms']:.2f} ms")
+    res["census_compression_x"] = round(
+        res["hash_raw_bytes_per_pass"]
+        / max(res["hash_varint_bytes_per_pass"], 1), 2)
+    res["census_collapse_x"] = round(
+        res["hash_raw_bytes_per_pass"]
+        / max(res["planned_varint_bytes_per_pass"], 1), 2)
+
+    # shuffle-wire key-column compression on one routed block
+    from paddlebox_tpu.data import archive
+    from paddlebox_tpu.data.record import RecordBlock
+
+    n_keys = ins_per_pass * 4
+    keys = (rng.zipf(zipf_a, n_keys) % vocab_per_slot).astype(np.uint64)
+    blk = RecordBlock(
+        n_ins=ins_per_pass, n_sparse_slots=n_slots, keys=keys,
+        key_offsets=np.linspace(0, n_keys, ins_per_pass * n_slots + 1
+                                ).astype(np.int64),
+        dense=np.zeros((ins_per_pass, dense), np.float32),
+        labels=np.zeros(ins_per_pass, np.float32),
+    )
+    _, raw_kb, _ = archive.block_to_wire(blk, "legacy")
+    _, _, wire_kb = archive.block_to_wire(blk, "varint")
+    res["shuffle_key_bytes_raw"] = raw_kb
+    res["shuffle_key_bytes_encoded"] = wire_kb
+    res["shuffle_key_compression_x"] = round(raw_kb / max(wire_kb, 1), 2)
+
+    # bit-exact: hash vs the full loopback wire path through real training
+    import jax
+
+    conf = make_synth_config(
+        n_sparse_slots=n_slots, dense_dim=dense, batch_size=bsz,
+        max_feasigns_per_ins=64, batch_key_capacity=bsz * n_slots * 4,
+    )
+    n_dev = min(4, len(jax.devices()))
+    mesh = make_mesh(n_dev)
+    states = {}
+    with tempfile.TemporaryDirectory() as td:
+        datasets = []
+        for p in range(n_passes):
+            files = write_synth_files(
+                os.path.join(td, f"p{p}"), n_files=2,
+                ins_per_file=max(ins_per_pass // 2, bsz * n_dev),
+                n_sparse_slots=n_slots, vocab_per_slot=vocab_per_slot,
+                dense_dim=dense, seed=91 + p, zipf_a=zipf_a,
+            )
+            ds = PadBoxSlotDataset(conf, read_threads=2)
+            ds.set_filelist(files)
+            ds.load_into_memory()
+            datasets.append(ds)
+        try:
+            t_train: dict = {}
+            for mode in ("hash", "loopback"):
+                tconf = dataclasses.replace(
+                    tconf0, placement=mode,
+                    placement_update_interval=1,
+                )
+                model = CtrDnn(n_slots, tconf.row_width, dense_dim=dense,
+                               hidden=hidden)
+                table = ShardedSparseTable(tconf, mesh, seed=0)
+                trainer = MultiChipTrainer(model, tconf, mesh, trconf)
+                auc_state = None
+                total = prev = 0
+                t0 = time.perf_counter()
+                for ds in datasets:
+                    table.begin_pass(ds.unique_keys())
+                    m = trainer.train_from_dataset(
+                        ds, table, auc_state=auc_state, drop_last=True,
+                    )
+                    auc_state = trainer.last_metric_state
+                    table.end_pass()
+                    total += int(m["count"]) - prev
+                    prev = int(m["count"])
+                table.flush()
+                t_train[mode] = time.perf_counter() - t0
+                states[mode] = table.state_dict()
+                states[mode]["auc"] = float(m["auc"])
+                if mode == "loopback":
+                    plan = table.placement_plan()
+                    res["hot_keys"] = 0 if plan is None else plan.n_hot
+                    res["plan_version"] = (
+                        0 if plan is None else plan.version
+                    )
+                table.close()
+            res["samples_per_sec"] = round(total / t_train["loopback"], 1)
+        finally:
+            for ds in datasets:
+                ds.close()
+    res["bitexact"] = bool(
+        np.array_equal(states["hash"]["keys"], states["loopback"]["keys"])
+        and np.array_equal(states["hash"]["values"],
+                           states["loopback"]["values"])
+        and states["hash"]["auc"] == states["loopback"]["auc"]
+    )
+    log(f"hostplane: bytes/pass {res['hash_raw_bytes_per_pass']:.0f} -> "
+        f"{res['planned_varint_bytes_per_pass']:.0f} "
+        f"({res['census_collapse_x']}x collapse, codec alone "
+        f"{res['census_compression_x']}x), shuffle keys "
+        f"{res['shuffle_key_compression_x']}x, "
+        f"bitexact={res['bitexact']}")
+    return res
+
+
+def stage_hostplane(backend, args, tconf, trconf, n_slots, dense, bsz,
+                    n_ins, hidden) -> None:
+    res = bench_hostplane(
+        3, tconf, trconf, n_slots, dense, min(bsz, 256),
+        max(n_ins // 16, 1024), hidden,
+        vocab_per_slot=max(args.vocab // 25, 200),
+    )
+    emit({"metric": "hostplane_census_bytes_per_pass",
+          "value": res.get("planned_varint_bytes_per_pass"),
+          "unit": "bytes/pass (2-rank census wire)",
+          "vs_baseline": res.get("hash_raw_bytes_per_pass"),
+          "backend": backend, **res})
+
+
 def bench_serving(n_slots: int = 8, dense: int = 13, n_requests: int = 100):
     """Serving-path latency/throughput (VERDICT r4 next #7): train a small
     CTR-DNN, export a shape-bucket ladder, then score canonical slot-text
@@ -2420,6 +2668,7 @@ def run_all(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
           with_naive=True)
     stage("pass_boundary", stage_pass_boundary, *common)
     stage("hbm_cache", stage_hbm_cache, *common)
+    stage("hostplane", stage_hostplane, *common)
     stage("device_profile", stage_device_profile, *common, scan_k=8)
     stage("pallas", stage_pallas, backend)
     stage("ops", stage_ops, backend, args)
@@ -2479,6 +2728,13 @@ def main() -> None:
                          "skewed (Zipf) key stream: begin-pass promotion "
                          "patch rows, hit rate, inter-pass gap and "
                          "bit-exactness of the two stores")
+    ap.add_argument("--hostplane", action="store_true",
+                    help="host-plane hybrid-parallelism ablation: census "
+                         "wire bytes/pass over a simulated 2-rank fleet "
+                         "(hash vs planned placement, raw vs varint "
+                         "codec), shuffle key-column compression, gather "
+                         "p50/p99, and the bit-exact planned-vs-hash "
+                         "trained-store check")
     ap.add_argument("--pallas", action="store_true",
                     help="Pallas vs XLA gather/scatter at table shapes")
     ap.add_argument("--ops", action="store_true",
@@ -2581,6 +2837,9 @@ def main() -> None:
         fail_metric, fail_unit = "pass_boundary_gap_ms", "ms"
     elif args.hbm_cache:
         fail_metric, fail_unit = "hbm_cache_promotion_patch_rows", "rows"
+    elif args.hostplane:
+        fail_metric = "hostplane_census_bytes_per_pass"
+        fail_unit = "bytes/pass (2-rank census wire)"
     elif args.trainer_path:
         fail_metric = f"{args.model}_trainer_path_samples_per_sec"
         fail_unit = "samples/sec"
@@ -2652,6 +2911,10 @@ def main() -> None:
 
     if args.hbm_cache:
         stage_hbm_cache(*common)
+        return
+
+    if args.hostplane:
+        stage_hostplane(*common)
         return
 
     if args.trainer_path:

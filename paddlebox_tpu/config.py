@@ -72,6 +72,25 @@ class _Flags:
         # overrides TrainerConfig.host_plane_timeout_s when a LivenessConfig
         # is active
         "hostplane_timeout_s": 3600.0,
+        # host-plane wire codec (parallel/host_plane.py + data/shuffle.py):
+        # "varint" = framed zigzag-delta/sorted-delta LEB128 compression of
+        # key and plan payloads (the default — want matrices and censuses
+        # shrink 4x+); "raw" = framed, uncompressed; "legacy" = the
+        # pre-codec bare-bytes wire for mixed-version fleets during a
+        # rolling upgrade.  Must match on every rank: a framing mismatch
+        # fails loudly (HostPlaneCodecError / CensusProtocolError), never
+        # silently mis-decodes.
+        "hostplane_codec": "varint",
+        # sparsity-aware placement (sparse/placement.py +
+        # parallel/census.py): "hybrid" = the planner classifies
+        # replicated-hot vs hash-sharded cold keys from observed census
+        # skew and the multi-host census exchange rides the shared
+        # dictionary (hot keys cost one BIT on the wire); "hash" = the
+        # flat key%n placement and full-key census wire (the ablation
+        # baseline / kill switch); "loopback" = hybrid plus the
+        # encode->decode wire path exercised even single-process (tests,
+        # bench).
+        "placement": "hybrid",
         # shuffle-transport wait bound (TcpShuffler default timeout)
         "shuffle_timeout_s": 120.0,
         # telemetry defaults (telemetry/): a non-zero metrics port starts
@@ -498,6 +517,22 @@ class SparseTableConfig:
     # resident row untouched for k passes keeps freq * aging^k and becomes
     # evictable once that falls below a fresh candidate's 1.0
     hbm_cache_aging: float = 0.8
+
+    # -- sparsity-aware placement (sparse/placement.py) ------------------- #
+    # Per-variable placement chosen from observed access skew (Parallax /
+    # Parameter Box): the planner classifies the top keys by aged census
+    # frequency as replicated-hot, the tail stays hash-sharded.  The plan
+    # drives the multi-host census wire (hot keys ride as membership bits
+    # — parallel/census.py); the device row placement stays hash-sharded,
+    # which is what keeps planned runs bit-exact vs hash-only ones.
+    # "" resolves PBOX_PLACEMENT ("hybrid" default); "hash" disables.
+    placement: str = ""
+    # max replicated-hot keys the planner may classify (top-k bound)
+    placement_hot_capacity: int = 4096
+    # per-pass aged-frequency decay of the planner's tracker
+    placement_aging: float = 0.8
+    # hysteresis: the hot set mutates at most once per this many passes
+    placement_update_interval: int = 2
 
     @property
     def row_width(self) -> int:

@@ -33,7 +33,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from paddlebox_tpu.data.archive import block_from_bytes, block_to_bytes
+from paddlebox_tpu.data.archive import block_from_wire, block_to_wire
 from paddlebox_tpu.data.record import RecordBlock
 from paddlebox_tpu.utils import faults
 from paddlebox_tpu.utils.retry import retry_call
@@ -219,7 +219,24 @@ class TcpShuffler:
         mode: str = "search_id",
         seed: int = 0,
         timeout: Optional[float] = None,
+        codec: Optional[str] = None,
     ):
+        # wire codec (PBOX_HOSTPLANE_CODEC, same knob as the KV plane):
+        # "varint" compresses each routed block's key column (sorted-delta
+        # + order permutation, data/archive.py block_to_wire), "raw"
+        # frames uncompressed, "legacy" ships the pre-codec bare npz.
+        # Receivers decode any framing this build speaks, so a rolling
+        # upgrade only needs legacy until every OLD reader is gone;
+        # unknown framings fail loudly (WireCodecError).
+        if codec is None:
+            from paddlebox_tpu.config import flags as _flags
+
+            codec = _flags.hostplane_codec
+        if codec not in ("varint", "raw", "legacy"):
+            raise ValueError(
+                f"codec must be varint|raw|legacy, got {codec!r}"
+            )
+        self.codec = codec
         if timeout is None:
             # explicit arg > active watchdog's LivenessConfig > flag
             wd_mod = _watchdog_mod()
@@ -282,7 +299,25 @@ class TcpShuffler:
             head = _recv_exact(conn, _FRAME.size)
             sender, rnd, n = _FRAME.unpack(head)
             payload = _recv_exact(conn, n)
-            block = block_from_bytes(payload)
+            try:
+                block = block_from_wire(payload)
+            except Exception:
+                # a codec-mismatched or corrupt payload must be LOUD (the
+                # round then times out naming the sender): log + count
+                # rather than dying silently on the handler thread
+                from paddlebox_tpu import telemetry
+                import logging
+
+                telemetry.counter(
+                    "shuffle.wire_errors",
+                    "shuffle payloads that failed wire decode "
+                    "(codec mismatch or corruption)",
+                ).inc()
+                logging.getLogger(__name__).error(
+                    "shuffle wire decode failed for worker %s round %s",
+                    sender, rnd, exc_info=True,
+                )
+                return
             with self._recv_cv:
                 self._received[(sender, rnd)] = block
                 self._recv_cv.notify_all()
@@ -358,10 +393,28 @@ class TcpShuffler:
         dest = route_ids(block, self.n_workers, self.mode, self.seed)
         parts = split_by_route(block, dest, self.n_workers)
         own = parts[self.worker_id]
+        raw_kb = wire_kb = 0
         for peer, part in enumerate(parts):
             if peer == self.worker_id:
                 continue
-            self._send_to_peer(peer, rnd, block_to_bytes(part))
+            payload, rb, wb = block_to_wire(part, self.codec)
+            raw_kb += rb
+            wire_kb += wb
+            self._send_to_peer(peer, rnd, payload)
+        if self.n_workers > 1:
+            from paddlebox_tpu import telemetry
+            from paddlebox_tpu.parallel.census import BYTE_BUCKETS
+
+            bh = telemetry.histogram(
+                "shuffle.exchange_bytes",
+                "shuffle key-payload bytes sent per exchange by worker "
+                "(raw = 8B/key equivalent, encoded = on-wire)",
+                buckets=BYTE_BUCKETS,
+            )
+            bh.observe(float(raw_kb), worker=str(self.worker_id),
+                       kind="raw")
+            bh.observe(float(wire_kb), worker=str(self.worker_id),
+                       kind="encoded")
         expected = {(p, rnd) for p in range(self.n_workers)} - {(self.worker_id, rnd)}
         deadline = time.monotonic() + self.timeout
         with self._recv_cv:

@@ -45,6 +45,105 @@ _GATHER_SECONDS = telemetry.histogram(
     help="host-plane allgather wall time (s) by channel",
 )
 
+# wire-format framing for codec'd payloads (PBOX_HOSTPLANE_CODEC): a
+# 4-byte magic + 1 codec byte ahead of the body.  Legacy peers ship the
+# bare body; the decode side fails LOUDLY on a framing mismatch instead
+# of reinterpreting bytes (HostPlaneCodecError names the channel + peer —
+# the per-channel negotiation is "every payload self-describes, unknown
+# framing is fatal").
+_CODEC_MAGIC = b"PBC1"
+_CODEC_RAW = 0  # framed, body = array.tobytes()
+_CODEC_VARINT = 1  # framed, body = zigzag-delta varints (integer dtypes)
+
+
+def _bytes_hist():
+    from paddlebox_tpu.parallel.census import BYTE_BUCKETS
+
+    return telemetry.histogram(
+        "hostplane.gather_bytes",
+        "host-plane gather payload bytes by channel base and kind "
+        "(raw = pre-codec equivalent, encoded = on-wire)",
+        buckets=BYTE_BUCKETS,
+    )
+
+
+class HostPlaneCodecError(RuntimeError):
+    """A KV-channel payload failed codec negotiation: the peer ships a
+    framing this process does not understand (mixed-version fleet) or a
+    damaged body.  Loud by design — silently frombuffer-ing a framed
+    payload as raw would train on garbage bytes."""
+
+    def __init__(self, channel: str, seq: int, rank: int, reason: str):
+        self.channel = channel
+        self.seq = seq
+        self.rank = rank
+        self.reason = reason
+        super().__init__(
+            f"host-plane codec mismatch on channel {channel!r} sequence "
+            f"{seq}: payload from process {rank} {reason} — run every "
+            "rank at the same version, or set PBOX_HOSTPLANE_CODEC=legacy "
+            "fleet-wide during a rolling upgrade"
+        )
+
+
+def _encode_array(x: np.ndarray, codec: str) -> bytes:
+    """Frame one same-shape-contract allgather payload.  ``legacy`` =
+    the pre-codec bare bytes; ``raw`` = framed, uncompressed; ``varint``
+    = framed, zigzag-delta varints for integer dtypes the transform is
+    exact on (signed ints and sub-64-bit unsigned — want matrices are
+    int32 with long dead-row runs, ~1 byte each instead of 4); other
+    dtypes fall back to the raw frame."""
+    if codec == "legacy":
+        return x.tobytes()
+    kind = x.dtype.kind
+    small_uint = kind == "u" and x.dtype.itemsize < 8
+    if codec == "varint" and (kind == "i" or small_uint) and x.size:
+        from paddlebox_tpu.utils import keycodec
+
+        body = keycodec.encode_zigzag_delta(x.ravel().astype(np.int64))
+        return _CODEC_MAGIC + bytes([_CODEC_VARINT]) + body
+    return _CODEC_MAGIC + bytes([_CODEC_RAW]) + x.tobytes()
+
+
+def _decode_array(raw: bytes, template: np.ndarray, codec: str,
+                  channel: str, seq: int, rank: int) -> np.ndarray:
+    """Inverse of :func:`_encode_array` against the local template's
+    shape/dtype; every framing surprise raises HostPlaneCodecError."""
+    if codec == "legacy":
+        if raw.startswith(_CODEC_MAGIC):
+            raise HostPlaneCodecError(
+                channel, seq, rank,
+                "is codec-framed but this rank runs PBOX_HOSTPLANE_CODEC="
+                "legacy",
+            )
+        return np.frombuffer(raw, dtype=template.dtype).reshape(
+            template.shape
+        )
+    if not raw.startswith(_CODEC_MAGIC):
+        raise HostPlaneCodecError(
+            channel, seq, rank,
+            "lacks the PBC1 frame (legacy peer on a codec-enabled fleet)",
+        )
+    codec_byte = raw[len(_CODEC_MAGIC)]
+    body = raw[len(_CODEC_MAGIC) + 1:]
+    if codec_byte == _CODEC_RAW:
+        return np.frombuffer(body, dtype=template.dtype).reshape(
+            template.shape
+        )
+    if codec_byte == _CODEC_VARINT:
+        from paddlebox_tpu.utils import keycodec
+
+        try:
+            flat = keycodec.decode_zigzag_delta(body, template.size)
+        except keycodec.KeyCodecError as e:
+            raise HostPlaneCodecError(
+                channel, seq, rank, f"has a damaged varint body ({e})"
+            ) from e
+        return flat.astype(template.dtype).reshape(template.shape)
+    raise HostPlaneCodecError(
+        channel, seq, rank, f"declares unknown codec byte {codec_byte}"
+    )
+
 
 def _channel_base(name: str) -> str:
     return re.sub(r"-\d+$", "", name)
@@ -117,13 +216,16 @@ class KvChannel:
     # within this bound, not the full channel timeout)
     POLL_S = 1.0
 
-    def __init__(self, name: str, timeout_s: Optional[float] = None):
+    def __init__(self, name: str, timeout_s: Optional[float] = None,
+                 codec: Optional[str] = None):
         # default 1h (liveness flags): a peer legitimately stalls this long
         # during a first XLA compile or a capacity-bump recompile with a
         # full prefetch queue — the device-collective path this replaces
         # would simply have waited, so the KV plane must not be the
         # stricter one.  Resolution: explicit arg > the active watchdog's
         # LivenessConfig > the PBOX_HOSTPLANE_TIMEOUT_S flag.
+        from paddlebox_tpu.config import flags
+
         if timeout_s is None:
             from paddlebox_tpu.parallel import watchdog as _wd
 
@@ -131,12 +233,22 @@ class KvChannel:
             if wd is not None:
                 timeout_s = wd.conf.hostplane_timeout_s
             else:
-                from paddlebox_tpu.config import flags
-
                 timeout_s = flags.hostplane_timeout_s
         self.name = name
         self.timeout_s = float(timeout_s)
         self.timeout_ms = int(self.timeout_s * 1000)
+        # payload codec (PBOX_HOSTPLANE_CODEC): "varint" compresses
+        # integer payloads (zigzag-delta LEB128 — the want matrices' dead
+        # runs collapse to ~1 byte each), "raw" frames without
+        # compression, "legacy" is the pre-codec bare-bytes wire for
+        # mixed-version fleets.  Same value required on every rank: the
+        # decode side fails loudly on a framing mismatch.
+        self.codec = codec if codec is not None else flags.hostplane_codec
+        if self.codec not in ("varint", "raw", "legacy"):
+            raise ValueError(
+                f"PBOX_HOSTPLANE_CODEC must be varint|raw|legacy, "
+                f"got {self.codec!r}"
+            )
         self._seq = 0
         import jax
 
@@ -156,13 +268,40 @@ class KvChannel:
         watchdog between slices (a coordinated abort interrupts the gather
         with the structured DistributedStallError within one slice), and a
         deadline raises :class:`HostPlaneTimeout` listing the exact
-        missing (channel, sequence, peer) keys."""
+        missing (channel, sequence, peer) keys.  Payloads ride the
+        channel's codec (``PBOX_HOSTPLANE_CODEC``); a peer speaking a
+        different framing raises :class:`HostPlaneCodecError`."""
+        x = np.ascontiguousarray(x)
+        payload = _encode_array(x, self.codec)
+        s = self._seq  # _gather_raw advances it
+        raws = self._gather_raw(payload, "allgather", raw_bytes=x.nbytes)
+        parts = [
+            x if r == self._rank
+            else _decode_array(raws[r], x, self.codec, self.name, s, r)
+            for r in range(self._world)
+        ]
+        return np.stack(parts)
+
+    def gather_bytes(self, payload: bytes) -> list:
+        """Varlen opaque-bytes allgather -> [P] list in rank order.
+
+        The byte-payload face of the channel: censuses and other
+        variable-length planning payloads gather WITHOUT the same-shape
+        contract (the KV store is string-valued, so no padding collective
+        is needed — one sequence step, same lockstep/GC discipline as
+        allgather).  Framing/codec of the bytes is the caller's
+        (parallel/census.py self-describes its messages)."""
+        return self._gather_raw(bytes(payload), "gather_bytes",
+                                raw_bytes=len(payload))
+
+    def _gather_raw(self, payload: bytes, op: str, raw_bytes: int) -> list:
+        """One lockstep gather of opaque bytes; shared engine under
+        allgather/gather_bytes.  Returns [P] raw byte payloads."""
         from paddlebox_tpu.parallel import watchdog as _wd
 
         faults.inject("hostplane.allgather")  # chaos site: raise or hang
         _wd.beat(f"hostplane:{self.name}")
         t_start = time.perf_counter()
-        x = np.ascontiguousarray(x)
         client = _client()
         s = self._seq
         self._seq += 1
@@ -175,15 +314,15 @@ class KvChannel:
 
         flight.record(
             "collective", "hostplane.allgather",
-            channel=self.name, seq=s, op="allgather", rank=self._rank,
+            channel=self.name, seq=s, op=op, rank=self._rank,
         )
         client.key_value_set(
             self._key(s, self._rank),
-            base64.b64encode(x.tobytes()).decode("ascii"),
+            base64.b64encode(payload).decode("ascii"),
         )
         deadline = time.monotonic() + self.timeout_s
 
-        def read(r: int) -> np.ndarray:
+        def read(r: int) -> bytes:
             key = self._key(s, r)
             while True:
                 _wd.check()  # pending abort interrupts the wait
@@ -204,9 +343,7 @@ class KvChannel:
                         continue  # slice expired: poll again
                     raise
                 _wd.beat(f"hostplane:{self.name}")
-                return np.frombuffer(
-                    base64.b64decode(raw), dtype=x.dtype
-                ).reshape(x.shape)
+                return base64.b64decode(raw)
 
         peers = [r for r in range(self._world) if r != self._rank]
         fetched: dict = {}
@@ -237,19 +374,23 @@ class KvChannel:
             raise HostPlaneTimeout(
                 self.name, s, self.timeout_s, sorted(missing)
             )
-        parts = [x if r == self._rank else fetched[r]
-                 for r in range(self._world)]
+        raws = [payload if r == self._rank else fetched[r]
+                for r in range(self._world)]
         # windowed GC of our own past key (see module docstring)
         if s >= 2:
             self._delete(s - 2)
         dt = time.perf_counter() - t_start
-        _GATHER_SECONDS.observe(dt, channel=_channel_base(self.name))
+        base = _channel_base(self.name)
+        _GATHER_SECONDS.observe(dt, channel=base)
+        bh = _bytes_hist()
+        bh.observe(float(raw_bytes), channel=base, kind="raw")
+        bh.observe(float(len(payload)), channel=base, kind="encoded")
         tr = telemetry.get_tracer()
         if tr is not None:
             end = tr.now_us()
             tr.add_span("hostplane.allgather", end - dt * 1e6, dt * 1e6,
                         channel=self.name, seq=s)
-        return np.stack(parts)
+        return raws
 
     def _delete(self, seq: int) -> None:
         try:

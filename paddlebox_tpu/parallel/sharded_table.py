@@ -74,6 +74,11 @@ from paddlebox_tpu.parallel.multiprocess import (
 )
 from paddlebox_tpu.sparse.table import SparseTable, _next_pow2
 
+# lockstep census-channel naming: every process constructs its sharded
+# tables in the same order, so the counter agrees fleet-wide (the same
+# discipline as the trainer's plan channels)
+_CENSUS_CHANNEL_SEQ = [0]
+
 
 @dataclasses.dataclass
 class ShardedBatchPlan:
@@ -134,10 +139,29 @@ class ShardedSparseTable(SparseTable):
         self._last_serve_n = 0
         # device-resident embedding engine, sharded: one HbmCache per LOCAL
         # shard (conf.hbm_cache_rows split evenly across shards), built
-        # lazily by _caches(); single-process only — the multi-host census
-        # allgather path keeps the uncached lifecycle (PR-5 scope split)
+        # lazily by _caches().  Multi-host uses the per-shard-device
+        # assembly paths (_assemble_cached_multihost /
+        # _end_pass_cached_sharded's shard-array branch) so no computation
+        # over the GLOBAL arrays ever depends on which rows are locally
+        # cached — per-rank cache state must never shape a collective.
         self._shard_cache_list: list = []
         self._cache_plans = None
+        # sparsity-aware placement + census wire (sparse/placement.py,
+        # parallel/census.py): "hybrid" classifies replicated-hot keys
+        # from observed census skew and rides them as membership bits on
+        # the multi-host census exchange; "hash" is the flat baseline;
+        # "loopback" additionally exercises the encode->decode wire path
+        # single-process.  Lazily built (_census_exchange_obj).
+        from paddlebox_tpu.config import flags as _flags
+
+        self._placement_mode = conf.placement or _flags.placement
+        if self._placement_mode not in ("hybrid", "hash", "loopback"):
+            raise ValueError(
+                "placement must be hybrid|hash|loopback, got "
+                f"{self._placement_mode!r}"
+            )
+        self._census = None
+        self._census_channel = None
         # mesh positions (== global shard ids) whose devices this process
         # owns; single-process: every position.  The want-matrix allgather in
         # plan_group assumes each process's positions are one contiguous run
@@ -166,31 +190,129 @@ class ShardedSparseTable(SparseTable):
         return None
 
     def _caches(self) -> list:
-        """One HbmCache per local shard (lazily built; empty when disabled
-        or multi-process).  Capacity splits evenly across shards."""
+        """One HbmCache per local shard (lazily built; empty when
+        disabled).  Capacity splits evenly across shards.  Multi-host: the
+        cache rows pin to each shard's owning device (hit fills/gathers
+        must be single-device ops — see _assemble_cached_multihost);
+        composed meshes keep the uncached lifecycle there (a data shard's
+        inner device group has no single owning device)."""
         if not self._cache_tried:
             with self._cache_lock:
                 if not self._cache_tried:
                     from paddlebox_tpu.config import flags
 
                     per_shard = self.conf.hbm_cache_rows // self.n_shards
+                    multi = is_multiprocess()
                     if (
                         per_shard > 0
                         and flags.hbm_cache
-                        and not is_multiprocess()
+                        and not (multi and self.mesh.devices.ndim != 1)
                     ):
                         from paddlebox_tpu.sparse.engine import HbmCache
 
+                        devs = (
+                            [self.mesh.devices[int(o)]
+                             for o in self._local_pos]
+                            if multi else [None] * self.n_local
+                        )
                         self._shard_cache_list = [
                             HbmCache(
                                 per_shard,
                                 self.conf.row_width + 1,
                                 aging=self.conf.hbm_cache_aging,
+                                device=devs[i],
                             )
-                            for _ in range(self.n_local)
+                            for i in range(self.n_local)
                         ]
                     self._cache_tried = True
         return self._shard_cache_list
+
+    # -- census wire (placement + compression) ----------------------------- #
+    def _census_exchange_obj(self):
+        """Lazily built CensusExchange: the placement planner + fleet
+        cache mirrors + transport (loopback single-process, a dedicated
+        KvChannel byte gather multi-host).  Construction is deterministic
+        across ranks — channel naming rides a lockstep counter, planner
+        and mirror sizing come from the (identical) table config."""
+        if self._census is None:
+            from paddlebox_tpu.config import flags
+            from paddlebox_tpu.parallel.census import (
+                CensusExchange,
+                FleetCacheMirror,
+                KvGatherTransport,
+                LoopbackTransport,
+            )
+
+            planner = None
+            mirror = None
+            if self._placement_mode in ("hybrid", "loopback"):
+                from paddlebox_tpu.sparse.placement import PlacementPlanner
+
+                planner = PlacementPlanner(
+                    hot_capacity=self.conf.placement_hot_capacity,
+                    aging=self.conf.placement_aging,
+                    update_interval=self.conf.placement_update_interval,
+                )
+                # seed from the HBM-cache LFU/aging directories when the
+                # caches already hold frequency evidence (warm restart)
+                for c in self._caches():
+                    used = np.nonzero(c.used)[0]
+                    if used.shape[0]:
+                        planner.seed(c.keys[used], c.freq[used])
+                per_shard = self.conf.hbm_cache_rows // self.n_shards
+                if per_shard > 0 and flags.hbm_cache:
+                    mirror = FleetCacheMirror(
+                        self.n_shards, per_shard, self.conf.hbm_cache_aging
+                    )
+            codec = (
+                "raw" if flags.hostplane_codec == "raw" else "varint"
+            )
+            if is_multiprocess():
+                from paddlebox_tpu.parallel.host_plane import KvChannel
+
+                _CENSUS_CHANNEL_SEQ[0] += 1
+                self._census_channel = KvChannel(
+                    f"census-{_CENSUS_CHANNEL_SEQ[0]}"
+                )
+                transport = KvGatherTransport(self._census_channel)
+            else:
+                transport = LoopbackTransport()
+            self._census = CensusExchange(
+                transport, planner=planner, mirror=mirror, codec=codec,
+            )
+        return self._census
+
+    def _exchange_census(self, pk: np.ndarray) -> np.ndarray:
+        """Local census -> the global census.  Multi-host, the exchange
+        runs on the main thread in lockstep across ranks (prepare_pass
+        stays gated off multi-process for exactly this reason); the
+        legacy codec keeps the pre-codec device-collective union for
+        mixed-version fleets."""
+        from paddlebox_tpu.config import flags
+
+        if is_multiprocess():
+            if flags.hostplane_codec == "legacy":
+                return np.unique(host_allgather_varlen(pk))
+            return self._census_exchange_obj().exchange(pk)
+        if self._placement_mode == "loopback":
+            return self._census_exchange_obj().exchange(pk)
+        return pk
+
+    def placement_plan(self):
+        """The current PlacementPlan, or None when the planner is off —
+        bench/test introspection."""
+        if self._census is None or self._census.planner is None:
+            return None
+        return self._census.planner.plan()
+
+    def close(self) -> None:
+        """Retire the census channel (its keys and peer-read pool) on top
+        of the base-table quiesce."""
+        ch, self._census_channel = self._census_channel, None
+        self._census = None
+        if ch is not None:
+            ch.close()
+        super().close()
 
     def abort_pass(self) -> None:
         self._cache_plans = None
@@ -307,7 +429,11 @@ class ShardedSparseTable(SparseTable):
         from paddlebox_tpu.utils.monitor import stats
 
         pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
-        pk = np.unique(host_allgather_varlen(pk))  # no-op single-process
+        # global census: the shared-dictionary exchange (hot/cached keys
+        # ride as membership bits, the cold tail as varint deltas —
+        # parallel/census.py) with byte-identical union semantics; the
+        # legacy codec keeps the raw device-collective union
+        pk = self._exchange_census(pk)
         w = self.conf.row_width
         payload, patches = self._pop_stage()
         lvals = None
@@ -347,9 +473,23 @@ class ShardedSparseTable(SparseTable):
                     sk = shard_keys[o]
                     lvals[i, : sk.shape[0]] = self._resolve_or_init(sk)
         sharding = NamedSharding(self.mesh, P(DATA_AXIS))
-        self.values = global_from_local(sharding, jnp.asarray(lvals[:, :, :w]))
-        self.g2sum = global_from_local(sharding, jnp.asarray(lvals[:, :, w]))
         self._cache_plans = None
+        if caches and is_multiprocess():
+            # multi-host cached assembly: strictly per-shard single-device
+            # ops, then one process-local global-array construction — a
+            # computation over the GLOBAL arrays here would be a collective
+            # whose program depends on per-rank cache state (deadlock)
+            self._assemble_cached_multihost(
+                lvals, shard_keys, caches, pk, sharding
+            )
+            caches = []  # hit fill already done per shard
+        else:
+            self.values = global_from_local(
+                sharding, jnp.asarray(lvals[:, :, :w])
+            )
+            self.g2sum = global_from_local(
+                sharding, jnp.asarray(lvals[:, :, w])
+            )
         if caches:
             # current hits never touch the host: one device gather+scatter
             # per shard straight out of its persistent cache
@@ -389,6 +529,65 @@ class ShardedSparseTable(SparseTable):
             else pk
         )
         self._observe_gap()
+
+    def _assemble_cached_multihost(self, lvals, shard_keys, caches, pk,
+                                   sharding) -> None:
+        """Multi-host cached promotion: per LOCAL shard, put the
+        miss-filled host buffer on the shard's own device, overwrite the
+        cache hits with a single-device gather out of that shard's
+        persistent cache, and assemble the global [n, cap, W] arrays from
+        the per-device buffers (make_array_from_single_device_arrays — a
+        pure construction, no collective).  The census exchange already
+        agreed pk fleet-wide, so shapes match across ranks even though
+        every rank's hit pattern differs."""
+        from paddlebox_tpu import telemetry
+
+        w = self.conf.row_width
+        cap = lvals.shape[1]
+        devs = [self.mesh.devices[int(o)] for o in self._local_pos]
+        vbufs, gbufs, plans = [], [], []
+        total_hits = 0
+        for i, o in enumerate(self._local_pos):
+            sk = shard_keys[o]
+            lv = jax.device_put(lvals[i], devs[i])  # [cap, W+1]
+            plan = caches[i].lookup(sk)
+            if plan.n_hits:
+                hr = caches[i].gather_rows(plan.hit_slots)
+                lv = lv.at[jnp.asarray(plan.hit_pos)].set(hr)
+            caches[i].touch(plan)
+            plans.append(plan)
+            total_hits += plan.n_hits
+            vbufs.append(lv[None, :, :w])
+            gbufs.append(lv[None, :, w])
+        n = self.n_shards
+        self.values = jax.make_array_from_single_device_arrays(
+            (n, cap, w), sharding, vbufs
+        )
+        self.g2sum = jax.make_array_from_single_device_arrays(
+            (n, cap), sharding, gbufs
+        )
+        self._cache_plans = plans
+        # local-shard hit accounting (pk is global; the per-process miss
+        # count is relative to the keys THIS process's shards own)
+        owned = sum(int(shard_keys[o].shape[0]) for o in self._local_pos)
+        self.last_cache_hits = total_hits
+        self.last_cache_misses = owned - total_hits
+        telemetry.gauge(
+            "cache.hit_rate",
+            "fraction of the pass census served from the HBM cache",
+        ).set(total_hits / max(owned, 1))
+
+    def _local_shard_arrays(self, x) -> dict:
+        """{global shard position -> [cap, ...] single-device array} for
+        this process's shards of a leading-axis-sharded global array —
+        the multi-host face of per-shard device math (no computation on
+        the global array, hence no accidental collective)."""
+        out = {}
+        for s in x.addressable_shards:
+            start = s.index[0].start or 0
+            if start not in out:
+                out[start] = s.data[0]
+        return out
 
     def _end_pass_cached_sharded(self, caches, plans) -> None:
         """Cached sharded end-of-pass: per shard, hits + admits update
@@ -431,6 +630,13 @@ class ShardedSparseTable(SparseTable):
                 self._sorted_write_back(ks, vs)
             return
         vals, g2 = self.values, self.g2sum
+        multi = is_multiprocess()
+        if multi:
+            # per-shard single-device views: indexing the GLOBAL arrays
+            # here would dispatch per-rank-divergent computations on a
+            # multi-device global array (each rank's cache plan differs)
+            vmap = self._local_shard_arrays(vals)
+            gmap = self._local_shard_arrays(g2)
         ks, vs = [], []
         n_evicted = 0
         for i, o in enumerate(self._local_pos):
@@ -446,18 +652,30 @@ class ShardedSparseTable(SparseTable):
                         caches[i].gather_rows(upd.victim_slots)
                     )
                 rp = jnp.asarray(upd_pos)
-                src = jnp.concatenate(
-                    [vals[o, rp], g2[o, rp, None]], axis=1
-                )
+                if multi:
+                    v_o, g_o = vmap[int(o)], gmap[int(o)]
+                    src = jnp.concatenate(
+                        [v_o[rp], g_o[rp][:, None]], axis=1
+                    )
+                else:
+                    src = jnp.concatenate(
+                        [vals[o, rp], g2[o, rp, None]], axis=1
+                    )
                 caches[i].set_rows(
                     np.concatenate([plan.hit_slots, upd.admit_slots]), src
                 )
             cold = empty_rows
             if upd.cold_pos.shape[0]:
                 cp = jnp.asarray(upd.cold_pos)
-                cold = np.asarray(
-                    jnp.concatenate([vals[o, cp], g2[o, cp, None]], axis=1)
-                )
+                if multi:
+                    v_o, g_o = vmap[int(o)], gmap[int(o)]
+                    cold = np.asarray(jnp.concatenate(
+                        [v_o[cp], g_o[cp][:, None]], axis=1
+                    ))
+                else:
+                    cold = np.asarray(jnp.concatenate(
+                        [vals[o, cp], g2[o, cp, None]], axis=1
+                    ))
             ks += [sk[upd.cold_pos], upd.victim_keys]
             vs += [cold, victim_rows]
             n_evicted += int(upd.victim_slots.shape[0])

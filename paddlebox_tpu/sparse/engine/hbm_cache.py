@@ -88,7 +88,13 @@ class UpdatePlan:
 
 class HbmCache:
     def __init__(self, capacity: int, n_cols: int, aging: float = 0.8,
-                 device=None):
+                 device=None, materialize_rows: bool = True):
+        """``materialize_rows=False`` builds a METADATA-ONLY twin: the full
+        directory/policy state machine (lookup/touch/plan_update/commit)
+        with no device row array — what the multi-host census plane uses to
+        mirror every remote shard's membership decisions from the shared
+        census stream (parallel/census.py FleetCacheMirror).  Row movement
+        (gather/set/drain) raises on a twin."""
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
         if not 0.0 < aging < 1.0:
@@ -96,10 +102,13 @@ class HbmCache:
         self.capacity = int(capacity)
         self.n_cols = int(n_cols)
         self.aging = float(aging)
-        rows = jnp.zeros((self.capacity, self.n_cols), jnp.float32)
-        if device is not None:
-            rows = jax.device_put(rows, device)
-        self.rows: jax.Array = rows
+        if materialize_rows:
+            rows = jnp.zeros((self.capacity, self.n_cols), jnp.float32)
+            if device is not None:
+                rows = jax.device_put(rows, device)
+        else:
+            rows = None
+        self.rows: Optional[jax.Array] = rows
         # directory (slot-indexed)
         self.keys = np.zeros(self.capacity, dtype=np.uint64)
         self.used = np.zeros(self.capacity, dtype=bool)
@@ -274,6 +283,12 @@ class HbmCache:
         the flag is on, XLA take otherwise — identical results)."""
         from paddlebox_tpu.config import flags
 
+        if self.rows is None:
+            raise RuntimeError(
+                "metadata-only cache twin has no rows to gather "
+                "(materialize_rows=False)"
+            )
+
         idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
         if flags.use_pallas_sparse:
             from paddlebox_tpu.ops.pallas_sparse import pallas_gather_slots
@@ -288,6 +303,11 @@ class HbmCache:
 
         if np.asarray(slots).shape[0] == 0:
             return
+        if self.rows is None:
+            raise RuntimeError(
+                "metadata-only cache twin has no rows to set "
+                "(materialize_rows=False)"
+            )
         idx = jnp.asarray(np.asarray(slots, dtype=np.int32))
         if flags.use_pallas_sparse:
             from paddlebox_tpu.ops.pallas_sparse import pallas_scatter_rows

@@ -1,0 +1,161 @@
+"""Property/fuzz tier for the varint sorted-delta key codec
+(utils/keycodec.py): round-trip exactness over adversarial key sets and
+LOUD structured failure on any damaged buffer — the wire the multi-host
+census and shuffle payloads ride must never short-decode silently."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.utils import keycodec as kc
+
+
+# --------------------------------------------------------------------------- #
+# round-trip exactness
+# --------------------------------------------------------------------------- #
+ADVERSARIAL_SETS = [
+    np.empty(0, dtype=np.uint64),
+    np.asarray([0], dtype=np.uint64),
+    np.asarray([np.iinfo(np.uint64).max], dtype=np.uint64),
+    np.asarray([0, np.iinfo(np.uint64).max], dtype=np.uint64),
+    # duplicates (zero deltas) — run-heavy
+    np.asarray([7] * 100, dtype=np.uint64),
+    np.sort(np.asarray([3, 3, 5, 5, 5, 9], dtype=np.uint64)),
+    # 2^32 boundary straddlers (the num-key-width family: values a 32-bit
+    # truncation would silently fold together)
+    np.asarray(
+        [(1 << 32) - 2, (1 << 32) - 1, 1 << 32, (1 << 32) + 1,
+         (1 << 33), (1 << 53), (1 << 63), (1 << 64) - 1],
+        dtype=np.uint64,
+    ),
+    # every 7-bit group-length boundary
+    np.asarray(
+        [(1 << (7 * k)) - 1 for k in range(1, 10)]
+        + [1 << (7 * k) for k in range(1, 10)],
+        dtype=np.uint64,
+    ),
+    np.arange(1000, dtype=np.uint64) * np.uint64(3),
+]
+
+
+@pytest.mark.parametrize("keys", ADVERSARIAL_SETS, ids=range(len(ADVERSARIAL_SETS)))
+def test_sorted_roundtrip_adversarial(keys):
+    keys = np.sort(keys)
+    enc = kc.encode_sorted_u64(keys)
+    out = kc.decode_sorted_u64(enc)
+    assert out.dtype == np.uint64
+    np.testing.assert_array_equal(out, keys)
+
+
+def test_sorted_roundtrip_fuzz():
+    rng = np.random.default_rng(7)
+    for trial in range(50):
+        n = int(rng.integers(0, 5000))
+        # mix of dense runs, duplicates and full-range outliers
+        dense = rng.integers(0, 1 << 20, size=n, dtype=np.uint64)
+        wide = rng.integers(0, 1 << 63, size=max(n // 8, 1), dtype=np.uint64)
+        keys = np.sort(np.concatenate([dense, wide, dense[: n // 4]]))
+        out = kc.decode_sorted_u64(kc.encode_sorted_u64(keys))
+        np.testing.assert_array_equal(out, keys)
+
+
+def test_compression_on_zipf_census():
+    """The acceptance bar: a Zipf-distributed census (real CTR traffic's
+    shape) compresses >= 4x vs raw 8-byte keys."""
+    rng = np.random.default_rng(3)
+    draws = rng.zipf(1.3, size=200_000) % (1 << 22)
+    census = np.unique(draws.astype(np.uint64))
+    enc = kc.encode_sorted_u64(census)
+    assert census.nbytes / len(enc) >= 4.0, (
+        f"compression {census.nbytes / len(enc):.2f}x < 4x "
+        f"({census.shape[0]} keys -> {len(enc)} bytes)"
+    )
+
+
+def test_unsorted_input_raises_structured():
+    with pytest.raises(kc.KeyCodecError) as ei:
+        kc.encode_sorted_u64(np.asarray([5, 3], dtype=np.uint64))
+    assert ei.value.reason == "unsorted-input"
+
+
+def test_perm_roundtrip_preserves_order():
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 1 << 40, size=777, dtype=np.uint64)
+    keys[::5] = keys[0]  # heavy duplicates in arbitrary positions
+    enc, rank = kc.encode_u64_with_perm(keys)
+    np.testing.assert_array_equal(kc.decode_u64_with_perm(enc, rank), keys)
+    # perm length/bounds damage is loud
+    with pytest.raises(kc.KeyCodecError):
+        kc.decode_u64_with_perm(enc, rank[:-1])
+    bad = rank.copy()
+    bad[0] = len(keys) + 3
+    with pytest.raises(kc.KeyCodecError):
+        kc.decode_u64_with_perm(enc, bad)
+
+
+def test_zigzag_delta_roundtrip():
+    rng = np.random.default_rng(23)
+    for vals in (
+        np.empty(0, dtype=np.int32),
+        np.asarray([0, -1, 1, np.iinfo(np.int32).min,
+                    np.iinfo(np.int32).max], dtype=np.int32),
+        rng.integers(-(1 << 30), 1 << 30, size=4096, dtype=np.int32),
+        np.full(2048, 4095, dtype=np.int32),  # dead-row run
+    ):
+        enc = kc.encode_zigzag_delta(vals)
+        out = kc.decode_zigzag_delta(enc, vals.shape[0])
+        np.testing.assert_array_equal(out.astype(np.int32), vals)
+    # the dead-row run must collapse to ~1 byte/entry (the want-matrix win)
+    run = np.full(2048, 4095, dtype=np.int32)
+    assert len(kc.encode_zigzag_delta(run)) <= 2048 + 4
+
+
+# --------------------------------------------------------------------------- #
+# damaged buffers: structured, never silent
+# --------------------------------------------------------------------------- #
+def test_truncated_buffer_every_prefix_is_loud():
+    """No prefix of a valid stream may decode to a DIFFERENT key set
+    silently — truncation either raises or (never) round-trips."""
+    keys = np.sort(
+        np.random.default_rng(5).integers(0, 1 << 48, 64, dtype=np.uint64)
+    )
+    enc = kc.encode_sorted_u64(keys)
+    for cut in range(len(enc)):
+        with pytest.raises(kc.KeyCodecError) as ei:
+            kc.decode_sorted_u64(enc[:cut])
+        assert ei.value.reason in ("truncated", "count-mismatch")
+
+
+def test_trailing_garbage_is_loud():
+    enc = kc.encode_sorted_u64(np.asarray([1, 2, 3], dtype=np.uint64))
+    with pytest.raises(kc.KeyCodecError) as ei:
+        kc.decode_sorted_u64(enc + b"\x01")
+    assert ei.value.reason == "trailing-bytes"
+
+
+def test_overlong_varint_is_loud():
+    # 11 continuation-ish bytes: an 11-byte group
+    with pytest.raises(kc.KeyCodecError) as ei:
+        kc.decode_varints(b"\x80" * 10 + b"\x01")
+    assert ei.value.reason == "overlong"
+    # a 10-byte group whose last byte encodes >= 2 (> 2^64)
+    with pytest.raises(kc.KeyCodecError) as ei:
+        kc.decode_varints(b"\x80" * 9 + b"\x02")
+    assert ei.value.reason == "overlong"
+
+
+def test_delta_overflow_is_loud():
+    # count=2, first = 2^64-1, delta = 1 -> cumsum wraps
+    stream = kc.encode_varints(
+        np.asarray([2, (1 << 64) - 1, 1], dtype=np.uint64)
+    )
+    with pytest.raises(kc.KeyCodecError) as ei:
+        kc.decode_sorted_u64(stream)
+    assert ei.value.reason == "delta-overflow"
+
+
+def test_count_mismatch_is_loud():
+    with pytest.raises(kc.KeyCodecError) as ei:
+        kc.decode_varints(b"\x05\x06", expect=3)
+    assert ei.value.reason == "count-mismatch"
+    with pytest.raises(kc.KeyCodecError):
+        kc.decode_sorted_u64(b"")

@@ -987,6 +987,58 @@ def test_executor_shutdown_bad_and_good(tmp_path):
     assert _run(rules_resources, tmp_path, good) == []
 
 
+def test_executor_shutdown_lazy_channel_pool_shape(tmp_path):
+    """The KvChannel lifecycle shape (ISSUE 15 satellite): a LAZILY built
+    peer-read pool (created under an is-None guard inside the hot method)
+    must still be flagged when nothing retires it, and the real pattern —
+    ``close()`` shutting the pool down and dropping the attribute, wired
+    into trainer teardown — must pass clean."""
+    bad = """\
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Channel:
+            def __init__(self, name):
+                self.name = name
+                self._pool = None
+
+            def allgather(self, peers):
+                if self._pool is None:
+                    self._pool = ThreadPoolExecutor(max_workers=4)
+                return [self._pool.submit(lambda p: p, r) for r in peers]
+    """
+    findings = _run(rules_resources, tmp_path, bad)
+    assert "executor-shutdown" in [f.rule for f in findings]
+
+    good = bad + """\
+
+            def close(self):
+                if self._pool is not None:
+                    self._pool.shutdown(wait=False)
+                    self._pool = None
+    """
+    assert _run(rules_resources, tmp_path, good) == []
+
+
+def test_resource_passes_clean_on_host_plane_and_census(tmp_path):
+    """Pin the REAL host-plane modules clean under the resource passes:
+    KvChannel's lazy pool + close() and the census plane must never
+    regress into a leak (the trainer closes the plan channel, the sharded
+    table closes its census channel)."""
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    ctx = Context(
+        paths=[str(root / "paddlebox_tpu" / "parallel" / "host_plane.py"),
+               str(root / "paddlebox_tpu" / "parallel" / "census.py")],
+        repo=str(root),
+    )
+    findings = [
+        f for f in rules_resources.run(ctx)
+        if not ctx.by_rel[f.file].suppressed(f)
+    ]
+    assert findings == [], [str(f) for f in findings]
+
+
 def test_resource_leak_on_early_return(tmp_path):
     src = """\
         def read(path, skip):

@@ -326,15 +326,23 @@ def fake_world(monkeypatch):
     return client
 
 
-def test_kvchannel_timeout_names_missing_keys(fake_world):
+def _peer_payload(x: np.ndarray, codec: str = "varint") -> str:
+    """What a same-version peer would post for ``x`` (codec-framed,
+    base64'd — the KvChannel wire format)."""
     import base64
 
+    return base64.b64encode(
+        host_plane._encode_array(np.ascontiguousarray(x), codec)
+    ).decode("ascii")
+
+
+def test_kvchannel_timeout_names_missing_keys(fake_world):
     ch = host_plane.KvChannel("plan-7", timeout_s=0.4)
     ch.POLL_S = 0.05
     # peer 1 answers, peer 2 never does
     x = np.asarray([5], dtype=np.int64)
-    fake_world.store["pbox_hp/plan-7/0/1"] = (
-        base64.b64encode(np.asarray([6], np.int64).tobytes()).decode()
+    fake_world.store["pbox_hp/plan-7/0/1"] = _peer_payload(
+        np.asarray([6], np.int64), ch.codec
     )
     with pytest.raises(host_plane.HostPlaneTimeout) as ei:
         ch.allgather(x)
@@ -346,24 +354,91 @@ def test_kvchannel_timeout_names_missing_keys(fake_world):
 
 
 def test_kvchannel_completes_when_peers_answer(fake_world):
-    import base64
-
     ch = host_plane.KvChannel("plan-8", timeout_s=2.0)
     ch.POLL_S = 0.05
     for r in (1, 2):
-        fake_world.store[f"pbox_hp/plan-8/0/{r}"] = (
-            base64.b64encode(np.asarray([r], np.int64).tobytes()).decode()
+        fake_world.store[f"pbox_hp/plan-8/0/{r}"] = _peer_payload(
+            np.asarray([r], np.int64), ch.codec
         )
     out = ch.allgather(np.asarray([0], dtype=np.int64))
     np.testing.assert_array_equal(out, np.asarray([[0], [1], [2]]))
     ch.close()
 
 
+def test_kvchannel_gather_bytes_varlen(fake_world):
+    """Opaque varlen byte payloads gather in rank order with no padding
+    contract (the census wire's transport face)."""
+    import base64
+
+    ch = host_plane.KvChannel("plan-b", timeout_s=2.0)
+    ch.POLL_S = 0.05
+    fake_world.store["pbox_hp/plan-b/0/1"] = base64.b64encode(
+        b"peer-one-longer-payload"
+    ).decode()
+    fake_world.store["pbox_hp/plan-b/0/2"] = base64.b64encode(b"p2").decode()
+    out = ch.gather_bytes(b"mine")
+    assert out == [b"mine", b"peer-one-longer-payload", b"p2"]
+    ch.close()
+
+
+def test_kvchannel_codec_mismatch_fails_loudly(fake_world):
+    """A legacy (unframed) peer payload on a codec-enabled channel raises
+    the structured codec error naming the peer — never a silent
+    frombuffer of garbage."""
+    ch = host_plane.KvChannel("plan-m", timeout_s=2.0, codec="varint")
+    ch.POLL_S = 0.05
+    # peer 1 speaks the old bare-bytes wire; peer 2 is well-formed
+    fake_world.store["pbox_hp/plan-m/0/1"] = (
+        __import__("base64").b64encode(
+            np.asarray([6], np.int64).tobytes()
+        ).decode()
+    )
+    fake_world.store["pbox_hp/plan-m/0/2"] = _peer_payload(
+        np.asarray([7], np.int64), "varint"
+    )
+    with pytest.raises(host_plane.HostPlaneCodecError) as ei:
+        ch.allgather(np.asarray([0], dtype=np.int64))
+    assert ei.value.rank == 1 and ei.value.channel == "plan-m"
+    # and the mirror case: a framed payload hitting a legacy rank
+    ch2 = host_plane.KvChannel("plan-m2", timeout_s=2.0, codec="legacy")
+    ch2.POLL_S = 0.05
+    fake_world.store["pbox_hp/plan-m2/0/1"] = _peer_payload(
+        np.asarray([6], np.int64), "varint"
+    )
+    fake_world.store["pbox_hp/plan-m2/0/2"] = (
+        __import__("base64").b64encode(
+            np.asarray([7], np.int64).tobytes()
+        ).decode()
+    )
+    with pytest.raises(host_plane.HostPlaneCodecError):
+        ch2.allgather(np.asarray([0], dtype=np.int64))
+
+
+def test_kvchannel_codec_roundtrip_all_modes(fake_world):
+    """Every codec mode round-trips int and float payloads exactly."""
+    for codec in ("varint", "raw", "legacy"):
+        for x in (
+            np.asarray([[5, -3, 4095, 4095]], dtype=np.int32),
+            np.asarray([1.5, -2.25], dtype=np.float32),
+            np.asarray([0, (1 << 63)], dtype=np.uint64),
+        ):
+            name = f"plan-c-{codec}-{x.dtype}"
+            ch = host_plane.KvChannel(name, timeout_s=2.0, codec=codec)
+            ch.POLL_S = 0.05
+            for r in (1, 2):
+                fake_world.store[f"pbox_hp/{name}/0/{r}"] = _peer_payload(
+                    x + x.dtype.type(r), codec
+                )
+            out = ch.allgather(x)
+            assert out.dtype == x.dtype
+            np.testing.assert_array_equal(out[0], x)
+            np.testing.assert_array_equal(out[2], x + x.dtype.type(2))
+            ch.close()
+
+
 def test_kvchannel_records_collective_digest(fake_world):
     """Every allgather leaves a (channel, seq, op) digest in the flight
     ring — the runtime witness pbox_doctor's cross-rank check consumes."""
-    import base64
-
     from paddlebox_tpu.telemetry import flight
 
     rec = flight.reset_for_tests()
@@ -371,8 +446,8 @@ def test_kvchannel_records_collective_digest(fake_world):
     ch.POLL_S = 0.05
     for s in range(2):
         for r in (1, 2):
-            fake_world.store[f"pbox_hp/plan-w/{s}/{r}"] = (
-                base64.b64encode(np.asarray([r], np.int64).tobytes()).decode()
+            fake_world.store[f"pbox_hp/plan-w/{s}/{r}"] = _peer_payload(
+                np.asarray([r], np.int64), ch.codec
             )
         ch.allgather(np.asarray([0], dtype=np.int64))
     digests = [

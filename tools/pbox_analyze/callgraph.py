@@ -34,7 +34,7 @@ from __future__ import annotations
 import ast
 from dataclasses import dataclass, field
 
-from .core import Context, SourceFile, dotted
+from .core import Context, SourceFile, cached_walk, dotted
 
 
 @dataclass
@@ -129,7 +129,7 @@ class CallGraph:
         the importing package."""
         out: dict = {}
         pkg = mod.split(".")
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
                     out[a.asname or a.name.split(".")[0]] = (
@@ -236,7 +236,7 @@ class CallGraph:
                         if sym and sym[0] == "class":
                             ann[a.arg] = sym[1]
             for mid in ci.methods.values():
-                for node in ast.walk(self.functions[mid].node):
+                for node in cached_walk(self.functions[mid].node):
                     if not isinstance(node, ast.Assign):
                         continue
                     for t in node.targets:
@@ -374,7 +374,7 @@ class CallGraph:
                     sym = self.resolve_symbol(fi.module, name)
                     if sym and sym[0] == "class":
                         out[a.arg] = sym[1]
-        for node in ast.walk(fn):
+        for node in cached_walk(fn):
             if not isinstance(node, ast.Assign):
                 continue
             for t in node.targets:

@@ -16,6 +16,29 @@ from dataclasses import dataclass, field
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# memoized subtree walks: ten-odd passes each re-walk the same module and
+# function subtrees; one materialization per root serves them all (the
+# single biggest term in the --all wall-time budget).  Entries pin a
+# strong reference to their root node, so an id() can never be reused
+# while its entry lives; the cache is bounded by a coarse clear so a
+# long-lived test session over many small fixture Contexts cannot grow
+# it without bound.
+_WALK_CACHE: dict = {}
+_WALK_CACHE_MAX = 1 << 20
+
+
+def cached_walk(node: "ast.AST"):
+    """ast.walk(node) as a memoized tuple (identical node order)."""
+    key = id(node)
+    hit = _WALK_CACHE.get(key)
+    if hit is not None and hit[0] is node:
+        return hit[1]
+    if len(_WALK_CACHE) > _WALK_CACHE_MAX:
+        _WALK_CACHE.clear()
+    nodes = tuple(ast.walk(node))
+    _WALK_CACHE[key] = (node, nodes)
+    return nodes
+
 #: what ``--all`` analyzes: the package, the tools themselves, and the
 #: bench driver.  tests/ is deliberately out — test code wedges threads
 #: and swallows exceptions on purpose.
@@ -111,7 +134,7 @@ class SourceFile:
     def parent(self, node: ast.AST):
         if self._parents is None:
             self._parents = {}
-            for parent in ast.walk(self.tree):
+            for parent in cached_walk(self.tree):
                 for child in ast.iter_child_nodes(parent):
                     self._parents[child] = parent
         return self._parents.get(node)
@@ -232,7 +255,7 @@ class ClassModel:
             if name in seen:
                 continue
             seen.add(name)
-            for node in ast.walk(self.methods[name]):
+            for node in cached_walk(self.methods[name]):
                 if (
                     isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
@@ -246,7 +269,7 @@ class ClassModel:
 
 def _scan_attr_bindings(model: ClassModel, tree) -> None:
     """Collect self.X = <ctor>() bindings and Thread(target=self.m)."""
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         if isinstance(node, (ast.Assign, ast.AnnAssign)):
             targets = node.targets if isinstance(node, ast.Assign) \
                 else [node.target]

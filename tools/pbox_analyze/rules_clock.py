@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import ast
 
-from .core import Context, dotted
+from .core import Context, cached_walk, dotted
 
 RULES = {
     "clock-misuse": (
@@ -65,7 +65,7 @@ def _is_wallclock_call(node) -> bool:
 def run(ctx: Context) -> list:
     findings: list = []
     for sf in ctx.files:
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             hit = None
             if isinstance(node, ast.BinOp) and \
                     isinstance(node.op, (ast.Add, ast.Sub)):

@@ -22,7 +22,7 @@ import os
 import re
 
 from . import catalog
-from .core import REPO, Context, Finding
+from .core import REPO, Context, Finding, cached_walk
 
 RULES = {
     "metric-name-drift": (
@@ -102,7 +102,7 @@ def fault_known_sites() -> set:
     """KNOWN_SITES parsed statically out of utils/faults.py (no package
     import: the tool must run on a bare checkout)."""
     tree = ast.parse(open(FAULTS_PY).read())
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         if isinstance(node, ast.Assign):
             for t in node.targets:
                 if isinstance(t, ast.Name) and t.id == "KNOWN_SITES":
@@ -158,7 +158,7 @@ def env_flag_vars() -> dict:
     """{PBOX_<NAME>: 'config.py:_Flags._DEFAULTS'} parsed statically out
     of the flag shim."""
     tree = ast.parse(open(CONFIG_PY).read())
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         if isinstance(node, ast.Assign):
             for t in node.targets:
                 if isinstance(t, ast.Name) and t.id == "_DEFAULTS":

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import ast
 
-from .core import Context, dotted
+from .core import Context, cached_walk, dotted
 
 RULES = {
     "swallowed-exception": (
@@ -88,7 +88,7 @@ def _is_handled(handler: ast.ExceptHandler) -> bool:
 def run(ctx: Context) -> list:
     findings: list = []
     for sf in ctx.files:
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if not _is_broad(node) or _is_handled(node):

@@ -71,7 +71,7 @@ from __future__ import annotations
 import ast
 
 from .callgraph import CallGraph
-from .core import Context, dotted
+from .core import Context, cached_walk, dotted
 from .num_catalog import (
     DEVICE_PRODUCER_CALLS,
     DTYPE_TAGS,
@@ -192,7 +192,7 @@ class NumEngine:
         names: set = set()
         factories: set = set()
         assigns: list = []
-        for node in ast.walk(sf.tree):
+        for node in cached_walk(sf.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for d in node.decorator_list:
                     base = _last(dotted(
@@ -711,13 +711,13 @@ def _retrace(eng: NumEngine, fi, fnodes) -> list:
             body_fn = nested_defs[node.args[0].id]
             own = {a.arg for a in body_fn.args.posonlyargs
                    + body_fn.args.args + body_fn.args.kwonlyargs}
-            for sub in ast.walk(body_fn):
+            for sub in cached_walk(body_fn):
                 if isinstance(sub, ast.Assign):
                     for t in sub.targets:
                         for n in ast.walk(t):
                             if isinstance(n, ast.Name):
                                 own.add(n.id)
-            for sub in ast.walk(body_fn):
+            for sub in cached_walk(body_fn):
                 if isinstance(sub, ast.Name) and isinstance(
                         sub.ctx, ast.Load) and sub.id in device_names \
                         and sub.id not in own:

@@ -51,7 +51,7 @@ import re
 from dataclasses import dataclass, field
 
 from .callgraph import CallGraph
-from .core import Context, dotted
+from .core import Context, cached_walk, dotted
 
 
 @dataclass(frozen=True)
@@ -432,7 +432,7 @@ class _Interp:
         # no release anywhere: the end-state analysis reports it once
         if not any(
             match_release(n)
-            for n in ast.walk(self.fi.node)
+            for n in cached_walk(self.fi.node)
             if isinstance(n, ast.Call)
         ):
             return
@@ -706,7 +706,7 @@ class Engine:
         toks = self._tokens.get(fi.id)
         if toks is None:
             toks = set()
-            for n in ast.walk(fi.node):
+            for n in cached_walk(fi.node):
                 if isinstance(n, ast.Call):
                     if isinstance(n.func, ast.Attribute):
                         toks.add(n.func.attr)

@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import ast
 
-from .core import Context, class_models, dotted
+from .core import Context, cached_walk, class_models, dotted
 from .rules_protocol import Engine, ProtocolSpec, release_guarded
 
 RULES = {
@@ -117,11 +117,11 @@ def _attr_method_calls(tree, method: str) -> set:
     directly, through a local alias (``t = self._thread; t.join()``),
     or through a loop over a tuple/list of self-attrs."""
     out: set = set()
-    for fn in ast.walk(tree):
+    for fn in cached_walk(tree):
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         aliases: dict = {}  # local name -> set of self attrs
-        for node in ast.walk(fn):
+        for node in cached_walk(fn):
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name):
                 attr = _self_attr(node.value)
@@ -143,7 +143,7 @@ def _attr_method_calls(tree, method: str) -> set:
                     attr = _self_attr(el)
                     if attr:
                         aliases.setdefault(node.target.id, set()).add(attr)
-        for node in ast.walk(fn):
+        for node in cached_walk(fn):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr == method):
@@ -160,7 +160,7 @@ def _attr_method_calls(tree, method: str) -> set:
 def _local_method_calls(fn, method: str) -> set:
     """Local names on which ``.method()`` is called within fn."""
     out: set = set()
-    for node in ast.walk(fn):
+    for node in cached_walk(fn):
         if (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
@@ -174,7 +174,7 @@ def _local_method_calls(fn, method: str) -> set:
 def _local_escapes(fn, name: str, binder) -> bool:
     """Does local ``name`` escape fn (returned, stored, appended,
     passed along)?  An escaped handle has an owner elsewhere."""
-    for node in ast.walk(fn):
+    for node in cached_walk(fn):
         if isinstance(node, ast.Return) and node.value is not None:
             for n in ast.walk(node.value):
                 if isinstance(n, ast.Name) and n.id == name:
@@ -214,7 +214,7 @@ def _thread_and_executor_findings(sf) -> list:
         joined_attrs = shutdown_attrs = None  # computed on first hit
         for fname, fn in model.methods.items():
             joined_locals = shutdown_locals = None
-            for node in ast.walk(fn):
+            for node in cached_walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
                 base = _ctor_base(node)
@@ -310,7 +310,7 @@ def _lock_acquire_findings(sf) -> list:
     findings: list = []
     for model in class_models(sf):
         for fname, fn in model.methods.items():
-            for node in ast.walk(fn):
+            for node in cached_walk(fn):
                 if not (
                     isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
@@ -336,7 +336,7 @@ def _lock_acquire_findings(sf) -> list:
                     continue
                 has_release = any(
                     isinstance(n, ast.Call) and match_release(n)
-                    for n in ast.walk(fn)
+                    for n in cached_walk(fn)
                 )
                 detail = (
                     "its release() is not inside a finally covering this "

@@ -64,7 +64,7 @@ from __future__ import annotations
 import ast
 
 from .callgraph import CallGraph
-from .core import Context, dotted
+from .core import Context, cached_walk, dotted
 from .spmd_catalog import (
     AXIS_CONSUMERS,
     DEVICE_COLLECTIVES,
@@ -234,7 +234,7 @@ class Spmd:
             changed = True
             while changed:
                 changed = False
-                for node in ast.walk(fn):
+                for node in cached_walk(fn):
                     if not isinstance(node, (ast.Assign, ast.AugAssign,
                                              ast.AnnAssign)):
                         continue

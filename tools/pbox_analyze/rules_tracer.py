@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import ast
 
-from .core import Context, dotted
+from .core import Context, cached_walk, dotted
 
 RULES = {
     "jax-tracer-safety": (
@@ -84,12 +84,12 @@ def _traced_functions(sf) -> list:
     to a trace entry point somewhere in the file."""
     by_name: dict = {}
     traced: list = []
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             by_name.setdefault(node.name, node)
             if any(_is_trace_decorator(d) for d in node.decorator_list):
                 traced.append(node)
-    for node in ast.walk(sf.tree):
+    for node in cached_walk(sf.tree):
         if not isinstance(node, ast.Call):
             continue
         if not _entry_last(dotted(node.func)):
@@ -177,7 +177,7 @@ def run(ctx: Context) -> list:
             wrap = ast.Module(body=[s for s in body
                                     if isinstance(s, ast.stmt)],
                               type_ignores=[])
-            for node in ast.walk(wrap) if wrap.body else ast.walk(fn):
+            for node in ast.walk(wrap) if wrap.body else cached_walk(fn):
                 if isinstance(node, ast.Call):
                     name = dotted(node.func)
                     segs = set(name.split(".")) if name else set()

@@ -70,9 +70,17 @@ METHOD_COLLECTIVES = {
         op="exchange",
         classes=frozenset({
             "TcpShuffler", "_InProcessShuffler", "InProcessShuffleGroup",
+            "CensusExchange",
         }),
         thread_safe=True,
-        why="pass-scoped shuffle round: every worker must exchange",
+        why="pass-scoped shuffle round / census gather: every worker must "
+            "exchange",
+    ),
+    "gather_bytes": CollectiveSpec(
+        op="gather_bytes", classes=frozenset({"KvChannel"}),
+        thread_safe=True,
+        why="ordered KV-channel byte gather (same lockstep contract as "
+            "allgather; the census wire's transport face)",
     ),
     "flush": CollectiveSpec(
         op="flush", classes=frozenset({"ShardedSparseTable"}),
