@@ -2195,6 +2195,416 @@ def stage_fleet(backend, args) -> None:
           **res})
 
 
+def _elastic_reshard_pin(n_slots: int, dense: int, bsz: int = 16) -> dict:
+    """The training-side half of the --elastic acceptance: a LIVE
+    pass-boundary reshard (grow, e.g. 2 -> 4 shards) must be bit-exact —
+    keys, values, g2sum, AUC — against a fixed-shard teardown-and-rebuild
+    at the new shard count (the same pin tests/test_reshard.py holds; the
+    bench re-proves it on the day's backend and reports it in the row)."""
+    import jax
+
+    from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.parallel import (
+        MultiChipTrainer, ShardedSparseTable, make_mesh,
+    )
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"reshard_bit_exact": None,
+                "reshard_skipped": f"{n_dev} device(s): no second shard"}
+    new_n = min(4, n_dev)
+    old_n = max(1, new_n // 2)
+    mesh_old, mesh_new = make_mesh(old_n), make_mesh(new_n)
+    tconf = SparseTableConfig(embedding_dim=8)
+
+    with tempfile.TemporaryDirectory() as td:
+        conf = make_synth_config(n_sparse_slots=n_slots, dense_dim=dense,
+                                 batch_size=bsz, max_feasigns_per_ins=16)
+        # 8 per-device batches: divisible by both shard counts
+        files = write_synth_files(td, n_files=2, ins_per_file=bsz * 4,
+                                  n_sparse_slots=n_slots, vocab_per_slot=200,
+                                  dense_dim=dense, seed=23)
+        ds = PadBoxSlotDataset(conf, read_threads=2)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+
+        def trainer(mesh):
+            model = CtrDnn(n_slots, tconf.row_width, dense_dim=dense,
+                           hidden=(16,))
+            return MultiChipTrainer(model, tconf, mesh,
+                                    TrainerConfig(auc_buckets=1 << 10),
+                                    seed=3)
+
+        def run_pass(tr, table):
+            table.begin_pass(ds.unique_keys())
+            m = tr.train_from_dataset(ds, table)
+            table.end_pass()
+            return m
+
+        live = ShardedSparseTable(tconf, mesh_old, seed=5)
+        run_pass(trainer(mesh_old), live)
+        t0 = time.perf_counter()
+        moved = live.reshard(mesh_new)
+        reshard_s = time.perf_counter() - t0
+        m_live = run_pass(trainer(mesh_new), live)
+
+        base = ShardedSparseTable(tconf, mesh_old, seed=5)
+        run_pass(trainer(mesh_old), base)
+        rebuilt = ShardedSparseTable(tconf, mesh_new, seed=5)
+        rebuilt.load_state_dict(base.state_dict())
+        m_base = run_pass(trainer(mesh_new), rebuilt)
+
+        s_live, s_base = live.state_dict(), rebuilt.state_dict()
+        exact = (np.array_equal(s_live["keys"], s_base["keys"])
+                 and np.array_equal(s_live["values"], s_base["values"])
+                 and m_live["auc"] == m_base["auc"])
+        for t in (live, base, rebuilt):
+            t.close()
+        ds.close()
+    return {
+        "reshard_old_shards": old_n,
+        "reshard_new_shards": new_n,
+        "reshard_moved_rows": moved,
+        "reshard_seconds": round(reshard_s, 3),
+        "reshard_auc": round(m_live["auc"], 6),
+        "reshard_bit_exact": bool(exact),
+    }
+
+
+def bench_elastic(duration_s: float = 24.0, base_qps: float = 10.0,
+                  n_slots: int = 4, dense: int = 4) -> dict:
+    """Elastic-fleet evidence (PR 16 acceptance), OPEN-LOOP: a diurnal
+    rate curve (low -> peak -> low over the run) with a 4x flash crowd on
+    the shoulder and a Zipf-drifting request mix, driven against a REAL
+    replica fleet (2 seed replicas) with the FleetAutoscaler live.  The
+    flash crowd must force >= 1 autoscale-up, the post-peak idle tail
+    >= 1 drain-retire, and a rolling restart fires mid-stream while the
+    load runs — with ZERO failed requests (sheds are admission control,
+    not failures), a bounded p99, and the fleet freshness floor held at
+    every sample (>= 1 serving replica reporting the model: min applied
+    seq never vanishes mid-roll; static base artifact, so the deadline
+    evidence is floor-never-empty + max observed age).  The emitted row
+    also carries the training-side pin: a live pass-boundary reshard
+    bit-exact vs a fixed-shard rebuild (_elastic_reshard_pin)."""
+    import http.client
+    import math
+    import threading
+
+    from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.inference import export_model
+    from paddlebox_tpu.models import CtrDnn
+    from paddlebox_tpu.serving_fleet import (
+        EJECTED,
+        AutoscalerConfig,
+        FleetAutoscaler,
+        FleetRouter,
+        ReplicaSupervisor,
+    )
+    from paddlebox_tpu.serving_sync.syncer import fleet_min_freshness
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.train.trainer import Trainer
+
+    from paddlebox_tpu import telemetry
+
+    B = 32
+    res: dict = {"base_qps": base_qps, "duration_s": duration_s}
+    with tempfile.TemporaryDirectory() as td:
+        telemetry.set_process_name("bench-elastic")
+        conf = make_synth_config(n_sparse_slots=n_slots, dense_dim=dense,
+                                 batch_size=B, max_feasigns_per_ins=8)
+        files = write_synth_files(td, n_files=1, ins_per_file=4 * B,
+                                  n_sparse_slots=n_slots, vocab_per_slot=500,
+                                  dense_dim=dense, seed=17)
+        ds = PadBoxSlotDataset(conf, read_threads=1)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        tconf = SparseTableConfig(embedding_dim=4)
+        model = CtrDnn(n_slots, tconf.row_width, dense_dim=dense,
+                       hidden=(16,))
+        table = SparseTable(tconf, seed=0)
+        trainer = Trainer(model, tconf, TrainerConfig(auc_buckets=1 << 10),
+                          seed=0)
+        table.begin_pass(ds.unique_keys())
+        trainer.train_from_dataset(ds, table)
+        table.end_pass()
+        ds.close()
+        kcap = conf.batch_key_capacity or (B * conf.max_feasigns_per_ins)
+        art = os.path.join(td, "artifact")
+        export_model(model, trainer.params, table, art, batch_size=B,
+                     key_capacity=kcap, dense_dim=dense, feed_conf=conf)
+
+        # Zipf-drifting request mix: K distinct bodies (4 lines each);
+        # the hot index rotates through the run so the popular request
+        # shape at minute N is a cold one at minute N+1
+        with open(files[0], "rb") as f:
+            lines = f.read().splitlines()
+        K = 16
+        bodies = [b"\n".join(lines[(4 * i) % len(lines):
+                                   (4 * i) % len(lines) + 4]) + b"\n"
+                  for i in range(K)]
+        zipf = np.minimum(np.random.default_rng(3).zipf(1.5, 1 << 14), K) - 1
+
+        def argv_for(rid, port):
+            return [sys.executable, "-m", "paddlebox_tpu.serve",
+                    "--replicas", "0",
+                    "--artifact", art, "--port", str(port), "--cpu",
+                    "--max-queue", "8", "--request-deadline-ms", "2000"]
+
+        sup = ReplicaSupervisor(2, argv_for,
+                                log_dir=os.path.join(td, "logs"))
+        sup.start()
+        router = FleetRouter(sup.endpoints(), probe_interval_s=0.2)
+        scaler = FleetAutoscaler(sup, router, AutoscalerConfig(
+            min_replicas=2, max_replicas=4, interval_s=0.25, cooldown_s=3.0,
+            up_queue_depth=2.0, up_wait_s=0.1, up_shed_rate=0.25,
+            up_after=2, down_after=8, drain_timeout_s=5.0,
+        ))
+        lat_ok: list = []
+        shed = failed = 0
+        count_lock = threading.Lock()
+        fresh = {"floor_held": True, "max_age_s": 0.0, "min_serving": 99,
+                 "samples": 0}
+        max_fleet = {"n": 2}
+        stop_monitor = threading.Event()
+        rolled: list = []
+        try:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 600:
+                router.probe_once()
+                if all(r.state != EJECTED for r in router.replicas):
+                    break
+                time.sleep(0.5)
+            else:
+                raise RuntimeError("replicas never came healthy: "
+                                   f"{[r.last_error for r in router.replicas]}")
+            log(f"elastic: 2 seed replicas healthy in "
+                f"{time.monotonic() - t0:.0f}s")
+            port = router.start(port=0)
+            for i in range(4):  # warm each replica's compile path
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                conn.request("POST", "/score", body=bodies[i % K])
+                conn.getresponse().read()
+                conn.close()
+            scaler.start()
+
+            def monitor():
+                # freshness floor + fleet-size high-water, sampled through
+                # flash crowd, scale events and the roll
+                while not stop_monitor.is_set():
+                    view = router.fleet_view()
+                    f = fleet_min_freshness(view)
+                    with count_lock:
+                        fresh["samples"] += 1
+                        max_fleet["n"] = max(max_fleet["n"],
+                                             len(sup.endpoints()))
+                        fresh["min_serving"] = min(fresh["min_serving"],
+                                                   f["n_serving"])
+                        # static base artifact => no sync seq lineage; the
+                        # floor evidence is "some serving replica reports
+                        # the model" at EVERY sample through the roll
+                        if f["n_serving"] < 1 \
+                                or f["max_age_seconds"] is None:
+                            fresh["floor_held"] = False
+                        if f["max_age_seconds"] is not None:
+                            fresh["max_age_s"] = max(fresh["max_age_s"],
+                                                     f["max_age_seconds"])
+                    stop_monitor.wait(0.15)
+
+            # diurnal open-loop schedule: send times come from the rate
+            # curve alone (a slow fleet slips the schedule and that shows
+            # up as achieved_qps, never as a hidden slowdown)
+            def rate_at(t):
+                frac = t / duration_s
+                r = base_qps * (0.25 + 0.75 *
+                                (0.5 - 0.5 * math.cos(2 * math.pi * frac)))
+                if 0.35 <= frac < 0.55:
+                    r *= 4.0  # flash crowd on the diurnal shoulder
+                return r
+
+            times = []
+            t = 0.0
+            while t < duration_s:
+                times.append(t)
+                t += 1.0 / max(rate_at(t), 0.5)
+            n_requests = len(times)
+            idx = {"i": 0}
+            start = time.monotonic()
+
+            def worker():
+                nonlocal shed, failed
+                while True:
+                    with count_lock:
+                        i = idx["i"]
+                        if i >= n_requests:
+                            return
+                        idx["i"] = i + 1
+                    delay = start + times[i] - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                    # Zipf mix whose hot index drifts with the clock
+                    body = bodies[(int(zipf[i % zipf.shape[0]])
+                                   + int(times[i] / duration_s * K)) % K]
+                    t1 = time.perf_counter()
+                    try:
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=30)
+                        conn.request("POST", "/score", body=body)
+                        r = conn.getresponse()
+                        r.read()
+                        status = r.status
+                        conn.close()
+                    # pbox-lint: ignore[swallowed-exception] failure is
+                    # recorded: status=-1 counts as failed below
+                    except Exception:
+                        status = -1
+                    dt = (time.perf_counter() - t1) * 1e3
+                    with count_lock:
+                        if status == 200:
+                            lat_ok.append(dt)
+                        elif status == 429:
+                            shed += 1
+                        else:
+                            failed += 1
+
+            # the flash crowd is a CLOSED-loop burst on top of the
+            # open-loop diurnal stream: N clients hammering back-to-back
+            # for the window — the open-loop pool alone cannot saturate a
+            # fast fleet, and the whole point of the window is to force
+            # real queue depth/sheds so the autoscaler has something to
+            # act on.  Its requests ride the same zero-failed accounting.
+            def flash_crowd():
+                w0 = start + 0.35 * duration_s
+                w1 = start + 0.55 * duration_s
+                while time.monotonic() < w0:
+                    if stop_monitor.is_set():
+                        return
+                    time.sleep(0.05)
+
+                def blast():
+                    nonlocal shed, failed
+                    while time.monotonic() < w1:
+                        t1 = time.perf_counter()
+                        try:
+                            conn = http.client.HTTPConnection(
+                                "127.0.0.1", port, timeout=10)
+                            conn.request("POST", "/score", body=bodies[0])
+                            r = conn.getresponse()
+                            r.read()
+                            status = r.status
+                            conn.close()
+                        # pbox-lint: ignore[swallowed-exception] recorded
+                        # as a failed request below
+                        except Exception:
+                            status = -1
+                        dt = (time.perf_counter() - t1) * 1e3
+                        with count_lock:
+                            if status == 200:
+                                lat_ok.append(dt)
+                            elif status == 429:
+                                shed += 1
+                            else:
+                                failed += 1
+
+                bthreads = [threading.Thread(target=blast, daemon=True)
+                            for _ in range(24)]
+                for b in bthreads:
+                    b.start()
+                for b in bthreads:
+                    b.join()
+
+            mon = threading.Thread(target=monitor, daemon=True)
+            mon.start()
+            crowd = threading.Thread(target=flash_crowd, daemon=True)
+            crowd.start()
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(8)]
+            for th in threads:
+                th.start()
+
+            # rolling restart MID-STREAM, concurrent with the autoscaler
+            # (the roll skips any replica a scale action retires under it)
+            time.sleep(duration_s * 0.25)
+            log("elastic: rolling restart starting mid-stream")
+            rolled = scaler.rolling_restart(freshness_max_age_s=3600.0,
+                                            replica_timeout_s=300.0)
+            log(f"elastic: rolled replicas {rolled}")
+            for th in threads:
+                th.join(timeout=duration_s + 300)
+            crowd.join(timeout=duration_s + 300)
+            wall = time.monotonic() - start
+
+            # idle tail: with the load gone, the down-streak + cooldown
+            # must produce the drain-retire if the flash crowd's spawn
+            # hasn't already been retired during the diurnal trough
+            ac = telemetry.counter("fleet.autoscale")
+            t0 = time.monotonic()
+            while ac.value(direction="up") >= 1 \
+                    and ac.value(direction="down") < 1 \
+                    and time.monotonic() - t0 < 90:
+                time.sleep(0.5)
+        finally:
+            stop_monitor.set()
+            scaler.stop()
+            router.stop()
+            sup.stop()
+
+    lat_ok.sort()
+    n_ok = len(lat_ok)
+    autoscale = telemetry.counter("fleet.autoscale")
+    rolls = telemetry.counter("fleet.rolls")
+    res.update({
+        "requests": n_ok + shed + failed,
+        "ok": n_ok,
+        "shed": shed,
+        "failed_requests": failed,
+        "zero_failed": failed == 0,
+        "p50_ms": round(lat_ok[n_ok // 2], 2) if n_ok else None,
+        "p99_ms": round(lat_ok[_rank(0.99, n_ok)], 2) if n_ok else None,
+        "achieved_qps": round((n_ok + shed + failed) / wall, 1),
+        "autoscale_up": int(autoscale.value(direction="up")),
+        "autoscale_down": int(autoscale.value(direction="down")),
+        "retired_replicas": int(
+            telemetry.counter("fleet.retires").value()),
+        "max_fleet_size": max_fleet["n"],
+        "rolled_replicas": rolled,
+        "rolls_ok": int(rolls.value(outcome="ok")),
+        "rolls_skipped": int(rolls.value(outcome="skipped")),
+        "freshness_floor_held": fresh["floor_held"],
+        "freshness_max_age_s": round(fresh["max_age_s"], 1),
+        "freshness_min_serving": fresh["min_serving"],
+        "freshness_samples": fresh["samples"],
+    })
+    log(f"elastic: {n_ok} ok / {shed} shed / {failed} FAILED of "
+        f"{res['requests']} @ {res['achieved_qps']} qps; p50 "
+        f"{res['p50_ms']}ms p99 {res['p99_ms']}ms; up "
+        f"{res['autoscale_up']} down {res['autoscale_down']} "
+        f"max_fleet {res['max_fleet_size']}; rolled {rolled}; "
+        f"freshness floor held={res['freshness_floor_held']}")
+    res.update(_elastic_reshard_pin(n_slots, dense))
+    if res.get("reshard_bit_exact") is not None:
+        log(f"elastic: reshard pin {res['reshard_old_shards']}->"
+            f"{res['reshard_new_shards']} moved "
+            f"{res['reshard_moved_rows']} rows in "
+            f"{res['reshard_seconds']}s bit_exact="
+            f"{res['reshard_bit_exact']}")
+    return res
+
+
+def stage_elastic(backend, args) -> None:
+    res = bench_elastic(duration_s=args.elastic_seconds,
+                        base_qps=args.elastic_qps)
+    emit({"metric": "elastic_fleet_p99_ms", "value": res.get("p99_ms"),
+          "unit": "ms p99 (diurnal open loop; autoscale + drain-retire + "
+                  "rolling restart mid-stream)", "vs_baseline": None,
+          "backend": backend, **res})
+
+
 def bench_streaming(duration_s: float = 10.0, rate: float = 500.0,
                     max_staleness_s: float = 1.5, n_slots: int = 2,
                     dense: int = 2, bsz: int = 16) -> dict:
@@ -2753,6 +3163,19 @@ def main() -> None:
                     help="open-loop target QPS for --fleet")
     ap.add_argument("--fleet-seconds", type=float, default=12.0,
                     help="load duration for --fleet")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic-fleet run: diurnal open-loop load with "
+                         "a flash crowd and Zipf request drift against a "
+                         "live FleetAutoscaler (scale-up, drain-retire) "
+                         "plus a rolling restart mid-stream — zero failed "
+                         "requests, bounded p99, freshness floor held; "
+                         "the row also carries the live-reshard "
+                         "bit-exactness pin")
+    ap.add_argument("--elastic-qps", type=float, default=10.0,
+                    help="diurnal base QPS for --elastic (the flash "
+                         "crowd peaks at 4x this)")
+    ap.add_argument("--elastic-seconds", type=float, default=24.0,
+                    help="load duration for --elastic")
     ap.add_argument("--qps-sweep", default="",
                     metavar="Q1,Q2,...",
                     help="open-loop QPS sweep: with --serving drive one "
@@ -2804,6 +3227,15 @@ def main() -> None:
         args.max_seconds = 5400.0 if getattr(args, "all") else 1700.0
     start_deadline(args.max_seconds)
 
+    if args.elastic:
+        # the training-side reshard pin needs a multi-shard mesh even on
+        # a single-CPU box; the flag only affects the host platform and
+        # must land before the first backend init
+        xf = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xf:
+            os.environ["XLA_FLAGS"] = (
+                xf + " --xla_force_host_platform_device_count=8").strip()
+
     if os.environ.get("PBOX_BENCH_CPU"):
         # smoke-test escape hatch: never touch the axon tunnel (the emitted
         # backend field says "cpu", so this can't masquerade as a TPU number)
@@ -2823,6 +3255,9 @@ def main() -> None:
     elif args.serving:
         fail_metric = "serving_score_latency"
         fail_unit = "ms p50 (64-instance request)"
+    elif args.elastic:
+        fail_metric = "elastic_fleet_p99_ms"
+        fail_unit = "ms p99 (diurnal open loop)"
     elif args.fleet:
         fail_metric = "fleet_router_p99_ms"
         fail_unit = "ms p99 (8-instance request)"
@@ -2887,6 +3322,10 @@ def main() -> None:
 
     if args.serving:
         stage_serving(backend)
+        return
+
+    if args.elastic:
+        stage_elastic(backend, args)
         return
 
     if args.fleet:

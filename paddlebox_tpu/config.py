@@ -161,6 +161,17 @@ class _Flags:
         "embedding_dtype": "fp32",
         # fleet router health/freshness probe cadence per replica
         "fleet_probe_interval_s": 1.0,
+        # elastic fleet (serving_fleet/autoscaler.py): autoscaler decision
+        # cadence and the cooldown after ANY scale action before the next
+        # may fire (hysteresis lives in the tick thresholds; the cooldown
+        # is the flap-proofing backstop on top)
+        "autoscale_interval_s": 2.0,
+        "autoscale_cooldown_s": 30.0,
+        # fleet size bounds the autoscaler may never cross in either
+        # direction (min also floors the rolling-restart freshness gate:
+        # a one-replica fleet can never roll without downtime)
+        "autoscale_min_replicas": 1,
+        "autoscale_max_replicas": 8,
         # pass-boundary pipelining kill switch (sparse/table.py): 0 forces
         # every table back to the serial end_pass/begin_pass lifecycle
         # regardless of SparseTableConfig.overlap_pass_boundary — the

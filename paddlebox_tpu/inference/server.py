@@ -485,7 +485,11 @@ class ScoringServer:
                          "degraded": bool(degraded),
                          "degraded_reasons": degraded,
                          "draining": server._draining,
-                         "queue_depth": server.gate.queue_depth()},
+                         "queue_depth": server.gate.queue_depth(),
+                         # admission-wait estimate for the queue as it
+                         # stands: the autoscaler's latency-pressure
+                         # signal (EWMA service time × queue / width)
+                         "estimated_wait_s": server.gate.estimated_wait_s()},
                     )
                 elif self.path == "/models":
                     # per-model version lineage + freshness: base tag,

@@ -53,6 +53,7 @@ US = n * C:
 from __future__ import annotations
 
 import dataclasses
+import struct
 import time
 from typing import Optional, Sequence
 
@@ -78,6 +79,55 @@ from paddlebox_tpu.sparse.table import SparseTable, _next_pow2
 # tables in the same order, so the counter agrees fleet-wide (the same
 # discipline as the trainer's plan channels)
 _CENSUS_CHANNEL_SEQ = [0]
+
+# lockstep reshard-channel naming: reshard() is a collective (every
+# process calls it at the same pass boundary), so the counter agrees
+_RESHARD_CHANNEL_SEQ = [0]
+
+# migration payload framing (keycodec-framed, versioned like the host
+# plane's PBC1): magic | n_rows | row_width+1 | len(key_stream) |
+# delta-compressed sorted keys | int32 rank (hottest-first order rides
+# as the permutation beside the compressed sorted copy) | f32 rows
+_RESHARD_MAGIC = b"PBR1"
+_RESHARD_HEAD = "<4sIII"
+
+
+def _encode_migration(keys: np.ndarray, rows: np.ndarray) -> bytes:
+    """Frame one process's outgoing migration rows.  ``keys`` arrive in
+    hottest-first order and that order is preserved on the wire
+    (encode_u64_with_perm: compressed sorted stream + permutation)."""
+    from paddlebox_tpu.utils.keycodec import encode_u64_with_perm
+
+    kb, rank = encode_u64_with_perm(keys)
+    head = struct.pack(
+        _RESHARD_HEAD, _RESHARD_MAGIC, keys.shape[0], rows.shape[1], len(kb)
+    )
+    return (head + kb + rank.astype("<i4").tobytes()
+            + np.ascontiguousarray(rows, dtype="<f4").tobytes())
+
+
+def _decode_migration(buf: bytes):
+    """Inverse of :func:`_encode_migration` -> (keys, rows), row order
+    preserved.  Raises on any framing mismatch — a migration payload
+    that doesn't round-trip must abort the reshard, never half-apply."""
+    from paddlebox_tpu.utils.keycodec import decode_u64_with_perm
+
+    head = struct.calcsize(_RESHARD_HEAD)
+    magic, n, w1, klen = struct.unpack_from(_RESHARD_HEAD, buf, 0)
+    if magic != _RESHARD_MAGIC:
+        raise ValueError(f"bad reshard payload magic {magic!r}")
+    off = head
+    kb = bytes(buf[off:off + klen])
+    off += klen
+    rank = np.frombuffer(buf, dtype="<i4", count=n, offset=off)
+    off += 4 * n
+    keys = decode_u64_with_perm(kb, rank)
+    rows = np.frombuffer(
+        buf, dtype="<f4", count=n * w1, offset=off
+    ).reshape(n, w1)
+    if off + 4 * n * w1 != len(buf):
+        raise ValueError("reshard payload length mismatch")
+    return keys.copy(), rows.astype(np.float32)
 
 
 @dataclasses.dataclass
@@ -162,21 +212,27 @@ class ShardedSparseTable(SparseTable):
             )
         self._census = None
         self._census_channel = None
+        # frequency evidence carried across a reshard cutover (seeds the
+        # rebuilt planner so the hot set survives the shard-map swap)
+        self._carry_freq = None
         # mesh positions (== global shard ids) whose devices this process
         # owns; single-process: every position.  The want-matrix allgather in
         # plan_group assumes each process's positions are one contiguous run
         # in process order (JAX's default device order guarantees it).
-        self._local_pos = local_device_indices(mesh)
-        L = self._local_pos.shape[0]
+        self._local_pos = self._checked_local_pos(mesh)
+
+    @staticmethod
+    def _checked_local_pos(mesh: Mesh) -> np.ndarray:
+        pos = local_device_indices(mesh)
+        L = pos.shape[0]
         pid = jax.process_index()
-        if not np.array_equal(
-            self._local_pos, np.arange(pid * L, pid * L + L)
-        ):
+        if not np.array_equal(pos, np.arange(pid * L, pid * L + L)):
             raise RuntimeError(
                 f"process {pid} owns non-contiguous mesh positions "
-                f"{self._local_pos.tolist()}: build the mesh from "
+                f"{pos.tolist()}: build the mesh from "
                 "jax.devices() default order"
             )
+        return pos
 
     @property
     def n_local(self) -> int:
@@ -259,6 +315,11 @@ class ShardedSparseTable(SparseTable):
                     used = np.nonzero(c.used)[0]
                     if used.shape[0]:
                         planner.seed(c.keys[used], c.freq[used])
+                # evidence carried across a reshard cutover: the previous
+                # planner's full tracker, so the hot set stays warm
+                if self._carry_freq is not None:
+                    planner.seed(*self._carry_freq)
+                    self._carry_freq = None
                 per_shard = self.conf.hbm_cache_rows // self.n_shards
                 if per_shard > 0 and flags.hbm_cache:
                     mirror = FleetCacheMirror(
@@ -317,6 +378,228 @@ class ShardedSparseTable(SparseTable):
     def abort_pass(self) -> None:
         self._cache_plans = None
         super().abort_pass()
+
+    # -- live resharding (PR 16) ------------------------------------------- #
+    def reshard(self, new_mesh: Mesh) -> int:
+        """Grow/shrink the shard count at a pass boundary (collective:
+        every process calls this at the SAME boundary).  Returns the
+        number of rows whose owner shard changed.
+
+        The cut point is the same barrier checkpointing rides: flush()
+        drains dirty HBM-cache rows and waits out in-flight write-backs,
+        so the host store is truth for every key before any row moves.
+        Any staged next pass is discarded — it was resolved and laid out
+        for the OLD shard split.
+
+        Two phases, both fault sites, with an all-or-nothing contract:
+        ``_reshard_migrate`` stages the owner-changed rows through the
+        host plane (keycodec-framed, hottest-first by planner frequency
+        evidence, round-trip verified) WITHOUT mutating anything;
+        ``_reshard_cutover`` then commits — store ownership, mesh, shard
+        count, cache/census rebuild.  A failure in either phase aborts
+        cleanly back to the old shard map (``_reshard_abort``) and
+        re-raises: there is no partial cutover state.
+
+        Bit-exactness: rows are moved verbatim ([show, clk, embed…,
+        g2sum] bytes untouched), fresh-key init is key-deterministic
+        (_key_uniform is shard-count-independent), and per-shard math
+        orders by the same sorted global census — so training after a
+        live reshard is bit-identical to a teardown-and-rebuild at the
+        new shard count (pinned by tests/test_reshard.py).
+        """
+        if self._in_pass:
+            raise RuntimeError("reshard between passes, never inside one")
+        new_n = int(new_mesh.shape[DATA_AXIS])
+        if new_n < 1:
+            raise ValueError(f"new mesh has no {DATA_AXIS!r} shards")
+        from paddlebox_tpu import telemetry
+
+        self.flush()
+        self._discard_stage()
+        if new_n == self.n_shards and np.array_equal(
+            np.asarray(self.mesh.devices, dtype=object),
+            np.asarray(new_mesh.devices, dtype=object),
+        ):
+            return 0
+        old = self._reshard_snapshot()
+        t0 = time.perf_counter()
+        try:
+            with telemetry.span("reshard.migrate", old_shards=self.n_shards,
+                                new_shards=new_n):
+                staged, moved = self._reshard_migrate(new_mesh)
+            with telemetry.span("reshard.cutover", old_shards=self.n_shards,
+                                new_shards=new_n):
+                self._reshard_cutover(new_mesh, staged)
+        except Exception:
+            self._reshard_abort(old)
+            telemetry.counter(
+                "reshard.aborts",
+                "reshards rolled back to the old shard map",
+            ).inc()
+            raise
+        telemetry.counter(
+            "reshard.migrated_rows",
+            "rows whose owner shard changed across reshards",
+        ).inc(moved)
+        telemetry.histogram(
+            "reshard.seconds", "live reshard wall time (migrate + cutover)"
+        ).observe(time.perf_counter() - t0)
+        return moved
+
+    def _reshard_snapshot(self) -> dict:
+        """Everything _reshard_abort needs to restore the old shard map.
+        The snapshot is references, not copies: migrate stages rows
+        without mutating, and cutover swaps these fields only after its
+        own fault site — so on every abort branch the referenced objects
+        are still exactly the pre-reshard state."""
+        return {
+            "mesh": self.mesh,
+            "n_shards": self.n_shards,
+            "local_pos": self._local_pos,
+            "caches": self._shard_cache_list,
+            "cache_tried": self._cache_tried,
+            "census": self._census,
+            "census_channel": self._census_channel,
+            "last_serve_n": self._last_serve_n,
+            "carry_freq": self._carry_freq,
+        }
+
+    def _proc_of(self, shard: np.ndarray, n_shards: int) -> np.ndarray:
+        """Owning process per shard id under a given shard count (shards
+        split into contiguous per-process runs — asserted in __init__)."""
+        per = max(n_shards // jax.process_count(), 1)
+        return shard // per
+
+    def _reshard_migrate(self, new_mesh: Mesh):
+        """Stage the owner-changed rows for the new shard map — NO
+        mutation of store/caches/mesh happens here, so an abort after a
+        migrate failure has nothing to undo.
+
+        Single-process, ownership never leaves the one host store: the
+        moved set still rides the full encode→decode wire round-trip
+        (same loopback discipline as the census exchange) and is
+        verified bit-exact against the store rows.  Multi-host, each
+        process frames its outgoing rows and the payloads cross the host
+        plane on a dedicated KvChannel byte gather; the staged result is
+        (incoming keys/rows to merge, outgoing keys to drop) committed
+        by cutover."""
+        from paddlebox_tpu.utils import faults
+
+        faults.inject("reshard.migrate")
+        old_n, new_n = self.n_shards, int(new_mesh.shape[DATA_AXIS])
+        keys, rows = self._store.materialize()
+        old_owner = (keys % np.uint64(old_n)).astype(np.int64)
+        new_owner = (keys % np.uint64(new_n)).astype(np.int64)
+        moved_mask = old_owner != new_owner
+        moved = int(moved_mask.sum())
+        mk, mrows = keys[moved_mask], rows[moved_mask]
+        # hottest-first: the planner's frequency evidence orders the
+        # payload so the keys most likely in the next pass's census land
+        # (and can be cache-seeded) first; ties stay in key order
+        planner = None if self._census is None else self._census.planner
+        if planner is not None and mk.shape[0]:
+            order = np.argsort(-planner.frequencies(mk), kind="stable")
+            mk, mrows = mk[order], mrows[order]
+        multi = is_multiprocess()
+        if not multi:
+            # loopback wire: what WOULD cross the host plane must survive
+            # the codec round trip bit-exactly, or the reshard aborts
+            dk, drows = _decode_migration(_encode_migration(mk, mrows))
+            if not (np.array_equal(dk, mk)
+                    and np.array_equal(drows, mrows)):
+                raise RuntimeError(
+                    "reshard migration payload failed the loopback "
+                    "round-trip verify")
+            return {"multi": False}, moved
+        # multi-host: ship only the rows LEAVING this process's shards
+        from paddlebox_tpu.parallel.host_plane import KvChannel
+
+        pid = jax.process_index()
+        mo = self._proc_of((mk % np.uint64(old_n)).astype(np.int64), old_n)
+        mn = self._proc_of((mk % np.uint64(new_n)).astype(np.int64), new_n)
+        om = (mo == pid) & (mn != pid)
+        _RESHARD_CHANNEL_SEQ[0] += 1
+        ch = KvChannel(f"reshard-{_RESHARD_CHANNEL_SEQ[0]}")
+        try:
+            payloads = ch.gather_bytes(_encode_migration(mk[om], mrows[om]))
+        finally:
+            ch.close()
+        in_keys, in_rows = [], []
+        for p, buf in enumerate(payloads):
+            if p == pid:
+                continue
+            k, v = _decode_migration(buf)
+            mine = self._proc_of(
+                (k % np.uint64(new_n)).astype(np.int64), new_n
+            ) == pid
+            if mine.any():
+                in_keys.append(k[mine])
+                in_rows.append(v[mine])
+        staged = {
+            "multi": True,
+            "drop_keys": mk[om],
+            "in_keys": (np.concatenate(in_keys) if in_keys
+                        else np.empty(0, np.uint64)),
+            "in_rows": (np.concatenate(in_rows) if in_rows
+                        else np.empty((0, rows.shape[1]), np.float32)),
+        }
+        return staged, moved
+
+    def _reshard_cutover(self, new_mesh: Mesh, staged: dict) -> None:
+        """Commit the new shard map.  The fault site fires BEFORE any
+        mutation, so an injected cutover failure aborts with the old map
+        fully intact (the chaos contract tests pin).  Dirty cache rows
+        were drained by the flush() at the cut point and no pass ran
+        since, so dropping the per-shard caches here loses nothing; the
+        planner's frequency evidence is carried into the rebuilt census
+        exchange so the hot set stays warm."""
+        from paddlebox_tpu.utils import faults
+
+        faults.inject("reshard.cutover")
+        if staged.get("multi"):
+            # ownership commit: merge rows that moved to this process,
+            # rebuild the store without the rows that left
+            if staged["in_keys"].shape[0]:
+                self._store.update(staged["in_keys"], staged["in_rows"])
+            if staged["drop_keys"].shape[0]:
+                keys, rows = self._store.materialize()
+                keep = ~np.isin(keys, staged["drop_keys"])
+                self._store.clear()
+                self._store.load_bulk(keys[keep], rows[keep])
+        # carry the planner's evidence before the census objects go
+        if self._census is not None and self._census.planner is not None:
+            self._carry_freq = self._census.planner.evidence()
+        ch, self._census_channel = self._census_channel, None
+        self._census = None
+        if ch is not None:
+            ch.close()
+        self.mesh = new_mesh
+        self.n_shards = int(new_mesh.shape[DATA_AXIS])
+        self._local_pos = self._checked_local_pos(new_mesh)
+        # per-shard caches are keyed to the old split: drop and let
+        # _caches() rebuild for the new shard count (re-seeded from the
+        # next passes' censuses + the carried frequency evidence)
+        self._shard_cache_list = []
+        self._cache_tried = False
+        self._cache_plans = None
+        self._shard_keys = None
+        # serve-scratch sizing learned under the old split is stale
+        self._last_serve_n = 0
+
+    def _reshard_abort(self, old: dict) -> None:
+        """Restore the old shard map on ANY failed branch: every field
+        cutover swaps goes back to the snapshot references (which were
+        never mutated — migrate stages, cutover commits)."""
+        self.mesh = old["mesh"]
+        self.n_shards = old["n_shards"]
+        self._local_pos = old["local_pos"]
+        self._shard_cache_list = old["caches"]
+        self._cache_tried = old["cache_tried"]
+        self._census = old["census"]
+        self._census_channel = old["census_channel"]
+        self._last_serve_n = old["last_serve_n"]
+        self._carry_freq = old["carry_freq"]
+        self._cache_plans = None
 
     # -- pass lifecycle --------------------------------------------------- #
     def _shard_split(self, pk: np.ndarray):

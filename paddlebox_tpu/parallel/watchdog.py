@@ -184,6 +184,13 @@ class PeerTracker:
                 out.append((rank, age, progress, stage))
         return out
 
+    def deregister(self, rank: int) -> None:
+        """Deliberate membership shrink (PR 16: a drained/retired rank
+        leaves the fleet on purpose).  Forget the rank entirely — its
+        frozen progress counter is expected, not a stall, and it must
+        never be named a culprit by :meth:`stale` again.  Idempotent."""
+        self._seen.pop(rank, None)
+
 
 # --------------------------------------------------------------------------- #
 # KV transports
@@ -315,6 +322,9 @@ class Watchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._unhook: Optional[Callable[[], None]] = None
+        # deliberately-retired peers (elastic shrink): skipped by the
+        # peer sweep, ignored as poison culprits
+        self._retired: set = set()
         # the local process starts tracked from construction time: a run
         # that never reports ANY stage is itself a stall (stage "start")
         self._tracker.observe(self.rank, 0, "start", self._clock())
@@ -438,15 +448,61 @@ class Watchdog:
         except Exception:
             logger.exception("watchdog: heartbeat publish failed")
 
+    def retire_peer(self, rank: int) -> None:
+        """Deliberate membership shrink: ``rank`` drained and left the
+        fleet on purpose.  Deregister it from staleness tracking (its
+        frozen heartbeat is EXPECTED — it must never be named a stall
+        culprit), prune its liveness gauges, and best-effort delete its
+        heartbeat key (a retired rank killed mid-drain can't clean up
+        after itself).  A poison payload naming a retired culprit is
+        ignored by :meth:`_check_poison`.  Idempotent."""
+        rank = int(rank)
+        if rank == self.rank:
+            raise ValueError("a watchdog cannot retire its own rank")
+        with self._lock:
+            self._retired.add(rank)
+        self._tracker.deregister(rank)
+        _STALENESS.remove(rank=str(rank))
+        _PROGRESS.remove(rank=str(rank))
+        prev = self._exported_stage.pop(rank, None)
+        if prev is not None:
+            _STAGE.remove(rank=str(rank), stage=prev)
+        if self.kv is not None:
+            try:
+                self.kv.delete(self._hb_key(rank))
+            except Exception:
+                logger.debug("retired peer %d heartbeat cleanup failed",
+                             rank, exc_info=True)
+        stats.add("watchdog.peers_retired")
+        logger.info("watchdog: rank %d retired from liveness tracking "
+                    "(deliberate membership shrink)", rank)
+
+    def _is_retired(self, rank: int) -> bool:
+        with self._lock:
+            return rank in self._retired
+
     def _check_poison(self, now: float) -> bool:
         if self.kv is None:
             return False
         raw = self.kv.get(self.poison_key)
         if raw is None:
             return False
-        self.abort(
-            DistributedStallError.from_payload(raw, self.rank), poison=False
-        )
+        err = DistributedStallError.from_payload(raw, self.rank)
+        if self._is_retired(err.culprit):
+            # a racing detector named a peer that was deliberately
+            # retired (it saw the drain, not a stall): this poison is
+            # stale — drop it so the fleet doesn't converge on a
+            # non-error, and best-effort clear the key
+            stats.add("watchdog.poison_retired_ignored")
+            logger.warning(
+                "watchdog: ignoring poison naming retired rank %d",
+                err.culprit)
+            try:
+                self.kv.delete(self.poison_key)
+            except Exception:
+                logger.debug("stale poison cleanup failed", exc_info=True)
+            return False
+        self.abort(err, poison=False)
         return True
 
     def _check_local(self, now: float) -> bool:
@@ -467,7 +523,7 @@ class Watchdog:
         if self.kv is None:
             return False
         for r in range(self.world):
-            if r == self.rank:
+            if r == self.rank or self._is_retired(r):
                 continue
             raw = self.kv.get(self._hb_key(r))
             if raw is None:
@@ -485,8 +541,8 @@ class Watchdog:
         for rank, age, progress, stage in self._tracker.stale(
             now, self.conf.deadline_s
         ):
-            if rank == self.rank:
-                continue  # local check already covers us
+            if rank == self.rank or self._is_retired(rank):
+                continue  # local check covers us; retired is deliberate
             self.abort(
                 DistributedStallError(
                     culprit=rank, stage=stage, kind="peer", age_s=age,

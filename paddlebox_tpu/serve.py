@@ -27,7 +27,10 @@ spreads /score traffic over them with health-checked membership,
 per-request failover and crash restarts — a killed replica is never
 client-visible.  Router endpoints: POST /score[/NAME], GET /healthz
 (fleet summary), GET /fleet (per-replica state + freshness), GET
-/metrics.
+/metrics.  --autoscale adds the FleetAutoscaler: replicas spawn under
+sustained pressure and drain-retire when idle, clamped to
+PBOX_AUTOSCALE_MIN_REPLICAS / PBOX_AUTOSCALE_MAX_REPLICAS (--replicas
+is the floor).
 
 Admission control (--max-queue / --request-deadline-ms, env
 PBOX_SERVE_MAX_QUEUE / PBOX_REQUEST_DEADLINE_MS) bounds every replica's
@@ -43,6 +46,7 @@ whole of it as one module over the StableHLO artifact.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import sys
 
@@ -104,6 +108,12 @@ def _build_parser() -> argparse.ArgumentParser:
                          "queue never waits)")
     ap.add_argument("--log-dir", default=None,
                     help="fleet mode: write per-replica logs here")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="fleet mode: run the FleetAutoscaler — grow/"
+                         "drain-retire replicas off the fleet's own "
+                         "telemetry, clamped to the "
+                         "PBOX_AUTOSCALE_MIN_REPLICAS / "
+                         "PBOX_AUTOSCALE_MAX_REPLICAS band")
     return ap
 
 
@@ -158,14 +168,32 @@ def _main_fleet(args) -> None:
     supervisor.start()
     router = FleetRouter(supervisor.endpoints())
     port = router.start(port=args.router_port, host=args.host)
+    autoscaler = None
+    if args.autoscale:
+        from paddlebox_tpu.serving_fleet import (
+            AutoscalerConfig, FleetAutoscaler,
+        )
+
+        conf = AutoscalerConfig.from_flags()
+        # the operator-chosen --replicas is the floor: autoscaling may
+        # only ever ADD capacity beyond what was explicitly requested
+        conf = dataclasses.replace(
+            conf, min_replicas=max(conf.min_replicas, args.replicas),
+            max_replicas=max(conf.max_replicas, args.replicas),
+        )
+        autoscaler = FleetAutoscaler(supervisor, router, conf)
+        autoscaler.start()
     print(f"fleet router on http://{args.host}:{port}/score "
           f"({args.replicas} replicas: "
-          f"{', '.join(supervisor.endpoints())})", flush=True)
+          f"{', '.join(supervisor.endpoints())}"
+          f"{', autoscaling' if autoscaler else ''})", flush=True)
     try:
         router.wait()
     except KeyboardInterrupt:
         pass
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         router.stop()
         supervisor.stop()
 

@@ -137,6 +137,7 @@ class ReplicaHandle:
             "last_error": self.last_error,
             "degraded_reasons": self.health.get("degraded_reasons") or {},
             "queue_depth": self.health.get("queue_depth"),
+            "estimated_wait_s": self.health.get("estimated_wait_s"),
             "models": {
                 n: {"seq": m.get("seq"), "age_seconds": m.get("age_seconds"),
                     "lineage": m.get("lineage"),
@@ -203,6 +204,8 @@ class FleetRouter:
     # -- state machine ------------------------------------------------------- #
     def _note_failure(self, r: ReplicaHandle, err: str) -> None:
         with self._lock:
+            if r not in self.replicas:
+                return  # removed mid-probe: don't resurrect its gauge
             r.consecutive_ok = 0
             r.consecutive_failures += 1
             r.last_error = err[:200]
@@ -225,6 +228,8 @@ class FleetRouter:
             if ages and min(ages) > self.degraded_max_age_s:
                 degraded = True
         with self._lock:
+            if r not in self.replicas:
+                return  # removed mid-probe: don't resurrect its gauge
             r.consecutive_failures = 0
             r.consecutive_ok += 1
             r.last_error = None
@@ -244,11 +249,44 @@ class FleetRouter:
     def _export_state(self, r: ReplicaHandle) -> None:
         _REPLICA_STATE.set(_STATE_CODE[r.state], replica=r.addr)
 
+    # -- dynamic membership (PR 16: elastic fleet) ---------------------------- #
+    def add_replica(self, addr: str) -> ReplicaHandle:
+        """Admit a freshly spawned replica into the routing set.  It
+        starts EJECTED (unproven) with the same never-failed recovery
+        seed as construction-time replicas: one clean probe admits it.
+        Idempotent on address."""
+        addr = addr if ":" in addr else f"127.0.0.1:{addr}"
+        with self._lock:
+            for r in self.replicas:
+                if r.addr == addr:
+                    return r
+            r = ReplicaHandle(addr)
+            r.consecutive_ok = max(0, self.recover_after - 1)
+            self.replicas.append(r)
+            self._export_state(r)
+        logger.info("fleet: replica %s joined the routing set", addr)
+        return r
+
+    def remove_replica(self, addr: str) -> None:
+        """Eject a replica from the routing set for good (drain-retire:
+        the caller stops the process AFTER removal, so no new request is
+        ever routed to a dying replica).  Clears its per-replica gauge
+        label so a retired address doesn't linger in /metrics."""
+        addr = addr if ":" in addr else f"127.0.0.1:{addr}"
+        with self._lock:
+            self.replicas = [r for r in self.replicas if r.addr != addr]
+        _REPLICA_STATE.remove(replica=addr)
+        logger.info("fleet: replica %s left the routing set", addr)
+
+    def _snapshot(self) -> List[ReplicaHandle]:
+        with self._lock:
+            return list(self.replicas)
+
     # -- probing ------------------------------------------------------------- #
     def probe_once(self) -> None:
         """One health sweep over every replica (ejected ones included —
         that IS the half-open recovery probe)."""
-        for r in self.replicas:
+        for r in self._snapshot():
             r.last_probe_at = time.monotonic()
             try:
                 faults.inject("fleet.probe")
@@ -416,7 +454,7 @@ class FleetRouter:
                                outcome="no_replica")
         return 503, json.dumps({
             "error": "no serving-capable replica",
-            "replicas": {r.addr: r.state for r in self.replicas},
+            "replicas": {r.addr: r.state for r in self._snapshot()},
         }).encode(), {"Content-Type": "application/json"}
 
     def route_candidates(self) -> List[ReplicaHandle]:
@@ -427,7 +465,7 @@ class FleetRouter:
         """The operator/freshness view: every replica's state, error,
         queue depth and per-model (seq, age) — convergence of ``seq``
         across replicas is the fleet-level freshness statement."""
-        replicas = [r.view() for r in self.replicas]
+        replicas = [r.view() for r in self._snapshot()]
         serving = [r for r in replicas if r["state"] != EJECTED]
         return {
             "ok": bool(serving),

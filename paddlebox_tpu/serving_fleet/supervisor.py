@@ -17,10 +17,21 @@ site ``fleet.restart`` so chaos plans can make restarts themselves fail
 (the attempt is counted and retried on the next babysit tick with a
 deeper delay).  Counter: ``fleet.restarts``.
 
-The supervisor owns the port plan: each replica gets a fixed local port
-at construction time (so the router's membership is stable across
-restarts — a respawned replica comes back at the SAME address and the
-router's half-open probes readmit it).
+The supervisor owns the port plan: each replica's port is bind-probed
+(``find_free_port``) when the replica first joins — at construction for
+the initial fleet, at :meth:`spawn_replica` time for autoscaled ones —
+and then pinned for that replica's lifetime (so the router's membership
+is stable across CRASH restarts: a respawned replica comes back at the
+SAME address and the router's half-open probes readmit it).  A retired
+replica's port goes back to the OS pool; a later spawn may legitimately
+probe it again.
+
+Elastic-fleet surface (PR 16): :meth:`spawn_replica` grows the fleet by
+one (fresh id, fresh bind-probed port), :meth:`retire_replica` shrinks
+it deliberately — SIGTERM (the replica's graceful stop/drain path),
+wait, escalate to SIGKILL past the deadline — and marks the replica
+``retired`` so the babysitter NEVER resurrects it: a deliberate
+retirement must not look like a crash to the restart loop.
 """
 
 from __future__ import annotations
@@ -47,6 +58,12 @@ _RESTART_FAILURES = telemetry.counter(
     "fleet.restart_failures",
     help="replica respawn attempts that themselves failed",
 )
+_SPAWNS = telemetry.counter(
+    "fleet.spawns", help="replicas added to the fleet after start"
+)
+_RETIRES = telemetry.counter(
+    "fleet.retires", help="replicas deliberately retired from the fleet"
+)
 
 
 def find_free_port() -> int:
@@ -67,6 +84,7 @@ class ReplicaProc:
     crash_streak: int = 0  # consecutive crashes (resets when stable)
     started_at: float = 0.0
     next_restart_at: float = 0.0  # monotonic; 0 = not pending
+    retired: bool = False  # deliberately removed; babysitter must not respawn
 
     @property
     def pid(self) -> Optional[int]:
@@ -125,7 +143,10 @@ class ReplicaSupervisor:
 
     # -- lifecycle ----------------------------------------------------------- #
     def endpoints(self) -> List[str]:
-        return [f"{self.host}:{r.port}" for r in self.replicas]
+        """Addresses of the current (non-retired) fleet membership."""
+        with self._lock:
+            return [f"{self.host}:{r.port}"
+                    for r in self.replicas if not r.retired]
 
     def _spawn(self, r: ReplicaProc) -> None:
         argv = self.argv_for(r.replica_id, r.port)
@@ -173,6 +194,10 @@ class ReplicaSupervisor:
         now = time.monotonic()
         with self._lock:
             for r in self.replicas:
+                if r.retired:
+                    # deliberate retirement is not a crash: the babysitter
+                    # must never resurrect a drained replica
+                    continue
                 if r.alive():
                     if r.crash_streak and \
                             now - r.started_at >= self.stable_after_s:
@@ -256,11 +281,75 @@ class ReplicaSupervisor:
         with self._lock:
             return sum(r.restarts for r in self.replicas)
 
+    # -- elastic membership (PR 16) ------------------------------------------ #
+    def live_replica_ids(self) -> List[int]:
+        """replica_ids still part of the fleet (spawn order preserved)."""
+        with self._lock:
+            return [r.replica_id for r in self.replicas if not r.retired]
+
+    def spawn_replica(self) -> str:
+        """Grow the fleet by one replica: next replica_id, fresh
+        bind-probed port (NOT a static offset — under churn the next
+        offset may be taken by anything, including a previously retired
+        replica's reused port).  Returns the new replica's address.
+
+        Fault site ``fleet.scale`` fires before the spawn so chaos plans
+        can make scale-up itself fail; on failure nothing joins the
+        fleet (the ReplicaProc is only appended after a clean spawn).
+        """
+        faults.inject("fleet.scale")
+        port = find_free_port()
+        with self._lock:
+            r = ReplicaProc(replica_id=len(self.replicas), port=port)
+            # pbox-lint: ignore[lock-held-blocking] spawn cost is bounded
+            # (log open + fork); membership changes are serialized against
+            # the babysitter by design
+            self._spawn(r)
+            self.replicas.append(r)
+        _SPAWNS.inc()
+        logger.info("fleet: scaled up — replica %d at %s:%d",
+                    r.replica_id, self.host, r.port)
+        return f"{self.host}:{r.port}"
+
+    def retire_replica(self, replica_id: int,
+                       timeout_s: float = 10.0) -> None:
+        """Deliberately remove one replica: mark it retired FIRST (so a
+        concurrent babysit tick cannot mistake the exit for a crash),
+        then SIGTERM — the replica's own graceful stop: drain in-flight,
+        then exit — and escalate to SIGKILL past the deadline.  The
+        port returns to the OS pool; a later :meth:`spawn_replica` may
+        legitimately bind-probe it again.  Idempotent."""
+        with self._lock:
+            r = self.replicas[replica_id]
+            if r.retired:
+                return
+            r.retired = True
+            r.next_restart_at = 0.0
+            proc = r.proc if r.alive() else None
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                logger.warning(
+                    "fleet: replica %d ignored SIGTERM for %.1fs; killing",
+                    replica_id, timeout_s)
+                proc.kill()
+                proc.wait(timeout=timeout_s)
+        f = self._logs.pop(replica_id, None)
+        if f is not None:
+            f.close()
+        _RETIRES.inc()
+        logger.info("fleet: retired replica %d (port %d freed)",
+                    replica_id, r.port)
+
     def kill_replica(self, replica_id: int,
                      sig: int = signal.SIGKILL) -> int:
         """Chaos hook: signal one replica (default SIGKILL).  Returns the
         pid signalled.  The babysitter restarts it like any crash."""
         r = self.replicas[replica_id]
+        if r.retired:
+            raise RuntimeError(f"replica {replica_id} is retired")
         pid = r.pid
         if pid is None:
             raise RuntimeError(f"replica {replica_id} has no process")

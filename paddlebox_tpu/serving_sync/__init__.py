@@ -41,4 +41,7 @@ from paddlebox_tpu.serving_sync.registry import (  # noqa: F401
     PublishEntry,
     parse_donefile,
 )
-from paddlebox_tpu.serving_sync.syncer import Syncer  # noqa: F401
+from paddlebox_tpu.serving_sync.syncer import (  # noqa: F401
+    Syncer,
+    fleet_min_freshness,
+)

@@ -547,3 +547,33 @@ class Syncer:
                     return True
             time.sleep(min(1.0, self.poll_interval_s))
         return self.registry.current(self.name) is not None
+
+
+def fleet_min_freshness(view: dict) -> dict:
+    """Fleet-level freshness from a router ``fleet_view()`` payload: the
+    minimum applied seq and maximum model age across SERVING (non-
+    ejected) replicas — the number a rolling restart must hold above the
+    staleness deadline before taking the next replica down.  Lives here,
+    next to the Syncer that defines per-replica freshness, so the
+    semantics cannot drift from the thing they aggregate.
+
+    Returns ``{"min_seq", "max_age_seconds", "n_serving"}`` with None
+    seq/age when no serving replica reports a model (a fleet of zero
+    serving replicas is maximally stale — the caller must treat None as
+    failing the freshness gate, not passing it)."""
+    min_seq: Optional[int] = None
+    max_age: Optional[float] = None
+    n_serving = 0
+    for r in view.get("replicas", []):
+        if r.get("state") == "ejected":
+            continue
+        n_serving += 1
+        for m in (r.get("models") or {}).values():
+            seq = m.get("seq")
+            age = m.get("age_seconds")
+            if seq is not None:
+                min_seq = seq if min_seq is None else min(min_seq, seq)
+            if age is not None:
+                max_age = age if max_age is None else max(max_age, age)
+    return {"min_seq": min_seq, "max_age_seconds": max_age,
+            "n_serving": n_serving}

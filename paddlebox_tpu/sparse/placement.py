@@ -122,6 +122,25 @@ class PlacementPlanner:
         """The current plan (stable across calls until update_plan)."""
         return self._plan
 
+    def frequencies(self, keys: np.ndarray) -> np.ndarray:
+        """Tracked frequency for each queried key (0.0 when unseen) — the
+        reshard migration orders moved rows hottest-first off this, so
+        the keys most likely to be needed next pass land first."""
+        q = np.asarray(keys, dtype=np.uint64)
+        out = np.zeros(q.shape[0], dtype=np.float64)
+        if self._keys.shape[0] and q.shape[0]:
+            pos = np.searchsorted(self._keys, q)
+            pos_c = np.minimum(pos, self._keys.shape[0] - 1)
+            found = self._keys[pos_c] == q
+            out[found] = self._freq[pos_c[found]]
+        return out
+
+    def evidence(self) -> tuple:
+        """(keys, freq) snapshot of the whole tracker — carried across a
+        reshard cutover so the rebuilt planner starts warm instead of
+        relearning the hot set from scratch."""
+        return self._keys.copy(), self._freq.copy()
+
     # -- frequency feeding ------------------------------------------------ #
     def seed(self, keys: np.ndarray, freq: np.ndarray) -> None:
         """Merge external frequency evidence — the HbmCache LFU directory

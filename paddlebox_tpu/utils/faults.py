@@ -88,6 +88,18 @@ KNOWN_SITES = {
     # next candidate) and the supervisor's crashed-replica respawn
     # (failure => retried on the next babysit tick with deeper backoff)
     "fleet.probe", "fleet.route", "fleet.restart",
+    # elastic fleet (serving_fleet/autoscaler + supervisor): the
+    # autoscaler's spawn of a new replica (failure => nothing joins the
+    # fleet; retried after cooldown) and the drain-retire wait (a hang:
+    # spec wedges the drain poll — the watchdog's hang interrupt raises
+    # out and the retirement/roll proceeds past the wedged replica)
+    "fleet.scale", "fleet.drain",
+    # live resharding (parallel/sharded_table.reshard): the host-plane
+    # key migration (failure => reshard aborts cleanly back to the old
+    # shard map, no partial cutover) and the cutover commit itself
+    # (failure after migration => same abort: the old map is restored
+    # and the migrated payloads discarded)
+    "reshard.migrate", "reshard.cutover",
     # streaming online learning (streaming/): the tail source's poll
     # (failure => counted + retried next poll; a hang wedges the feed and
     # the watchdog's `feed` stage must catch it), the mini-pass window cut

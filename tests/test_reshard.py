@@ -1,0 +1,297 @@
+"""Live resharding of ShardedSparseTable (PR 16: elastic fleet).
+
+The contract pinned here is the one reshard()'s docstring promises:
+growing or shrinking the shard count at a pass boundary is bit-identical
+— keys, values, g2sum, AUC — to tearing the table down and rebuilding it
+at the new shard count from a checkpoint.  On top of the equality pin:
+steady-state stages stay ZERO-retrace once post-cutover warmup settles,
+a checkpoint saved mid-roll restores onto the new shard count, and an
+injected migrate/cutover failure aborts cleanly back to the old shard
+map (the reshard half of the PR-16 chaos contract; the fleet half lives
+in tests/test_elastic_fleet.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddlebox_tpu import telemetry
+from paddlebox_tpu.checkpoint import CheckpointManager
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models.ctr_dnn import CtrDnn
+from paddlebox_tpu.parallel import (
+    MultiChipTrainer,
+    ShardedSparseTable,
+    make_mesh,
+)
+from paddlebox_tpu.parallel.sharded_table import (
+    _decode_migration,
+    _encode_migration,
+)
+from paddlebox_tpu.telemetry import compiles
+from paddlebox_tpu.utils.faults import FaultInjected, fault_plan
+
+S, DENSE = 3, 2
+N_INS, B = 128, 8  # 16 per-device batches: divisible by 2 AND 4 devices
+
+
+@pytest.fixture(scope="module")
+def mesh2():
+    assert len(jax.devices()) >= 4, "conftest must force 8 CPU devices"
+    return make_mesh(2)
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    return make_mesh(4)
+
+
+def _data(tmp_path, sub="d"):
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+        max_feasigns_per_ins=16,
+    )
+    files = write_synth_files(
+        str(tmp_path / sub), n_files=2, ins_per_file=N_INS // 2,
+        n_sparse_slots=S, vocab_per_slot=50, dense_dim=DENSE, seed=7,
+    )
+    ds = PadBoxSlotDataset(conf, read_threads=2)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    return ds
+
+
+def _trainer(tconf, mesh, seed=3, **tkw):
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(16,))
+    return MultiChipTrainer(
+        model, tconf, mesh, TrainerConfig(auc_buckets=1 << 10, **tkw),
+        seed=seed,
+    )
+
+
+def _run_pass(trainer, table, ds):
+    table.begin_pass(ds.unique_keys())
+    m = trainer.train_from_dataset(ds, table)
+    table.end_pass()
+    return m
+
+
+# --------------------------------------------------------------------------- #
+# migration payload framing (the PBR1 wire format)
+# --------------------------------------------------------------------------- #
+class TestMigrationCodec:
+    def test_round_trip_preserves_hottest_first_order(self):
+        keys = np.array([901, 3, 77, 41, 500], dtype=np.uint64)  # unsorted
+        rows = np.arange(5 * 6, dtype=np.float32).reshape(5, 6) * 0.25
+        dk, dr = _decode_migration(_encode_migration(keys, rows))
+        np.testing.assert_array_equal(dk, keys)
+        np.testing.assert_array_equal(dr, rows)
+
+    def test_empty_payload_round_trips(self):
+        dk, dr = _decode_migration(_encode_migration(
+            np.empty(0, np.uint64), np.empty((0, 5), np.float32)
+        ))
+        assert dk.shape == (0,) and dr.shape == (0, 5)
+
+    def test_bad_magic_raises(self):
+        buf = bytearray(_encode_migration(
+            np.array([1, 2], dtype=np.uint64),
+            np.zeros((2, 3), np.float32),
+        ))
+        buf[:4] = b"XXXX"
+        with pytest.raises(ValueError, match="magic"):
+            _decode_migration(bytes(buf))
+
+    def test_truncated_payload_raises(self):
+        buf = _encode_migration(
+            np.array([1, 2], dtype=np.uint64), np.zeros((2, 3), np.float32)
+        )
+        with pytest.raises(ValueError):
+            _decode_migration(buf + b"\x00\x00\x00\x00")
+
+
+# --------------------------------------------------------------------------- #
+# lifecycle guards
+# --------------------------------------------------------------------------- #
+class TestReshardGuards:
+    def test_reshard_inside_pass_refused_then_works(self, mesh2, mesh4):
+        tconf = SparseTableConfig(embedding_dim=4)
+        table = ShardedSparseTable(tconf, mesh2, seed=0)
+        table.begin_pass(np.arange(1, 60, dtype=np.uint64))
+        with pytest.raises(RuntimeError, match="between passes"):
+            table.reshard(mesh4)
+        table.end_pass()
+        # the refusal left the table healthy: the boundary call works
+        assert table.reshard(mesh4) > 0
+        assert table.n_shards == 4
+        table.close()
+
+    def test_same_mesh_reshard_is_a_no_op(self, mesh2):
+        tconf = SparseTableConfig(embedding_dim=4)
+        table = ShardedSparseTable(tconf, mesh2, seed=0)
+        table.begin_pass(np.arange(1, 40, dtype=np.uint64))
+        table.end_pass()
+        assert table.reshard(mesh2) == 0
+        assert table.n_shards == 2
+        table.close()
+
+
+# --------------------------------------------------------------------------- #
+# the PR-16 equality pin: live reshard == teardown-and-rebuild
+# --------------------------------------------------------------------------- #
+def _live_vs_rebuilt(tmp_path, mesh_old, mesh_new):
+    """Pass 1 on the old split, then pass 2 on the new split — once via
+    live reshard, once via state_dict -> fresh table at the new shard
+    count.  Everything downstream must be bit-exact."""
+    tconf = SparseTableConfig(embedding_dim=8)
+    ds = _data(tmp_path)
+
+    live = ShardedSparseTable(tconf, mesh_old, seed=5)
+    _run_pass(_trainer(tconf, mesh_old), live, ds)
+    moved = live.reshard(mesh_new)
+    assert moved > 0, "growing/shrinking the split must move owners"
+    m_live = _run_pass(_trainer(tconf, mesh_new), live, ds)
+
+    base = ShardedSparseTable(tconf, mesh_old, seed=5)
+    _run_pass(_trainer(tconf, mesh_old), base, ds)
+    rebuilt = ShardedSparseTable(tconf, mesh_new, seed=5)
+    rebuilt.load_state_dict(base.state_dict())
+    m_base = _run_pass(_trainer(tconf, mesh_new), rebuilt, ds)
+
+    s_live, s_base = live.state_dict(), rebuilt.state_dict()
+    np.testing.assert_array_equal(s_live["keys"], s_base["keys"])
+    # full-row equality: embeds AND the g2sum column (last) — bit-exact
+    np.testing.assert_array_equal(s_live["values"], s_base["values"])
+    np.testing.assert_array_equal(
+        s_live["values"][:, -1], s_base["values"][:, -1]
+    )
+    assert m_live["steps"] == m_base["steps"] > 0
+    assert m_live["loss"] == m_base["loss"]
+    assert m_live["auc"] == m_base["auc"]
+    for t in (live, base, rebuilt):
+        t.close()
+    ds.close()
+
+
+class TestReshardBitExact:
+    def test_grow_2_to_4(self, tmp_path, mesh2, mesh4):
+        _live_vs_rebuilt(tmp_path, mesh2, mesh4)
+
+    def test_shrink_4_to_2(self, tmp_path, mesh2, mesh4):
+        _live_vs_rebuilt(tmp_path, mesh4, mesh2)
+
+
+# --------------------------------------------------------------------------- #
+# steady state after cutover: zero retrace
+# --------------------------------------------------------------------------- #
+def _counts():
+    return compiles.compiles_by_stage()
+
+
+def _delta(before, after):
+    out = {}
+    for stage, n in after.items():
+        d = n - before.get(stage, 0)
+        if d:
+            out[stage] = d
+    return out
+
+
+def test_zero_retrace_after_cutover(tmp_path, mesh2, mesh4):
+    """Two passes after the cutover settle the new split's shapes
+    (compile + capacity-fit recompile); the third must not move
+    jit.compiles for ANY stage."""
+    tconf = SparseTableConfig(embedding_dim=8)
+    ds = _data(tmp_path)
+    table = ShardedSparseTable(tconf, mesh2, seed=5, bucket_slack=8.0)
+    _run_pass(_trainer(tconf, mesh2), table, ds)
+    assert table.reshard(mesh4) > 0
+    tr = _trainer(tconf, mesh4)
+    _run_pass(tr, table, ds)  # warmup: first compile on the new split
+    _run_pass(tr, table, ds)  # capacity-fit recompile settles
+    before = _counts()
+    _run_pass(tr, table, ds)
+    assert not _delta(before, _counts()), \
+        "steady-state pass after cutover must be zero-retrace"
+    table.close()
+    ds.close()
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint saved mid-roll restores on the new shard count
+# --------------------------------------------------------------------------- #
+def test_checkpoint_mid_roll_restores_on_new_shard_count(
+    tmp_path, mesh2, mesh4
+):
+    tconf = SparseTableConfig(embedding_dim=8)
+    ds = _data(tmp_path)
+    table = ShardedSparseTable(tconf, mesh2, seed=5)
+    _run_pass(_trainer(tconf, mesh2), table, ds)
+    assert table.reshard(mesh4) > 0
+    tr = _trainer(tconf, mesh4)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    p, o = tr.dense_state()
+    mgr.save_base("midroll", table, p, o)
+
+    # the restore world starts DIRECTLY on the new shard count
+    table2 = ShardedSparseTable(tconf, mesh4, seed=5)
+    tr2 = _trainer(tconf, mesh4)
+    p2, o2, _ = mgr.load(table2, *tr2.dense_state())
+    tr2.load_dense_state(p2, o2)
+
+    s1, s2 = table.state_dict(), table2.state_dict()
+    np.testing.assert_array_equal(s1["keys"], s2["keys"])
+    np.testing.assert_array_equal(s1["values"], s2["values"])
+    # and the restored world trains on at the new split
+    m = _run_pass(tr2, table2, ds)
+    assert m["steps"] > 0 and np.isfinite(m["loss"])
+    table.close()
+    table2.close()
+    ds.close()
+
+
+# --------------------------------------------------------------------------- #
+# chaos: injected failures abort back to the old shard map
+# --------------------------------------------------------------------------- #
+def _assert_abort_clean(tmp_path, site, mesh_old, mesh_new):
+    tconf = SparseTableConfig(embedding_dim=8)
+    ds = _data(tmp_path)
+    table = ShardedSparseTable(tconf, mesh_old, seed=5)
+    tr_old = _trainer(tconf, mesh_old)
+    _run_pass(tr_old, table, ds)
+    old_n = table.n_shards
+    before_sd = table.state_dict()
+    aborts0 = telemetry.counter("reshard.aborts").value()
+
+    with fault_plan({site: "first:1"}):
+        with pytest.raises(FaultInjected):
+            table.reshard(mesh_new)
+
+    # old shard map fully intact: count, mesh, every row
+    assert table.n_shards == old_n
+    assert table.mesh is mesh_old
+    assert telemetry.counter("reshard.aborts").value() == aborts0 + 1
+    after_sd = table.state_dict()
+    np.testing.assert_array_equal(before_sd["keys"], after_sd["keys"])
+    np.testing.assert_array_equal(before_sd["values"], after_sd["values"])
+
+    # training continues on the old map as if nothing happened...
+    m = _run_pass(tr_old, table, ds)
+    assert m["steps"] > 0 and np.isfinite(m["loss"])
+    # ...and a later retry (fault cleared) commits
+    assert table.reshard(mesh_new) > 0
+    m2 = _run_pass(_trainer(tconf, mesh_new), table, ds)
+    assert m2["steps"] > 0 and np.isfinite(m2["loss"])
+    table.close()
+    ds.close()
+
+
+class TestReshardChaos:
+    def test_migrate_fault_aborts_cleanly(self, tmp_path, mesh2, mesh4):
+        _assert_abort_clean(tmp_path, "reshard.migrate", mesh2, mesh4)
+
+    def test_cutover_fault_aborts_cleanly(self, tmp_path, mesh2, mesh4):
+        _assert_abort_clean(tmp_path, "reshard.cutover", mesh2, mesh4)

@@ -157,6 +157,83 @@ def test_poison_payload_roundtrip_and_corruption():
     assert bad.kind == "poison" and bad.culprit == -1
 
 
+# --------------------------------------------------------------------------- #
+# deliberate membership shrink (PR 16: elastic fleet)
+# --------------------------------------------------------------------------- #
+def test_retired_rank_never_named_stall_culprit():
+    """A drained-and-retired rank's frozen heartbeat is EXPECTED: after
+    retire_peer, pushing its staleness arbitrarily past the deadline must
+    not trip anyone's abort latch."""
+    kv = InMemoryKv()
+    wds = _sim_fleet(3, kv)
+    t0 = 100.0
+    for step in range(4):  # everyone healthy first
+        t = t0 + step * 0.1
+        for wd in wds:
+            wd.report("step")
+            assert not wd.tick(now=t)
+    # rank 1 drains out of the fleet on purpose
+    for wd in wds:
+        if wd.rank != 1:
+            wd.retire_peer(1)
+    assert kv.get(wds[0]._hb_key(1)) is None  # heartbeat key pruned
+    # rank 1 frozen forever; survivors keep working far past the deadline
+    for step in range(30):
+        t = t0 + 0.4 + step * 0.1
+        for wd in wds:
+            if wd.rank == 1:
+                continue
+            wd.report("step")
+            assert not wd.tick(now=t)
+    for wd in wds:
+        if wd.rank != 1:
+            assert not wd.aborted
+    assert kv.get(wds[0].poison_key) is None
+
+
+def test_poison_naming_retired_rank_is_ignored_and_cleared():
+    """A racing detector that poisoned the fleet naming a rank that was
+    deliberately retired (it saw the drain, not a stall): readers must
+    drop the stale poison, clear the key, and NOT abort."""
+    kv = InMemoryKv()
+    wds = _sim_fleet(3, kv)
+    wds[0].retire_peer(1)
+    err = DistributedStallError(
+        culprit=1, stage="step", kind="peer", age_s=9.9, progress=3,
+        detected_by=2,
+    )
+    kv.set(wds[0].poison_key, err.to_payload())
+    base = stats.get("watchdog.poison_retired_ignored")
+    wds[0].report("step")
+    assert not wds[0].tick(now=100.1)
+    assert not wds[0].aborted
+    assert kv.get(wds[0].poison_key) is None  # cleared for everyone
+    assert stats.get("watchdog.poison_retired_ignored") == base + 1
+    # a poison naming a NON-retired rank still aborts as before
+    err2 = DistributedStallError(
+        culprit=2, stage="step", kind="peer", age_s=9.9, progress=3,
+        detected_by=0,
+    )
+    kv.set(wds[0].poison_key, err2.to_payload())
+    assert wds[0].tick(now=100.2)
+    assert wds[0].aborted and wds[0].error.culprit == 2
+
+
+def test_retire_peer_is_idempotent_and_guards_own_rank():
+    kv = InMemoryKv()
+    wds = _sim_fleet(2, kv)
+    tr = PeerTracker()
+    tr.observe(1, 0, "step", 0.0)
+    tr.deregister(1)
+    assert tr.age(1, 5.0) is None
+    tr.deregister(1)  # deregistering an unknown rank is a no-op
+    wds[0].retire_peer(1)
+    wds[0].retire_peer(1)  # idempotent
+    assert wds[0]._is_retired(1)
+    with pytest.raises(ValueError):
+        wds[0].retire_peer(0)
+
+
 def test_threaded_fleet_aborts_within_deadline():
     """Real monitor threads + heartbeats: freeze one of two workers and the
     whole simulated fleet aborts within ~2x the deadline, naming it."""

@@ -38,6 +38,7 @@ SPARSE_PASS = ProtocolSpec(
         "shrink": {"idle"},
         "load_state_dict": {"idle"},
         "apply_delta": {"idle"},
+        "reshard": {"idle"},
     },
     end_states=frozenset({"idle"}),
     hints={
@@ -45,6 +46,7 @@ SPARSE_PASS = ProtocolSpec(
         "state_dict": "end_pass() (or abort_pass()) before checkpointing",
         "delta_state_dict": "end_pass() before taking a delta",
         "shrink": "shrink between passes, never inside one",
+        "reshard": "reshard between passes, never inside one",
     },
 )
 
@@ -169,12 +171,50 @@ SPAN_PAIRING = ProtocolSpec(
     },
 )
 
+# --------------------------------------------------------------------------- #
+# 6. Live-reshard ordering (PR 16: flush cut point -> staged migrate ->
+#    cutover commit; abort restores the old map on every branch because
+#    migrate stages without mutating and cutover's fault site fires
+#    before its first mutation)
+# --------------------------------------------------------------------------- #
+RESHARD = ProtocolSpec(
+    rule="protocol-reshard",
+    name="reshard",
+    description=(
+        "live reshard discipline: flush() the pass-boundary cut point, "
+        "stage the migration, only then cutover — never cutover without "
+        "the flush barrier or before the migrate staged"
+    ),
+    states=("idle", "flushed", "migrated", "cut"),
+    initial="idle",
+    scope_ops=True,
+    trigger="_reshard_cutover",
+    transitions={
+        "flush": {"idle": "flushed", "flushed": "flushed"},
+        "_reshard_migrate": {"flushed": "migrated"},
+        "_reshard_cutover": {"migrated": "cut"},
+    },
+    end_states=None,
+    hints={
+        "_reshard_migrate": (
+            "migrate only after flush(): the cut-point barrier is what "
+            "makes the host store truth for every row that moves"
+        ),
+        "_reshard_cutover": (
+            "cutover commits the new shard map: it is only legal after "
+            "the migration staged — a cutover without a staged migrate "
+            "is a partial-state corruption"
+        ),
+    },
+)
+
 PROTOCOLS = [
     SPARSE_PASS,
     STREAM_LIFECYCLE,
     ADMISSION_TICKET,
     PUBLISH_ORDER,
     SPAN_PAIRING,
+    RESHARD,
 ]
 
 # --------------------------------------------------------------------------- #
@@ -199,6 +239,16 @@ OBLIGATIONS = [
                  "load_state_dict", "apply_delta"),
         must_call=("flush",),
         why="same flush barrier as SparseTable, per local shard",
+    ),
+    ImplObligation(
+        cls="ShardedSparseTable",
+        methods=("reshard",),
+        must_call=("flush",),
+        why=(
+            "the reshard cut point IS the flush barrier: dirty HBM-cache "
+            "rows and in-flight write-backs must land before any row's "
+            "ownership moves"
+        ),
     ),
     ImplObligation(
         cls="StreamSource",
