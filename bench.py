@@ -1951,6 +1951,146 @@ def stage_quantized(backend) -> None:
           "vs_baseline": 1.0, "backend": backend, **res})
 
 
+def bench_storage(n_passes: int = 8, embedding_dim: int = 8,
+                  hot_rows: int = 4000, cold_rows: int = 1500) -> list:
+    """Durable-cold-tier storage ablation (ISSUE 17): the same churny
+    training job checkpointed two ways — classic full snapshots
+    (`CheckpointManager.save_base` every pass) vs log-structured
+    incremental generations (`IncrementalCheckpointManager`: one base,
+    then `save_delta` per pass over the keep-history LogStore).  Each arm
+    reports bytes + seconds per checkpoint, restore wall time against the
+    restored row count and the last delta's row count (the bounded-
+    recovery claim: incremental save cost tracks the DELTA, not the
+    table), and the census disk-reject rate — the fraction of absent
+    census keys the table's own durable log rejected from bloom/min-max
+    sidecars alone, without reading a segment."""
+    from paddlebox_tpu.checkpoint import (
+        CheckpointManager,
+        IncrementalCheckpointManager,
+    )
+    from paddlebox_tpu.config import SparseTableConfig
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.utils.monitor import stats
+
+    def du(path: str) -> int:
+        total = 0
+        for dirpath, _, files in os.walk(path):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, f))
+                except OSError:
+                    pass
+        return total
+
+    def pass_keys(p: int) -> np.ndarray:
+        # half the hot set revisits every pass; a disjoint cold slice is
+        # new each pass — so deltas stay small while the table grows
+        rs = np.random.RandomState(1000 + p)
+        hot = rs.choice(hot_rows, size=hot_rows // 2,
+                        replace=False).astype(np.uint64) + 1
+        cold = np.arange(cold_rows, dtype=np.uint64) \
+            + np.uint64(1_000_000 + p * cold_rows)
+        return np.unique(np.concatenate([hot, cold]))
+
+    import jax.numpy as jnp
+
+    rows = []
+    for arm in ("full", "incremental"):
+        with tempfile.TemporaryDirectory() as td:
+            conf = SparseTableConfig(
+                embedding_dim=embedding_dim,
+                overlap_pass_boundary=False, hbm_cache_rows=0,
+                store_log_dir=os.path.join(td, "tlog"),
+                store_log_buckets=4,
+            )
+            t = SparseTable(conf, seed=11)
+            root = os.path.join(td, "ckpt")
+            mgr = (CheckpointManager(root) if arm == "full"
+                   else IncrementalCheckpointManager(root))
+            save_s, bytes_per_save, rows_per_save = [], [], []
+            for p in range(n_passes):
+                t.begin_pass(pass_keys(p))
+                t.values = t.values + 1.0
+                t.end_pass()
+                t.flush()
+                tag = f"pass{p:03d}"
+                pre = du(root)
+                t0 = time.perf_counter()
+                if arm == "full" or p == 0:
+                    mgr.save_base(tag, t)
+                else:
+                    mgr.save_delta(tag, t)
+                save_s.append(time.perf_counter() - t0)
+                bytes_per_save.append(du(root) - pre)
+            ents = (mgr.entries() if arm == "incremental"
+                    else [c.meta for c in mgr.list_checkpoints()])
+            rows_per_save = [int(e["n_sparse_rows"]) for e in ents]
+            # census disk-reject rate, measured AFTER the last save so the
+            # probe keys never pollute a checkpoint
+            absent = np.arange(2_000, dtype=np.uint64) + np.uint64(1 << 40)
+            pre_rej = stats.get("store.census_disk_rejects")
+            t.begin_pass(absent)
+            t.end_pass()
+            reject_rate = (stats.get("store.census_disk_rejects") - pre_rej) \
+                / float(absent.shape[0])
+            final_rows = int(t.state_dict()["keys"].shape[0])
+            t.close()
+
+            conf2 = SparseTableConfig(
+                embedding_dim=embedding_dim,
+                overlap_pass_boundary=False, hbm_cache_rows=0,
+            )
+            t2 = SparseTable(conf2, seed=11)
+            mgr2 = (CheckpointManager(root) if arm == "full"
+                    else IncrementalCheckpointManager(root))
+            upto = f"pass{n_passes - 1:03d}"
+            t0 = time.perf_counter()
+            mgr2.load(t2, upto=upto)
+            restore_s = time.perf_counter() - t0
+            restored_rows = int(t2.state_dict()["keys"].shape[0])
+            t2.close()
+            row = {
+                "arm": arm,
+                "n_passes": n_passes,
+                "final_rows": final_rows,
+                "restored_rows": restored_rows,
+                "ckpt_bytes_total": int(sum(bytes_per_save)),
+                "ckpt_seconds_total": round(sum(save_s), 4),
+                "bytes_last_save": int(bytes_per_save[-1]),
+                # median, because background compaction amortizes across
+                # delta saves and spikes whichever save it rides on
+                "bytes_median_save": int(np.median(bytes_per_save)),
+                "seconds_last_save": round(save_s[-1], 4),
+                "rows_last_save": rows_per_save[-1],
+                "restore_seconds": round(restore_s, 4),
+                "census_disk_reject_rate": round(reject_rate, 4),
+            }
+            rows.append(row)
+            log(f"storage[{arm}]: last save {row['bytes_last_save']:,} B "
+                f"({row['rows_last_save']:,} rows) in "
+                f"{row['seconds_last_save']:.3f}s; restore "
+                f"{row['restored_rows']:,} rows in {restore_s:.3f}s; "
+                f"census disk-reject rate {reject_rate:.2%}")
+    return rows
+
+
+def stage_storage(backend) -> None:
+    rows = bench_storage()
+    by_arm = {r["arm"]: r for r in rows}
+    for r in rows:  # one JSON row per arm, as the issue asks
+        emit({"metric": f"storage_ckpt_{r['arm']}", "unit": "bytes/save",
+              "value": r["bytes_last_save"], "backend": backend, **r})
+    full, incr = by_arm["full"], by_arm["incremental"]
+    emit({"metric": "storage_incremental_ckpt_bytes_ratio",
+          "value": round(incr["ckpt_bytes_total"]
+                         / max(1, full["ckpt_bytes_total"]), 4),
+          "unit": "incr/full total checkpoint bytes",
+          "vs_baseline": round(full["ckpt_bytes_total"]
+                               / max(1, incr["ckpt_bytes_total"]), 2),
+          "backend": backend,
+          "full": full, "incremental": incr})
+
+
 def bench_fleet(n_replicas: int = 3, qps: float = 25.0,
                 duration_s: float = 12.0, kill_at_s: float = 4.0,
                 n_slots: int = 4, dense: int = 4):
@@ -3190,6 +3330,11 @@ def main() -> None:
                     help="quantized embedding artifacts: fp32 vs int8 "
                          "vs fp8 sparse payload bytes + synthetic-CTR "
                          "AUC delta")
+    ap.add_argument("--storage", action="store_true",
+                    help="durable cold tier ablation: full vs incremental "
+                         "checkpoints (bytes+seconds per save, restore "
+                         "time vs table/delta rows, census disk-reject "
+                         "rate); one JSON row per arm")
     ap.add_argument("--streaming", action="store_true",
                     help="streaming online-learning loop: synthetic "
                          "append-rate stream -> StreamingTrainer -> "
@@ -3252,6 +3397,9 @@ def main() -> None:
     elif args.quantized:
         fail_metric = "quantized_artifact_bytes_ratio"
         fail_unit = "int8/fp32 sparse payload bytes"
+    elif args.storage:
+        fail_metric = "storage_incremental_ckpt_bytes_ratio"
+        fail_unit = "incr/full total checkpoint bytes"
     elif args.serving:
         fail_metric = "serving_score_latency"
         fail_unit = "ms p50 (64-instance request)"
@@ -3318,6 +3466,10 @@ def main() -> None:
 
     if args.quantized:
         stage_quantized(backend)
+        return
+
+    if args.storage:
+        stage_storage(backend)
         return
 
     if args.serving:

@@ -446,3 +446,256 @@ class CheckpointManager:
         if opt_template is not None and os.path.exists(opt_p):
             opt_state = load_pytree(opt_p, opt_template)
         return params, opt_state, last.meta
+
+
+# --------------------------------------------------------------------------- #
+# incremental checkpoints over the durable log
+# --------------------------------------------------------------------------- #
+class IncrementalCheckpointManager:
+    """Log-structured checkpoints: the whole sparse history lives in ONE
+    keep-history :class:`~paddlebox_tpu.sparse.logstore.LogStore`, and each
+    checkpoint tag pins a committed manifest *generation* of it plus its
+    (small) dense state.  ``save_delta`` appends only the rows touched
+    since the last save and commits one generation — write cost is the
+    delta, not the table; ``save_base`` rewrites the log compacted (the
+    day-boundary reset).  Restore materializes the tag's generation, so
+    its cost is the bytes that generation references (compacted base + the
+    trailing deltas), never a per-checkpoint full re-export — background
+    compaction is what keeps that bounded as the delta chain grows.
+
+    Directory layout::
+
+        root/
+          sparse-log/           segments + manifest-<gen>.json + CURRENT
+          state-<kind>-<tag>/   dense.npz  opt.npz  meta.json  manifest.json
+          donefile.txt          one json line per completed tag, append-only
+                                and LAST (the crash-consistency commit point)
+
+    Drop-in for :class:`CheckpointManager` on the single-shard surface
+    AutoCheckpointer uses (``save_base`` / ``save_delta`` /
+    ``find_valid_tag`` / ``load``); multi-shard tables keep the classic
+    manager."""
+
+    def __init__(self, root: str, compact_threshold: int = 8):
+        self.root = root
+        self.compact_threshold = int(compact_threshold)
+        os.makedirs(root, exist_ok=True)
+        self._store = None
+
+    # -- the log ------------------------------------------------------------ #
+    def _log_root(self) -> str:
+        return os.path.join(self.root, "sparse-log")
+
+    def _log(self, n_cols: Optional[int] = None):
+        from paddlebox_tpu.sparse.logstore import LogStore
+
+        if self._store is None:
+            self._store = LogStore(
+                self._log_root(),
+                n_cols=n_cols,
+                n_buckets=4,
+                compact_threshold=self.compact_threshold,
+                keep_history=True,  # every tagged generation stays loadable
+            )
+        return self._store
+
+    # -- write -------------------------------------------------------------- #
+    def _state_dir(self, kind: str, tag: str) -> str:
+        return os.path.join(self.root, f"state-{kind}-{tag}")
+
+    def _write_state(
+        self,
+        kind: str,
+        tag: str,
+        gen: int,
+        n_rows: int,
+        params: Any,
+        opt_state: Any,
+        meta: Optional[dict],
+    ) -> dict:
+        dirname = self._state_dir(kind, tag)
+        tmp = dirname + f".tmp-{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        if params is not None:
+            save_pytree(os.path.join(tmp, "dense.npz"), params)
+        if opt_state is not None:
+            save_pytree(os.path.join(tmp, "opt.npz"), opt_state)
+        full_meta = {
+            "kind": kind,
+            "tag": tag,
+            "gen": int(gen),
+            "time": time.time(),
+            "n_sparse_rows": int(n_rows),
+            **(meta or {}),
+        }
+        with open(os.path.join(tmp, "meta.json"), "w") as fh:
+            json.dump(full_meta, fh)
+        write_manifest(tmp, "manifest.json")
+        if os.path.exists(dirname):
+            shutil.rmtree(dirname)
+        os.replace(tmp, dirname)
+        # donefile LAST: a tag exists only once its log generation AND its
+        # dense dir are durably in place
+        with open(os.path.join(self.root, "donefile.txt"), "a") as fh:
+            fh.write(json.dumps(full_meta) + "\n")
+        return full_meta
+
+    def save_base(
+        self,
+        tag: str,
+        table,
+        params: Any = None,
+        opt_state: Any = None,
+        meta: Optional[dict] = None,
+    ) -> str:
+        """Full snapshot as one compacted rewrite generation."""
+        from paddlebox_tpu import telemetry
+
+        with telemetry.histogram(
+            "ckpt.save_seconds", help="checkpoint write wall time (s) by kind"
+        ).time(kind="incr-base"):
+            state = table.state_dict()
+            log = self._log(int(state["values"].shape[1]))
+            gen = log.rewrite(state["keys"], state["values"])
+            self._write_state(
+                "base", tag, gen, state["keys"].shape[0], params, opt_state,
+                {"table_seed": table._seed, **(meta or {})},
+            )
+        table.clear_delta()  # only after the tag is visible
+        return self._state_dir("base", tag)
+
+    def save_delta(
+        self,
+        tag: str,
+        table,
+        params: Any = None,
+        opt_state: Any = None,
+        meta: Optional[dict] = None,
+    ) -> str:
+        """Rows touched since the previous save, as one appended
+        generation.  A failure anywhere (the ``ckpt.delta_save`` chaos
+        site fires before any mutation) leaves the delta tracker intact:
+        the next save retries the same rows — at-least-once, and the log's
+        newest-wins merge makes the replay idempotent."""
+        from paddlebox_tpu import telemetry
+
+        faults.inject("ckpt.delta_save")
+        with telemetry.histogram(
+            "ckpt.save_seconds", help="checkpoint write wall time (s) by kind"
+        ).time(kind="incr-delta"):
+            state = table.delta_state_dict()
+            log = self._log(int(state["values"].shape[1]))
+            log.append(state["keys"], state["values"])
+            gen = log.commit()
+            self._write_state(
+                "delta", tag, gen, state["keys"].shape[0], params, opt_state,
+                {"table_seed": table._seed, **(meta or {})},
+            )
+            # bound the NEXT restore: fold over-threshold buckets now, so
+            # the chain a future tag references is compacted-base + a few
+            # deltas (old segments stay on disk — keep_history — so THIS
+            # tag and every older one remain materializable)
+            log.compact()
+        table.clear_delta()
+        return self._state_dir("delta", tag)
+
+    # -- read --------------------------------------------------------------- #
+    def entries(self) -> list[dict]:
+        """Donefile entries oldest-first; a torn trailing line (crash mid-
+        append) is skipped, matching the delivery plane's reader."""
+        done = os.path.join(self.root, "donefile.txt")
+        if not os.path.exists(done):
+            return []
+        out = []
+        with open(done) as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    logger.warning(
+                        "donefile %s: skipping torn/unparsable line", done
+                    )
+        return out
+
+    def _verify_entry(self, e: dict) -> bool:
+        dirname = self._state_dir(e["kind"], e["tag"])
+        try:
+            verify_checkpoint_dir(dirname)
+        except CheckpointCorrupt as err:
+            logger.warning("checkpoint %s corrupt: %s", dirname, err)
+            return False
+        try:
+            log = self._log()
+        except Exception as err:
+            logger.warning("checkpoint log unopenable: %s", err)
+            return False
+        ok, why = log.verify_gen(int(e["gen"]))
+        if not ok:
+            logger.warning(
+                "checkpoint tag %s: log gen %s fails verification: %s",
+                e["tag"], e["gen"], why,
+            )
+        return ok
+
+    def find_valid_tag(self, upto: Optional[str] = None) -> Optional[str]:
+        """Newest tag (at or before ``upto``) whose state dir AND pinned
+        log generation both verify.  Unlike the classic manager there is
+        no chain to walk per tag — a generation is self-contained."""
+        ents = self.entries()
+        if upto is not None and any(e["tag"] == upto for e in ents):
+            while ents and ents[-1]["tag"] != upto:
+                ents.pop()
+        for e in reversed(ents):
+            if self._verify_entry(e):
+                return e["tag"]
+        return None
+
+    def load(
+        self,
+        table,
+        params_template: Any = None,
+        opt_template: Any = None,
+        upto: Optional[str] = None,
+    ):
+        """Restore the newest (or ``upto``) tag: materialize its pinned log
+        generation into the table, then its dense state.  Returns
+        (params, opt_state, meta)."""
+        from paddlebox_tpu import telemetry
+
+        with telemetry.histogram(
+            "ckpt.load_seconds", help="checkpoint restore wall time (s)"
+        ).time():
+            ents = self.entries()
+            if upto is not None:
+                keep, found = [], False
+                for e in ents:
+                    keep.append(e)
+                    if e["tag"] == upto:
+                        found = True
+                        break
+                if not found:
+                    raise FileNotFoundError(f"no checkpoint tagged {upto!r}")
+                ents = keep
+            if not ents:
+                raise FileNotFoundError(f"no checkpoints under {self.root}")
+            e = ents[-1]
+            dirname = self._state_dir(e["kind"], e["tag"])
+            verify_checkpoint_dir(dirname)
+            log = self._log()
+            ok, why = log.verify_gen(int(e["gen"]))
+            if not ok:
+                raise CheckpointCorrupt(
+                    f"tag {e['tag']}: log generation {e['gen']} corrupt: {why}"
+                )
+            keys, vals = log.materialize_at(int(e["gen"]))
+            table.load_state_dict({"keys": keys, "values": vals})
+            if "table_seed" in e:
+                table._seed = int(e["table_seed"])
+            params = opt_state = None
+            dense_p = os.path.join(dirname, "dense.npz")
+            if params_template is not None and os.path.exists(dense_p):
+                params = load_pytree(dense_p, params_template)
+            opt_p = os.path.join(dirname, "opt.npz")
+            if opt_template is not None and os.path.exists(opt_p):
+                opt_state = load_pytree(opt_p, opt_template)
+            return params, opt_state, e

@@ -191,6 +191,12 @@ class _Flags:
         "stream_root": "",
         "max_staleness_s": 10.0,
         "stream_window_records": 1024,
+        # durable cold tier kill switch (sparse/logstore.py):
+        # PBOX_DURABLE_STORE=0 disables the crash-consistent log under
+        # every table regardless of SparseTableConfig.store_log_dir —
+        # the operational escape hatch if the log path misbehaves (the
+        # table then runs the pre-durability in-RAM lifecycle)
+        "durable_store": True,
     }
 
     def __getattr__(self, name: str):
@@ -493,6 +499,21 @@ class SparseTableConfig:
     # the rest live as .npz files — the SSD tier for stores beyond RAM.
     store_spill_dir: str = ""
     store_max_resident: int = 64
+    # durable cold tier (sparse/logstore.py): directory of the
+    # crash-consistent log-structured store under the warm tier ("" =
+    # durability off, the pre-PR-17 in-RAM lifecycle).  Every pass-boundary
+    # merge writes through to append-only checksummed segments and commits
+    # a manifest generation, so the table recovers its last committed
+    # merge after SIGKILL at any byte; census resolve consults per-segment
+    # bloom/min-max filters before ever touching disk.  The process-wide
+    # kill switch is PBOX_DURABLE_STORE=0.
+    store_log_dir: str = ""
+    # power-of-two bucket count of the durable log (independent of
+    # store_buckets: segments are pass-granular, so fewer, larger buckets
+    # keep file counts sane) and the per-bucket segment count beyond which
+    # the background compactor folds a bucket to one newest-wins segment
+    store_log_buckets: int = 8
+    store_compact_threshold: int = 8
 
     # -- pass-boundary pipelining (sparse/table.py) ----------------------- #
     # Overlap the pass transition with device/host work: end_pass snapshots

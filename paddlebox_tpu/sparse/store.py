@@ -39,16 +39,32 @@ so the two lock orders cannot deadlock.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
+import zlib
 from collections import OrderedDict
-from typing import Iterator, Optional, Tuple
+from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
 from paddlebox_tpu.utils.monitor import stats
 
+logger = logging.getLogger(__name__)
+
 _EMPTY_KEYS = np.empty(0, dtype=np.uint64)
+
+
+class StoreCorrupt(RuntimeError):
+    """A spill file failed its integrity check and no recovery source is
+    wired — raised loud instead of deserializing garbage rows."""
+
+
+def _spill_crc(keys: np.ndarray, vals: np.ndarray) -> int:
+    return zlib.crc32(
+        np.ascontiguousarray(vals).tobytes(),
+        zlib.crc32(np.ascontiguousarray(keys).tobytes()),
+    )
 
 # splitmix64 finalizer constants (public-domain mixing function)
 _MIX_1 = np.uint64(0x9E3779B97F4A7C15)
@@ -75,6 +91,7 @@ class BucketStore:
         spill_dir: str = "",
         max_resident: int = 64,
         n_threads: int = 0,
+        recover_fn: Optional[Callable[[int], Tuple[np.ndarray, np.ndarray]]] = None,
     ):
         if n_buckets & (n_buckets - 1) or n_buckets <= 0:
             raise ValueError(f"n_buckets must be a power of two, got {n_buckets}")
@@ -88,6 +105,10 @@ class BucketStore:
         self.spill_dir = spill_dir
         self.max_resident = max(1, max_resident)
         self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # corrupt-spill recovery source: called with the bucket id, returns
+        # (keys, vals) rebuilt from a durable tier (the table wires this to
+        # its logstore).  None = a corrupt spill raises StoreCorrupt.
+        self._recover_fn = recover_fn
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
         # bucket parallelism: per-bucket content locks + one LRU/spill lock
@@ -154,7 +175,13 @@ class BucketStore:
         if k is None:
             return
         if k.shape[0]:
-            np.savez(self._path(b), keys=k, vals=self._vals[b])
+            # checksum rides the file: _get verifies before trusting a row
+            # (an unchecked spill deserializes disk corruption straight
+            # into training state)
+            np.savez(
+                self._path(b), keys=k, vals=self._vals[b],
+                crc=np.uint32(_spill_crc(k, self._vals[b])),
+            )
             self._spilled[b] = True
             self.spill_writes += 1
         elif self._spilled[b]:
@@ -173,9 +200,30 @@ class BucketStore:
         k = self._keys[b]
         if k is None:
             if self._spilled[b]:
-                with np.load(self._path(b)) as z:
-                    self._keys[b] = z["keys"]
-                    self._vals[b] = z["vals"]
+                try:
+                    with np.load(self._path(b)) as z:
+                        sk = np.ascontiguousarray(z["keys"], dtype=np.uint64)
+                        sv = np.ascontiguousarray(z["vals"], dtype=np.float32)
+                        crc = int(z["crc"])
+                    if _spill_crc(sk, sv) != crc:
+                        raise StoreCorrupt(
+                            f"spill bucket {b}: checksum mismatch"
+                        )
+                except Exception as e:  # torn/garbled npz raises zoo-wide
+                    stats.add("store.spill_corrupt")
+                    logger.error("spill bucket %d failed verification: %s", b, e)
+                    if self._recover_fn is None:
+                        raise StoreCorrupt(
+                            f"spill bucket {b} corrupt and no durable tier "
+                            f"to recover from: {e}"
+                        ) from e
+                    sk, sv = self._recover_fn(b)
+                    sk = np.ascontiguousarray(sk, dtype=np.uint64)
+                    sv = np.ascontiguousarray(sv, dtype=np.float32)
+                    stats.add("store.spill_recovered", int(sk.shape[0]))
+                    self._counts[b] = sk.shape[0]
+                self._keys[b] = sk
+                self._vals[b] = sv
                 self.spill_reads += 1
             else:
                 self._keys[b] = _EMPTY_KEYS

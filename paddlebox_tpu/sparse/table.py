@@ -148,7 +148,34 @@ class SparseTable:
             spill_dir=conf.store_spill_dir,
             max_resident=conf.store_max_resident,
             n_threads=conf.store_threads,
+            recover_fn=self._recover_spill_bucket,
         )
+        # durable cold tier (sparse/logstore.py): every pass-boundary merge
+        # writes through to the crash-consistent log and commits a manifest
+        # generation, so a killed process recovers its last committed merge
+        # here at construction.  "" / PBOX_DURABLE_STORE=0 = off (the
+        # pre-durability in-RAM lifecycle).
+        self._log = None
+        self._compact_worker: Optional[_SerialWorker] = None
+        self._compact_future: Optional[Future] = None
+        if conf.store_log_dir and flags.durable_store:
+            from paddlebox_tpu.sparse.logstore import LogStore
+
+            self._log = LogStore(
+                conf.store_log_dir,
+                n_cols=w + 1,
+                n_buckets=conf.store_log_buckets,
+                compact_threshold=conf.store_compact_threshold,
+            )
+            self._compact_worker = _SerialWorker("table-compact")
+            if self._log.gen > 0:
+                rk, rv = self._log.materialize()
+                if rk.shape[0]:
+                    self._store.load_bulk(rk, rv)
+                    logger.info(
+                        "durable log %s: recovered %d rows at gen %d",
+                        conf.store_log_dir, rk.shape[0], self._log.gen,
+                    )
         # pass-scoped device state
         self.values: Optional[jax.Array] = None  # [P, w]
         self.g2sum: Optional[jax.Array] = None  # [P]
@@ -386,6 +413,9 @@ class SparseTable:
         self._drain_cache()
         while self._merge_futures:
             self._merge_futures.pop(0).result()
+        if self._log is not None:
+            # merges commit per batch; this covers any straggler staging
+            self._log.commit()
 
     def close(self) -> None:
         """Quiesce and retire background resources: barrier the
@@ -398,6 +428,16 @@ class SparseTable:
             raise RuntimeError("end_pass (or abort_pass) before close")
         self._discard_stage()
         self.flush()
+        if self._compact_future is not None:
+            try:
+                self._compact_future.result()
+            except Exception:
+                logger.warning(
+                    "background log compaction failed at close", exc_info=True
+                )
+            self._compact_future = None
+        if self._log is not None:
+            self._log.close()
         self._store.close()
 
     def _discard_stage(self) -> None:
@@ -613,6 +653,24 @@ class SparseTable:
             return np.zeros((0, w + 1), dtype=np.float32)
         vals, found = self._lookup_with_overlay(pk, _entries)
         n_new = int((~found).sum())
+        if n_new and self._log is not None:
+            # census disk-reject: the per-segment bloom + min-max filters
+            # prove most unseen keys are on NO segment without a read —
+            # only the maybes (bloom false positives, or rows the warm
+            # tier genuinely lost) pay a disk lookup
+            from paddlebox_tpu.utils.monitor import stats
+
+            miss_idx = np.nonzero(~found)[0]
+            maybe = self._log.might_contain(pk[miss_idx])
+            stats.add("store.census_disk_rejects", int((~maybe).sum()))
+            if maybe.any():
+                lv, lf = self._log.lookup(pk[miss_idx[maybe]])
+                if lf.any():
+                    hit_idx = miss_idx[maybe][lf]
+                    vals[hit_idx] = lv[lf]
+                    found[hit_idx] = True
+                    n_new -= int(lf.sum())
+                    stats.add("store.census_log_hits", int(lf.sum()))
         if n_new:
             init = np.zeros((n_new, w + 1), dtype=np.float32)
             init[:, self.conf.cvm_offset : w] = _key_uniform(
@@ -837,8 +895,48 @@ class SparseTable:
 
     def _merge_into_store(self, keys: np.ndarray, vals: np.ndarray) -> None:
         """Write back rows for sorted unique ``keys`` (existing rows update
-        in place; buckets with new keys rebuild — see sparse/store.py)."""
-        self._store.update(keys, np.asarray(vals, dtype=np.float32))
+        in place; buckets with new keys rebuild — see sparse/store.py).
+        With a durable log, the batch lands there FIRST and commits a
+        manifest generation: a failure aborts before the warm tier sees the
+        rows (clean abort), and a kill after commit replays them from the
+        log at the next construction."""
+        vals32 = np.asarray(vals, dtype=np.float32)
+        if self._log is not None:
+            self._log.append(keys, vals32)
+            self._log.commit()
+            self._maybe_compact_log()
+        self._store.update(keys, vals32)
+
+    def _recover_spill_bucket(self, b: int):
+        """BucketStore corrupt-spill recovery source: rebuild bucket ``b``
+        from the durable log's committed state (raises in the store when
+        no log is configured)."""
+        if self._log is None:
+            raise RuntimeError(
+                f"spill bucket {b} corrupt and no durable log configured"
+            )
+        lk, lv = self._log.materialize()
+        mask = self._store._bucket_of(lk) == b
+        return lk[mask], lv[mask]
+
+    def _maybe_compact_log(self) -> None:
+        """Kick background compaction (PR-5 _SerialWorker pattern) when any
+        log bucket crossed the segment threshold.  One compaction in flight
+        at a time; a failure is counted + logged, never fatal — the log
+        stays correct uncompacted, only longer."""
+        if self._log is None or not self._log.buckets_over_threshold():
+            return
+        fut = self._compact_future
+        if fut is not None and not fut.done():
+            return
+        if fut is not None:
+            exc = fut.exception()
+            if exc is not None:
+                from paddlebox_tpu.utils.monitor import stats
+
+                stats.add("store.compact_failures")
+                logger.warning("background log compaction failed: %s", exc)
+        self._compact_future = self._compact_worker.submit(self._log.compact)
 
     # -- batch planning (host) ------------------------------------------- #
     def plan_batch(self, batch: HostBatch) -> BatchPlan:
@@ -926,6 +1024,12 @@ class SparseTable:
         # store decayed/evicted): membership must drop so the next pass
         # re-reads the decayed rows from the store
         self._invalidate_caches()
+        if self._log is not None:
+            # the log must not resurrect decayed/evicted rows at recovery:
+            # one rewrite generation replaces the chain with the shrunk
+            # state (also the compaction that bounds recovery cost)
+            lk, lv = self._store.materialize()
+            self._log.rewrite(lk, lv)
         return evicted
 
     # -- persistence ------------------------------------------------------ #
@@ -947,6 +1051,11 @@ class SparseTable:
         )
         # every cached row is now stale relative to the restored store
         self._invalidate_caches()
+        if self._log is not None:
+            # re-sync the durable chain: recovery must reproduce the
+            # restored state, not the pre-restore one
+            lk, lv = self._store.materialize()
+            self._log.rewrite(lk, lv)
 
     def pass_state_dict(self) -> dict:
         """Snapshot usable mid-pass: the live working set when a pass is

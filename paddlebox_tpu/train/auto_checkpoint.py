@@ -34,6 +34,7 @@ import numpy as np
 from paddlebox_tpu.checkpoint import (
     CheckpointCorrupt,
     CheckpointManager,
+    IncrementalCheckpointManager,
     load_pytree,
     save_pytree,
 )
@@ -58,11 +59,25 @@ class AutoCheckpointer:
         base_every: int = 8,
         shard: int = 0,
         n_shards: int = 1,
+        incremental: bool = False,
     ):
         self.root = root
         self.job_id = job_id
         self.base_every = max(int(base_every), 1)
-        self.ckpt = CheckpointManager(root, shard=shard, n_shards=n_shards)
+        if incremental:
+            # log-structured checkpoints: deltas append one manifest
+            # generation to the durable log instead of writing a dir per
+            # pass, and restore materializes a generation (cost = base +
+            # trailing-delta bytes, bounded by compaction).  Single-shard
+            # only — sharded jobs keep the classic per-shard manager.
+            if n_shards > 1:
+                raise ValueError(
+                    "incremental checkpoints are single-shard; use the "
+                    "classic CheckpointManager for sharded jobs"
+                )
+            self.ckpt = IncrementalCheckpointManager(root)
+        else:
+            self.ckpt = CheckpointManager(root, shard=shard, n_shards=n_shards)
         os.makedirs(root, exist_ok=True)
 
     def _status_path(self) -> str:

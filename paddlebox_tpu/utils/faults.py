@@ -107,6 +107,17 @@ KNOWN_SITES = {
     # the deadline-triggered publish (failure => rows stay in the delta
     # tracker and the next window retries — at-least-once delivery)
     "stream.tail", "stream.cut", "stream.publish_deadline",
+    # durable cold tier (sparse/logstore.py + checkpoint.py): segment
+    # block append (failure => the staged segment is unlinked and the
+    # batch aborts with committed state untouched), compaction between
+    # the staged merge and its manifest commit (failure => the staged
+    # output is dropped, the old segments stay live), the manifest
+    # commit's CURRENT swing (failure => the new manifest is an orphan,
+    # the store stays at the old generation, a retry re-commits), and
+    # the incremental checkpoint delta save (failure => the delta
+    # tracker is NOT cleared, the next save retries the same rows)
+    "store.segment_write", "store.compact", "store.manifest_commit",
+    "ckpt.delta_save",
 }
 
 

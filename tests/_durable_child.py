@@ -1,0 +1,168 @@
+"""Child program for the durable-cold-tier SIGKILL chaos tests (not pytest).
+
+One deterministic multi-pass training job over a `SparseTable` backed by
+the crash-consistent log (`store_log_dir`).  Three modes:
+
+  run     — all passes, uninterrupted; dump the final state (the oracle).
+  victim  — same job, but at pass ``kill_pass`` a ``hang:first:1`` fault
+            plan is installed for ``site`` (store.segment_write /
+            store.compact / store.manifest_commit).  The process freezes
+            at that site mid-mutation; a watcher thread touches the
+            sentinel file the moment ``faults.hung.<site>`` trips so the
+            parent can SIGKILL us at exactly the modeled crash point.
+  resume  — open the same root (the table ctor recovers the committed
+            log generation), read the atomic progress file, replay the
+            unfinished passes, dump the final state.
+
+The parent asserts resume's dump is BIT-exact vs run's: keys, values,
+g2sum, and the exact rank-based AUC over scores derived from the final
+embeddings (labels = key parity).  The progress file is written only
+after ``flush()`` returns — i.e. after the pass's log generation
+committed — so "replay from progress" is exactly the recovery contract:
+a kill mid-merge leaves progress at the pass being merged, and the log
+at the previous generation.
+
+argv: mode root n_passes kill_pass site sentinel
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+
+mode = sys.argv[1]
+root = sys.argv[2]
+n_passes = int(sys.argv[3])
+kill_pass = int(sys.argv[4])
+site = sys.argv[5]
+sentinel = sys.argv[6] if len(sys.argv) > 6 else ""
+
+
+def make_table():
+    from paddlebox_tpu.config import SparseTableConfig
+    from paddlebox_tpu.sparse import SparseTable
+
+    conf = SparseTableConfig(
+        embedding_dim=4, learning_rate=0.1, initial_g2sum=1.0,
+        initial_range=0.5, grad_clip=10.0,
+        overlap_pass_boundary=False, hbm_cache_rows=0,
+        store_log_dir=os.path.join(root, "log"),
+        store_log_buckets=2,
+        # compaction is driven explicitly (the store.compact arm), never
+        # by the background worker — keeps the kill point deterministic
+        store_compact_threshold=10_000,
+    )
+    return SparseTable(conf, seed=7)
+
+
+def pass_keys(p: int) -> np.ndarray:
+    rs = np.random.RandomState(100 + p)
+    return np.unique(rs.randint(1, 5000, size=400).astype(np.uint64))
+
+
+def run_pass(t, p: int) -> None:
+    import jax.numpy as jnp
+
+    t.begin_pass(pass_keys(p))
+    cap = int(t.values.shape[0])
+    delta = ((np.arange(cap, dtype=np.float32)[:, None] % 7.0) + p) * 0.01
+    delta = np.broadcast_to(delta, (cap, t.values.shape[1]))
+    t.values = t.values + jnp.asarray(np.ascontiguousarray(delta))
+    t.g2sum = t.g2sum + jnp.float32(0.25)
+    t.end_pass()
+
+
+def progress_path() -> str:
+    return os.path.join(root, "progress.json")
+
+
+def write_progress(next_pass: int) -> None:
+    tmp = progress_path() + f".tmp-{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump({"next_pass": next_pass}, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, progress_path())
+
+
+def read_progress() -> int:
+    if not os.path.exists(progress_path()):
+        return 0
+    with open(progress_path()) as fh:
+        return int(json.load(fh)["next_pass"])
+
+
+def exact_auc(scores: np.ndarray, labels: np.ndarray) -> float:
+    """Exact rank-based AUC (average ranks on ties)."""
+    order = np.argsort(scores, kind="mergesort")
+    s = scores[order]
+    ranks = np.empty(len(s), dtype=np.float64)
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and s[j + 1] == s[i]:
+            j += 1
+        ranks[i : j + 1] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    r = np.empty_like(ranks)
+    r[order] = ranks
+    pos = labels > 0
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    return float((r[pos].sum() - n_pos * (n_pos + 1) / 2.0)
+                 / (n_pos * n_neg))
+
+
+def dump(t, out_path: str) -> None:
+    state = t.state_dict()
+    keys, vals = state["keys"], state["values"]
+    scores = vals[:, 2:-1].astype(np.float64).sum(axis=1)
+    labels = (keys % 2).astype(np.int64)
+    np.savez(out_path, keys=keys, values=vals,
+             auc=np.float64(exact_auc(scores, labels)))
+
+
+def main() -> int:
+    if mode == "victim":
+        from paddlebox_tpu.utils.monitor import stats
+
+        def watch() -> None:
+            while True:
+                if stats.get(f"faults.hung.{site}") > 0:
+                    with open(sentinel, "w") as fh:
+                        fh.write("hung\n")
+                    return
+                time.sleep(0.01)
+
+        threading.Thread(target=watch, daemon=True).start()
+
+    t = make_table()
+    start = read_progress() if mode == "resume" else 0
+    for p in range(start, n_passes):
+        if mode == "victim" and p == kill_pass:
+            from paddlebox_tpu.utils import faults
+
+            faults.install(faults.FaultPlan({site: "hang:first:1"}))
+        run_pass(t, p)
+        t.flush()  # the pass's log generation commits HERE
+        write_progress(p + 1)
+        if mode == "victim" and site == "store.compact" and p == kill_pass:
+            # explicit synchronous compaction: hangs between the staged
+            # merge and its swap-manifest commit
+            t._log.compact(0)
+    dump(t, os.path.join(root, f"state-{mode}.npz"))
+    t.close()
+    print("DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,122 @@
+"""tools/check_store_dir.py: durable-log store-root lint (damage vs
+crash debris)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_store_dir import check_store_root, main  # noqa: E402
+
+from paddlebox_tpu.sparse.logstore import LogStore  # noqa: E402
+
+
+def _write_root(tmp_path, passes=3, compact=False):
+    root = str(tmp_path / "log")
+    ls = LogStore(root, n_cols=3, n_buckets=2, compact_threshold=2)
+    k = np.arange(1, 60, dtype=np.uint64)
+    for p in range(passes):
+        v = (k.astype(np.float64)[:, None] * [1, 2, 3] * 0.01 + p)
+        ls.append(k, v.astype(np.float32))
+        ls.commit()
+    if compact:
+        ls.compact()
+    ls.close()
+    return root
+
+
+def _current_manifest(root):
+    with open(os.path.join(root, "CURRENT")) as fh:
+        name = fh.read().strip()
+    return name, json.load(open(os.path.join(root, name)))
+
+
+def test_clean_root_passes(tmp_path, capsys):
+    root = _write_root(tmp_path, compact=True)
+    errors, warnings = check_store_root(root)
+    assert errors == [] and warnings == []
+    assert main([root]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_fresh_empty_root_passes(tmp_path):
+    root = str(tmp_path / "fresh")
+    os.makedirs(root)
+    assert check_store_root(root) == ([], [])
+
+
+def test_missing_current_with_data_is_an_error(tmp_path):
+    root = _write_root(tmp_path)
+    os.remove(os.path.join(root, "CURRENT"))
+    errors, _ = check_store_root(root)
+    assert errors and "CURRENT missing" in errors[0]
+    assert main([root]) == 1
+
+
+def test_dangling_current_is_an_error(tmp_path):
+    root = _write_root(tmp_path)
+    name, _ = _current_manifest(root)
+    os.remove(os.path.join(root, name))
+    errors, _ = check_store_root(root)
+    assert errors and "unreadable" in errors[0]
+
+
+def test_referenced_segment_damage_is_an_error(tmp_path):
+    root = _write_root(tmp_path)
+    _, man = _current_manifest(root)
+    segs = [d["name"] for d in man["segments"]]
+    # one missing, one truncated, one bit-flipped (size intact)
+    os.remove(os.path.join(root, segs[0]))
+    with open(os.path.join(root, segs[1]), "r+b") as fh:
+        fh.truncate(os.path.getsize(os.path.join(root, segs[1])) - 5)
+    with open(os.path.join(root, segs[2]), "r+b") as fh:
+        fh.seek(-1, os.SEEK_END)
+        b = fh.read(1)
+        fh.seek(-1, os.SEEK_END)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    errors, _ = check_store_root(root)
+    assert any("missing" in e for e in errors)
+    assert any("size" in e for e in errors)
+    assert any("crc" in e for e in errors)
+    assert main([root]) == 1
+
+
+def test_orphans_and_torn_tails_warn(tmp_path):
+    root = _write_root(tmp_path)
+    # a torn orphan (crashed segment write) and a clean orphan
+    with open(os.path.join(root, "seg-00000099-b001.seg"), "wb") as fh:
+        fh.write(b"PBLOG1\x00\n\x20\x00\x00\x00trunc")
+    _, man = _current_manifest(root)
+    src = os.path.join(root, man["segments"][0]["name"])
+    import shutil
+
+    shutil.copy(src, os.path.join(root, "seg-00000098-b000.seg"))
+    errors, warnings = check_store_root(root)
+    assert errors == []
+    assert any("orphan segment" in w and "torn" in w for w in warnings)
+    assert any("seg-00000098" in w and "torn" not in w for w in warnings)
+    assert main([root]) == 0
+    assert main([root, "--strict"]) == 1
+
+
+def test_manifest_newer_than_current_warns(tmp_path):
+    root = _write_root(tmp_path)
+    name, man = _current_manifest(root)
+    man["gen"] += 3
+    with open(os.path.join(root, f"manifest-{man['gen']:08d}.json"),
+              "w") as fh:
+        json.dump(man, fh)
+    errors, warnings = check_store_root(root)
+    assert errors == []
+    assert any("newer than CURRENT" in w for w in warnings)
+
+
+def test_manifest_chain_gap_warns(tmp_path):
+    root = _write_root(tmp_path, passes=4)
+    os.remove(os.path.join(root, "manifest-00000002.json"))
+    errors, warnings = check_store_root(root)
+    assert errors == []
+    assert any("chain gap" in w for w in warnings)

@@ -1,0 +1,303 @@
+"""Durable cold tier component tests: segment framing, crash windows,
+manifest commit discipline, bloom rejects, compaction barrier.
+
+The SIGKILL end-to-end versions (a real child process frozen at each
+fault site and killed) live in test_durable_store.py (`-m chaos`); this
+file proves the same crash windows in-process by byte surgery and
+raising fault plans, so tier-1 covers every recovery rule fast.
+"""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.sparse.logstore import (
+    BloomFilter,
+    LogStore,
+    LogStoreCorrupt,
+    SegmentWriter,
+    read_segment,
+)
+from paddlebox_tpu.utils import faults
+from paddlebox_tpu.utils.faults import fault_plan
+from paddlebox_tpu.utils.monitor import stats
+
+
+def _rows(keys, n_cols=3, salt=0.0):
+    keys = np.asarray(keys, dtype=np.uint64)
+    base = keys.astype(np.float64)[:, None] * np.arange(1, n_cols + 1)
+    return (base * 0.001 + salt).astype(np.float32)
+
+
+def _store(root, **kw):
+    kw.setdefault("n_cols", 3)
+    kw.setdefault("n_buckets", 2)
+    return LogStore(str(root), **kw)
+
+
+# --------------------------------------------------------------------------- #
+# segment files
+# --------------------------------------------------------------------------- #
+class TestSegment:
+    def test_roundtrip_and_typestate(self, tmp_path):
+        k = np.array([3, 9, 11], dtype=np.uint64)
+        v = _rows(k)
+        w = SegmentWriter(str(tmp_path), 0, 1)
+        with pytest.raises(RuntimeError, match="sealed"):
+            w.info()  # unsealed segments must never be read
+        w.append(k, v)
+        info = w.seal()
+        with pytest.raises(RuntimeError):
+            w.append(k, v)  # sealed files never grow
+        blocks = read_segment(os.path.join(str(tmp_path), info.name),
+                              expect_bytes=info.n_bytes, expect_crc=info.crc)
+        assert len(blocks) == 1
+        np.testing.assert_array_equal(blocks[0][0], k)
+        np.testing.assert_array_equal(blocks[0][1], v)
+        assert (info.min_key, info.max_key) == (3, 11)
+
+    def test_unsorted_keys_loud(self, tmp_path):
+        w = SegmentWriter(str(tmp_path), 0, 1)
+        try:
+            with pytest.raises(Exception):
+                w.append(np.array([9, 3], dtype=np.uint64),
+                         _rows([9, 3]))
+        finally:
+            w.abort()
+
+    def test_torn_tail_byte_sweep(self, tmp_path):
+        """Truncate the file at EVERY byte: orphan decode never raises and
+        always yields a prefix of the committed blocks; strict decode
+        always raises."""
+        k1 = np.array([1, 5], dtype=np.uint64)
+        k2 = np.array([2, 8, 12], dtype=np.uint64)
+        w = SegmentWriter(str(tmp_path), 0, 1)
+        w.append(k1, _rows(k1))
+        w.append(k2, _rows(k2))
+        info = w.seal()
+        path = os.path.join(str(tmp_path), info.name)
+        data = open(path, "rb").read()
+        torn = os.path.join(str(tmp_path), "torn.seg")
+        for cut in range(len(data)):
+            with open(torn, "wb") as fh:
+                fh.write(data[:cut])
+            blocks = read_segment(torn)  # orphan mode: recoverable prefix
+            assert len(blocks) <= 2
+            for got, want in zip(blocks, [k1, k2]):
+                np.testing.assert_array_equal(got[0], want)
+            with pytest.raises(LogStoreCorrupt):
+                read_segment(torn, expect_bytes=info.n_bytes,
+                             expect_crc=info.crc)
+        # flipping one payload byte (size intact) still fails strict
+        flipped = bytearray(data)
+        flipped[-1] ^= 0xFF
+        with open(torn, "wb") as fh:
+            fh.write(bytes(flipped))
+        with pytest.raises(LogStoreCorrupt):
+            read_segment(torn, expect_bytes=info.n_bytes,
+                         expect_crc=info.crc)
+
+    def test_bloom_rejects_absent_keys(self):
+        present = np.arange(0, 4000, 2, dtype=np.uint64)
+        absent = np.arange(1, 4001, 2, dtype=np.uint64)
+        bf = BloomFilter.build(present)
+        assert bf.might_contain(present).all()
+        fp = bf.might_contain(absent).mean()
+        assert fp < 0.05
+        # hex round-trip (the manifest wire form)
+        bf2 = BloomFilter.from_hex(bf.to_hex())
+        np.testing.assert_array_equal(
+            bf2.might_contain(absent), bf.might_contain(absent))
+
+
+# --------------------------------------------------------------------------- #
+# the store: commit visibility, newest-wins, recovery
+# --------------------------------------------------------------------------- #
+class TestLogStore:
+    def test_uncommitted_is_invisible(self, tmp_path):
+        ls = _store(tmp_path)
+        k = np.array([1, 2, 3], dtype=np.uint64)
+        ls.append(k, _rows(k))  # staged, never committed
+        ls.close()
+        again = _store(tmp_path)
+        assert again.gen == 0
+        mk, _ = again.materialize()
+        assert mk.shape[0] == 0
+        again.close()
+
+    def test_commit_newest_wins_and_reopen(self, tmp_path):
+        ls = _store(tmp_path)
+        k = np.arange(1, 40, dtype=np.uint64)
+        ls.append(k, _rows(k))
+        ls.commit()
+        ls.append(k[:10], _rows(k[:10], salt=9.0))
+        ls.commit()
+        ls.close()
+        again = _store(tmp_path)
+        mk, mv = again.materialize()
+        np.testing.assert_array_equal(mk, k)
+        np.testing.assert_array_equal(mv[:10], _rows(k[:10], salt=9.0))
+        np.testing.assert_array_equal(mv[10:], _rows(k[10:]))
+        vals, found = again.lookup(np.array([5, 999], dtype=np.uint64))
+        assert found.tolist() == [True, False]
+        np.testing.assert_array_equal(vals[0], _rows([5], salt=9.0)[0])
+        again.close()
+
+    def test_lookup_skips_segments_without_disk(self, tmp_path):
+        ls = _store(tmp_path)
+        lo = np.arange(1, 50, dtype=np.uint64)
+        hi = np.arange(10_000, 10_050, dtype=np.uint64)
+        ls.append(lo, _rows(lo))
+        ls.commit()
+        ls.append(hi, _rows(hi))
+        ls.commit()
+        before = stats.get("store.log_seg_skips")
+        # an old key: the newer (hi-range) segment is consulted first and
+        # skipped via its min-max sidecar, never read
+        vals, found = ls.lookup(np.array([5], dtype=np.uint64))
+        assert found.all()
+        np.testing.assert_array_equal(vals[0], _rows([5])[0])
+        assert stats.get("store.log_seg_skips") > before
+        assert not ls.might_contain(
+            np.array([777_777], dtype=np.uint64)).any()
+        ls.close()
+
+    def test_compaction_is_content_preserving(self, tmp_path):
+        ls = _store(tmp_path, compact_threshold=2)
+        k = np.arange(1, 60, dtype=np.uint64)
+        for p in range(4):
+            ls.append(k, _rows(k, salt=float(p)))
+            ls.commit()
+        pre_k, pre_v = ls.materialize()
+        assert ls.buckets_over_threshold()
+        n = ls.compact()
+        assert n > 0 and not ls.buckets_over_threshold()
+        post_k, post_v = ls.materialize()
+        np.testing.assert_array_equal(pre_k, post_k)
+        np.testing.assert_array_equal(pre_v, post_v)
+        ls.close()
+        # and the compacted root recovers identically
+        again = _store(tmp_path)
+        rk, rv = again.materialize()
+        np.testing.assert_array_equal(rk, pre_k)
+        np.testing.assert_array_equal(rv, pre_v)
+        again.close()
+
+    def test_verify_gen_detects_damage(self, tmp_path):
+        ls = _store(tmp_path, keep_history=True)
+        k = np.arange(1, 30, dtype=np.uint64)
+        ls.append(k, _rows(k))
+        gen = ls.commit()
+        ok, _ = ls.verify_gen(gen)
+        assert ok
+        seg = [n for n in os.listdir(str(tmp_path)) if n.endswith(".seg")][0]
+        with open(os.path.join(str(tmp_path), seg), "r+b") as fh:
+            fh.seek(-3, os.SEEK_END)
+            fh.write(b"\x00\x00\x00")
+        ok, reason = ls.verify_gen(gen)
+        assert not ok and "crc" in reason
+        ls.close()
+
+    def test_materialize_at_time_travel(self, tmp_path):
+        ls = _store(tmp_path, keep_history=True)
+        k = np.arange(1, 20, dtype=np.uint64)
+        gens = []
+        for p in range(3):
+            ls.append(k, _rows(k, salt=float(p)))
+            gens.append(ls.commit())
+        for p, g in enumerate(gens):
+            gk, gv = ls.materialize_at(g)
+            np.testing.assert_array_equal(gk, k)
+            np.testing.assert_array_equal(gv, _rows(k, salt=float(p)))
+        ls.close()
+
+
+# --------------------------------------------------------------------------- #
+# crash windows, in-process: every fault site aborts clean and retries
+# to commit
+# --------------------------------------------------------------------------- #
+class TestFaultSites:
+    def _committed_state(self, root):
+        probe = _store(root)
+        try:
+            return probe.gen, probe.materialize()
+        finally:
+            probe.close()
+
+    @pytest.mark.parametrize("site", [
+        "store.segment_write", "store.manifest_commit",
+    ])
+    def test_append_commit_abort_then_retry(self, tmp_path, site):
+        ls = _store(tmp_path)
+        k0 = np.arange(1, 25, dtype=np.uint64)
+        ls.append(k0, _rows(k0))
+        ls.commit()
+        gen0, (mk0, mv0) = self._committed_state(tmp_path)
+        k1 = np.arange(100, 125, dtype=np.uint64)
+        with fault_plan({site: "first:1"}):
+            with pytest.raises(faults.FaultInjected):
+                ls.append(k1, _rows(k1))
+                ls.commit()
+            # clean abort: committed state unchanged on disk
+            gen, (mk, mv) = self._committed_state(tmp_path)
+            assert gen == gen0
+            np.testing.assert_array_equal(mk, mk0)
+            np.testing.assert_array_equal(mv, mv0)
+            # retry-to-commit under the same (exhausted) plan
+            ls.discard_pending()
+            ls.append(k1, _rows(k1))
+            ls.commit()
+        gen, (mk, _) = self._committed_state(tmp_path)
+        assert gen > gen0
+        np.testing.assert_array_equal(mk, np.concatenate([k0, k1]))
+        ls.close()
+
+    def test_compact_abort_keeps_old_segments(self, tmp_path):
+        ls = _store(tmp_path, compact_threshold=2)
+        k = np.arange(1, 60, dtype=np.uint64)
+        for p in range(3):
+            ls.append(k, _rows(k, salt=float(p)))
+            ls.commit()
+        pre_gen, (mk, mv) = self._committed_state(tmp_path)
+        n_live = ls.n_live_segments
+        with fault_plan({"store.compact": "first:1"}):
+            with pytest.raises(faults.FaultInjected):
+                ls.compact()
+            assert ls.n_live_segments == n_live  # nothing swapped
+            gen, (ak, av) = self._committed_state(tmp_path)
+            assert gen == pre_gen
+            np.testing.assert_array_equal(ak, mk)
+            np.testing.assert_array_equal(av, mv)
+            # the staged orphan was dropped, retry compacts for real
+            assert ls.compact() > 0
+        gen, (ak, av) = self._committed_state(tmp_path)
+        np.testing.assert_array_equal(ak, mk)
+        np.testing.assert_array_equal(av, mv)
+        ls.close()
+
+    def test_kill_between_manifest_and_current(self, tmp_path):
+        """The CURRENT-last window, by byte surgery: a manifest that landed
+        without its CURRENT swing is an orphan the reopen ignores."""
+        ls = _store(tmp_path)
+        k = np.arange(1, 30, dtype=np.uint64)
+        ls.append(k, _rows(k))
+        gen1 = ls.commit()
+        # forge the crash: newer manifest exists, CURRENT still points back
+        man = open(os.path.join(str(tmp_path),
+                                f"manifest-{gen1:08d}.json")).read()
+        forged = man.replace(f'"gen": {gen1}', f'"gen": {gen1 + 1}')
+        with open(os.path.join(str(tmp_path),
+                               f"manifest-{gen1 + 1:08d}.json"), "w") as fh:
+            fh.write(forged)
+        ls.close()
+        again = _store(tmp_path)
+        assert again.gen == gen1  # CURRENT rules, the orphan never existed
+        again.close()
+
+
+def test_known_sites_cover_the_new_surface():
+    for site in ("store.segment_write", "store.compact",
+                 "store.manifest_commit", "ckpt.delta_save"):
+        assert site in faults.KNOWN_SITES

@@ -889,6 +889,66 @@ def test_protocol_impl_obligation_fixture(tmp_path):
                 if "state_dict() must" in f.message]
 
 
+def test_protocol_segment_writer_read_before_seal(tmp_path):
+    bad = """\
+        def write(root, keys, vals):
+            writer = SegmentWriter(root, 0, 1)
+            writer.append(keys, vals)
+            blocks = writer.info()
+            writer.seal()
+            return blocks
+    """
+    findings = _run(rules_protocol, tmp_path, bad)
+    assert any(f.rule == "protocol-segment-lifecycle" and f.line == 4
+               for f in findings)
+
+    good = """\
+        def write(root, keys, vals):
+            writer = SegmentWriter(root, 0, 1)
+            writer.append(keys, vals)
+            writer.seal()
+            return writer.info()
+    """
+    assert _run(rules_protocol, tmp_path, good) == []
+
+
+def test_protocol_segment_writer_leaked_open(tmp_path):
+    # a scope that neither seals nor aborts leaks an unsynced segment
+    bad = """\
+        def write(root, keys, vals):
+            writer = SegmentWriter(root, 0, 1)
+            writer.append(keys, vals)
+    """
+    findings = _run(rules_protocol, tmp_path, bad)
+    assert any(f.rule == "protocol-segment-lifecycle" for f in findings)
+
+    aborted = bad.replace("writer.append(keys, vals)",
+                          "writer.append(keys, vals)\n    writer.abort()")
+    assert _run(rules_protocol, tmp_path, aborted) == []
+
+
+def test_protocol_segment_compact_swap_before_commit(tmp_path):
+    bad = """\
+        class S:
+            def compact(self, b):
+                staged = self._compact_write(b)
+                self._swap_segments(b, [staged], [])
+                self._commit_manifest([[staged]])
+    """
+    findings = _run(rules_protocol, tmp_path, bad)
+    assert any(f.rule == "protocol-segment-lifecycle" and f.line == 4
+               for f in findings)
+
+    good = """\
+        class S:
+            def compact(self, b):
+                staged = self._compact_write(b)
+                self._commit_manifest([[staged]])
+                self._swap_segments(b, [staged], [])
+    """
+    assert _run(rules_protocol, tmp_path, good) == []
+
+
 def test_protocol_suppressed(tmp_path):
     src = """\
         def drive(conf, k):

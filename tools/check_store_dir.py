@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Store-root lint: crash-debris vs damage audit for the durable cold tier.
+
+Thin wrapper over ``pbox_analyze.publish.check_store_root`` (rule
+``store-dir`` — opt-in via ``tools/pbox_analyze.py --store-root``, since
+it audits runtime data rather than source).  The line it draws is the
+store's own crash contract (ARCHITECTURE.md "Durable cold tier"):
+damage to the CURRENT-committed generation is an error; orphan
+segments/manifests and torn tails are warnings — legal crash debris,
+named so an operator can garbage-collect with confidence.
+
+Usage:
+    python tools/check_store_dir.py ROOT [--strict] [--quiet]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from pbox_analyze.publish import check_store_root  # noqa: E402,F401
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", help="durable-log store root to lint")
+    ap.add_argument("--strict", action="store_true",
+                    help="treat warnings as errors")
+    ap.add_argument("--quiet", action="store_true",
+                    help="print nothing on success")
+    args = ap.parse_args(argv)
+    errors, warnings = check_store_root(args.root)
+    for w in warnings:
+        print(f"WARNING: {w}", file=sys.stderr)
+    for e in errors:
+        print(f"ERROR: {e}", file=sys.stderr)
+    if errors or (args.strict and warnings):
+        print(f"{args.root}: {len(errors)} error(s), "
+              f"{len(warnings)} warning(s)", file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"{args.root}: store root OK "
+              f"({len(warnings)} warning(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,8 @@ Modes:
   --update-baseline    accept every current finding into the baseline
   --publish-root PATH  additionally audit a publish root (repeatable;
                        runtime data check, imports the package)
+  --store-root PATH    additionally audit a durable-log store root
+                       (repeatable; runtime data check)
 """
 
 from __future__ import annotations
@@ -116,6 +118,10 @@ def main(argv=None) -> int:
     ap.add_argument("--publish-root", action="append", default=[],
                     metavar="PATH",
                     help="also audit a publish root (runtime data check)")
+    ap.add_argument("--store-root", action="append", default=[],
+                    metavar="PATH",
+                    help="also audit a durable-log store root "
+                         "(runtime data check)")
     args = ap.parse_args(argv)
 
     rules_catalog = all_rules()
@@ -171,6 +177,17 @@ def main(argv=None) -> int:
             print(f"WARNING: {root}: {w}", file=sys.stderr)
         kept += [
             Finding(file=root, line=1, rule="publish-dir", message=e)
+            for e in errors
+        ]
+
+    # store roots (opt-in runtime audit of the durable cold tier)
+    for root in args.store_root:
+        from .publish import check_store_root
+        errors, warnings = check_store_root(root)
+        for w in warnings:
+            print(f"WARNING: {root}: {w}", file=sys.stderr)
+        kept += [
+            Finding(file=root, line=1, rule="store-dir", message=e)
             for e in errors
         ]
 

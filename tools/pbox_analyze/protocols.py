@@ -208,6 +208,76 @@ RESHARD = ProtocolSpec(
     },
 )
 
+# --------------------------------------------------------------------------- #
+# 7. Durable-log segment lifecycle (PR 17: crash-consistent cold tier).
+#    Two specs share one rule name: the SegmentWriter typestate (a segment
+#    is open -> append* -> seal/abort; only sealed segments may be read or
+#    reach a manifest) and the compaction barrier (the staged merge output
+#    is swapped in ONLY after its manifest committed — swapping first
+#    would lose rows on a crash between swap and commit).
+# --------------------------------------------------------------------------- #
+SEGMENT_WRITER = ProtocolSpec(
+    rule="protocol-segment-lifecycle",
+    name="segment-writer",
+    description=(
+        "SegmentWriter typestate: open -> append* -> seal (or abort); "
+        "info()/manifest use only after seal; nothing after either"
+    ),
+    states=("open", "sealed", "aborted"),
+    initial="open",
+    # ctor-tracked ONLY (receivers=None): `append` is too common a method
+    # name (list.append) to match on arbitrary receivers
+    ctors=frozenset({"SegmentWriter"}),
+    transitions={
+        "append": {"open": "open"},
+        "seal": {"open": "sealed"},
+        "abort": {"open": "aborted", "sealed": "aborted"},
+    },
+    require_state={
+        "info": {"sealed"},
+    },
+    end_states=frozenset({"sealed", "aborted"}),
+    hints={
+        "append": "a sealed/aborted segment file can never grow again",
+        "seal": "seal() twice would re-fsync a closed fd",
+        "info": (
+            "reading an unsealed segment observes an unsynced, unframed "
+            "tail — only sealed segments may be read or manifested"
+        ),
+    },
+)
+
+SEGMENT_COMPACT = ProtocolSpec(
+    rule="protocol-segment-lifecycle",
+    name="segment-compact",
+    description=(
+        "compaction barrier: stage the merged segment (_compact_write), "
+        "commit the swap manifest (_commit_manifest), only then "
+        "_swap_segments — never swap before the manifest committed"
+    ),
+    states=("idle", "written", "committed", "swapped"),
+    initial="idle",
+    scope_ops=True,
+    trigger="_swap_segments",
+    transitions={
+        "_compact_write": {"idle": "written", "swapped": "written"},
+        "_commit_manifest": {"written": "committed"},
+        "_swap_segments": {"committed": "swapped"},
+    },
+    end_states=None,
+    hints={
+        "_commit_manifest": (
+            "committing before the staged output exists references a "
+            "segment a crash can vanish"
+        ),
+        "_swap_segments": (
+            "swapping (and unlinking the replaced files) before the "
+            "manifest committed loses the bucket on a crash between the "
+            "two — the manifest commit IS the durability point"
+        ),
+    },
+)
+
 PROTOCOLS = [
     SPARSE_PASS,
     STREAM_LIFECYCLE,
@@ -215,6 +285,8 @@ PROTOCOLS = [
     PUBLISH_ORDER,
     SPAN_PAIRING,
     RESHARD,
+    SEGMENT_WRITER,
+    SEGMENT_COMPACT,
 ]
 
 # --------------------------------------------------------------------------- #
@@ -257,6 +329,17 @@ OBLIGATIONS = [
         why=(
             "close() is the two-phase escalation: the graceful stop/drain "
             "must be requested before the hard kill"
+        ),
+    ),
+    ImplObligation(
+        cls="LogStore",
+        methods=("commit", "rewrite", "compact"),
+        must_call=("_commit_manifest",),
+        why=(
+            "every durable mutation becomes real ONLY at the manifest "
+            "commit point (temp/fsync/rename then CURRENT-last) — a "
+            "mutation path that skips it leaves state a crash silently "
+            "discards"
         ),
     ),
     ImplObligation(

@@ -1,4 +1,10 @@
-"""publish-dir: donefile/manifest consistency lint for one publish root.
+"""publish-dir / store-dir: runtime-data consistency lints.
+
+Two per-root audits live here, both opt-in (they check *data produced
+at runtime*, not source): ``check_publish_root`` for a delivery-plane
+publish root and ``check_store_root`` for a durable cold-tier log root
+(``sparse/logstore.py`` layout — see ARCHITECTURE.md "Durable cold
+tier").
 
 Unlike the AST passes this audits *data produced at runtime*, so it is
 opt-in per root (``tools/pbox_analyze.py --publish-root PATH`` or the
@@ -105,5 +111,139 @@ def check_publish_root(root: str) -> tuple:
             warnings.append(
                 f"orphan dir {name}/ (uploaded but never donefiled — "
                 "mid-publish, or a crashed publish to garbage-collect)"
+            )
+    return errors, warnings
+
+
+def check_store_root(root: str) -> tuple:
+    """(errors, warnings) for one durable-log store root.
+
+    Recovery trusts exactly what CURRENT's manifest references, so the
+    audit draws the same line the store's own crash rules draw:
+
+      errors (the committed state is damaged — recovery would fail or
+      lie):
+        * CURRENT missing while manifests/segments exist, or naming a
+          manifest that is absent/unparsable
+        * a CURRENT-referenced segment missing, size- or crc-mismatched
+          against the manifest pin, or failing frame-level verification
+      warnings (crash debris — legal by design, worth garbage-collecting):
+        * segment files referenced by NO on-disk manifest (torn/aborted
+          writes, unlinked-compaction leftovers)
+        * manifests newer than CURRENT (a commit killed between the
+          manifest rename and the CURRENT swing) or gaps in the retained
+          manifest-generation chain
+    """
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from paddlebox_tpu.sparse.logstore import (
+        LogStoreCorrupt,
+        SegmentInfo,
+        read_segment,
+    )
+
+    errors: list = []
+    warnings: list = []
+    if not os.path.isdir(root):
+        return [f"{root}: not a directory"], []
+    names = sorted(os.listdir(root))
+    seg_names = [n for n in names if n.startswith("seg-") and
+                 n.endswith(".seg")]
+    man_names = [n for n in names if n.startswith("manifest-") and
+                 n.endswith(".json")]
+
+    current_path = os.path.join(root, "CURRENT")
+    current = None
+    if os.path.exists(current_path):
+        with open(current_path) as fh:
+            current = fh.read().strip() or None
+    if current is None:
+        if man_names or seg_names:
+            errors.append(
+                "CURRENT missing but manifests/segments exist — the "
+                "commit point never landed; recovery sees an empty store"
+            )
+        return errors, warnings  # fresh root: nothing else to check
+
+    import json as _json
+
+    def _load_manifest(name):
+        with open(os.path.join(root, name)) as fh:
+            man = _json.load(fh)
+        if int(man.get("version", -1)) != 1:
+            raise ValueError(f"unsupported version {man.get('version')!r}")
+        return man
+
+    try:
+        live_man = _load_manifest(current)
+    except (OSError, ValueError) as e:
+        return [f"CURRENT -> {current}: unreadable/unparsable ({e})"], []
+
+    # the committed generation must verify end to end
+    for d in live_man.get("segments", ()):
+        info = SegmentInfo.from_json(d)
+        path = os.path.join(root, info.name)
+        where = f"{current} -> {info.name}"
+        if not os.path.exists(path):
+            errors.append(f"{where}: referenced segment missing")
+            continue
+        if os.path.getsize(path) != info.n_bytes:
+            errors.append(
+                f"{where}: size {os.path.getsize(path)} != manifest pin "
+                f"{info.n_bytes}"
+            )
+            continue
+        try:
+            read_segment(path, expect_bytes=info.n_bytes,
+                         expect_crc=info.crc)
+        except LogStoreCorrupt as exc:
+            errors.append(f"{where}: {exc}")
+
+    # crash debris: referenced-by-nothing segments, unreachable manifests
+    referenced: set = set()
+    gens: list = []
+    for name in man_names:
+        try:
+            man = _load_manifest(name)
+        except (OSError, ValueError):
+            if name != current:
+                warnings.append(f"orphan manifest {name}: unparsable "
+                                "(torn commit debris)")
+            continue
+        gens.append(int(man.get("gen", 0)))
+        referenced.update(d["name"] for d in man.get("segments", ()))
+    cur_gen = int(live_man.get("gen", 0))
+    import zlib as _zlib
+
+    for name in seg_names:
+        if name not in referenced:
+            # strict framing check against the file's own bytes: orphan
+            # mode would silently stop at the tear, we want to NAME it
+            try:
+                path = os.path.join(root, name)
+                with open(path, "rb") as fh:
+                    data = fh.read()
+                read_segment(path, expect_bytes=len(data),
+                             expect_crc=_zlib.crc32(data))
+                tail = ""
+            except (OSError, LogStoreCorrupt):
+                tail = ", torn"
+            warnings.append(
+                f"orphan segment {name} (referenced by no manifest{tail} "
+                "— crashed write/compaction debris, safe to delete)"
+            )
+    for g in sorted(gens):
+        if g > cur_gen:
+            warnings.append(
+                f"manifest-{g:08d}.json is newer than CURRENT (gen "
+                f"{cur_gen}) — a commit was killed before the CURRENT "
+                "swing; the generation never became real"
+            )
+    retained = sorted(g for g in gens if g <= cur_gen)
+    for a, b in zip(retained, retained[1:]):
+        if b != a + 1:
+            warnings.append(
+                f"manifest chain gap: gen {a} -> {b} (generations "
+                "between were dropped out of retention order)"
             )
     return errors, warnings
