@@ -412,6 +412,10 @@ class ShardedSparseTable(SparseTable):
         new_n = int(new_mesh.shape[DATA_AXIS])
         if new_n < 1:
             raise ValueError(f"new mesh has no {DATA_AXIS!r} shards")
+        # validate the new mesh placement BEFORE any fallible phase: a
+        # non-contiguous process->position layout must fail here, while
+        # nothing has migrated or mutated (all-or-nothing contract)
+        self._checked_local_pos(new_mesh)
         from paddlebox_tpu import telemetry
 
         self.flush()
@@ -556,11 +560,20 @@ class ShardedSparseTable(SparseTable):
         from paddlebox_tpu.utils import faults
 
         faults.inject("reshard.cutover")
+        # the last fallible step runs before the first mutation: a bad
+        # mesh placement aborts with the store and census fully intact
+        new_local_pos = self._checked_local_pos(new_mesh)
         if staged.get("multi"):
             # ownership commit: merge rows that moved to this process,
-            # rebuild the store without the rows that left
+            # rebuild the store without the rows that left.  The wire
+            # payload is hottest-first; the store contract is sorted
+            # unique keys, so re-sort before merging (keys are globally
+            # unique — each has exactly one old owner process)
             if staged["in_keys"].shape[0]:
-                self._store.update(staged["in_keys"], staged["in_rows"])
+                order = np.argsort(staged["in_keys"], kind="stable")
+                self._store.update(
+                    staged["in_keys"][order], staged["in_rows"][order]
+                )
             if staged["drop_keys"].shape[0]:
                 keys, rows = self._store.materialize()
                 keep = ~np.isin(keys, staged["drop_keys"])
@@ -571,11 +584,9 @@ class ShardedSparseTable(SparseTable):
             self._carry_freq = self._census.planner.evidence()
         ch, self._census_channel = self._census_channel, None
         self._census = None
-        if ch is not None:
-            ch.close()
         self.mesh = new_mesh
         self.n_shards = int(new_mesh.shape[DATA_AXIS])
-        self._local_pos = self._checked_local_pos(new_mesh)
+        self._local_pos = new_local_pos
         # per-shard caches are keyed to the old split: drop and let
         # _caches() rebuild for the new shard count (re-seeded from the
         # next passes' censuses + the carried frequency evidence)
@@ -585,6 +596,11 @@ class ShardedSparseTable(SparseTable):
         self._shard_keys = None
         # serve-scratch sizing learned under the old split is stale
         self._last_serve_n = 0
+        # close the old census channel LAST: everything above is either
+        # pre-mutation validation or infallible assignment, so an abort
+        # can never be asked to restore an already-closed channel
+        if ch is not None:
+            ch.close()
 
     def _reshard_abort(self, old: dict) -> None:
         """Restore the old shard map on ANY failed branch: every field

@@ -550,6 +550,10 @@ class LogStore:
 
     # -- write path ---------------------------------------------------------- #
     def _bucket_of(self, q: np.ndarray) -> np.ndarray:
+        if self.n_buckets == 1:
+            # shift-by-64 is undefined for uint64 (x86 leaves the value
+            # unchanged): one bucket means every key maps to bucket 0
+            return np.zeros(q.shape[0], dtype=np.int64)
         return (splitmix64(q) >> self._shift).astype(np.int64)
 
     def append(self, keys: np.ndarray, vals: np.ndarray) -> None:
@@ -638,6 +642,11 @@ class LogStore:
             f"manifest-{target:08d}.json\n".encode("utf-8"),
         )
         self.gen = target
+        if not self.keep_history:
+            # a no-history store needs only the committed manifest; sweep
+            # here (every commit point) so long runs of per-merge-batch
+            # commits can't accumulate manifest files
+            self._drop_old_manifests()
         return target
 
     def rewrite(self, keys: np.ndarray, vals: np.ndarray) -> int:
@@ -676,7 +685,6 @@ class LogStore:
             if not self.keep_history:
                 for info in old:
                     self._unlink(info.name)
-                self._drop_old_manifests()
             self._update_gauges()
             return self.gen
 
@@ -688,11 +696,25 @@ class LogStore:
             pass
 
     def _drop_old_manifests(self) -> None:
-        for g in range(max(self.gen - 8, 1), self.gen):
+        """Unlink every manifest below the committed generation (orphans
+        ABOVE it — a crash between manifest write and CURRENT swing —
+        are left for the retry to overwrite)."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith("manifest-") and name.endswith(".json")):
+                continue
             try:
-                os.unlink(self._manifest_path(g))
-            except OSError:
-                pass
+                g = int(name[len("manifest-"):-len(".json")])
+            except ValueError:
+                continue
+            if g < self.gen:
+                try:
+                    os.unlink(os.path.join(self.root, name))
+                except OSError:
+                    pass
 
     # -- compaction ---------------------------------------------------------- #
     def buckets_over_threshold(self) -> List[int]:

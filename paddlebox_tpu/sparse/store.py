@@ -204,8 +204,17 @@ class BucketStore:
                     with np.load(self._path(b)) as z:
                         sk = np.ascontiguousarray(z["keys"], dtype=np.uint64)
                         sv = np.ascontiguousarray(z["vals"], dtype=np.float32)
-                        crc = int(z["crc"])
-                    if _spill_crc(sk, sv) != crc:
+                        crc = int(z["crc"]) if "crc" in z.files else None
+                    if crc is None:
+                        # pre-checksum spill format: loadable, just
+                        # unverifiable — warn instead of treating a valid
+                        # legacy file as corruption (the next spill of
+                        # this bucket rewrites it with a crc)
+                        logger.warning(
+                            "spill bucket %d: legacy file without "
+                            "checksum, loaded unverified", b,
+                        )
+                    elif _spill_crc(sk, sv) != crc:
                         raise StoreCorrupt(
                             f"spill bucket {b}: checksum mismatch"
                         )
@@ -241,6 +250,10 @@ class BucketStore:
     def _bucket_of(self, q: np.ndarray) -> np.ndarray:
         """Bucket id per key: top bits of the splitmix64 mix, so skewed key
         spaces (small sequential ids) spread as evenly as hash feasigns."""
+        if self.n_buckets == 1:
+            # shift-by-64 is undefined for uint64 (x86 leaves the value
+            # unchanged): one bucket means every key maps to bucket 0
+            return np.zeros(q.shape[0], dtype=np.int64)
         return (splitmix64(q) >> self._shift).astype(np.int64)
 
     def _split(self, q: np.ndarray) -> Iterator[Tuple[int, np.ndarray]]:
@@ -318,6 +331,13 @@ class BucketStore:
         """Overwrite/insert rows for sorted unique keys ``q`` (end-of-pass
         write-back).  Existing keys update in place; buckets receiving new
         keys are rebuilt with one sorted insert each."""
+        # the sorted-insert merge below silently builds unsorted buckets
+        # (= keys lost to every later searchsorted) on unsorted input, so
+        # the contract is enforced loudly, not assumed
+        if q.shape[0] > 1 and not bool(np.all(q[:-1] < q[1:])):
+            raise ValueError(
+                "BucketStore.update requires sorted unique keys"
+            )
 
         def work(b, idx):
             bk, bv = self._get(b)

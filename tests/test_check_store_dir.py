@@ -14,9 +14,9 @@ from check_store_dir import check_store_root, main  # noqa: E402
 from paddlebox_tpu.sparse.logstore import LogStore  # noqa: E402
 
 
-def _write_root(tmp_path, passes=3, compact=False):
+def _write_root(tmp_path, passes=3, compact=False, **kw):
     root = str(tmp_path / "log")
-    ls = LogStore(root, n_cols=3, n_buckets=2, compact_threshold=2)
+    ls = LogStore(root, n_cols=3, n_buckets=2, compact_threshold=2, **kw)
     k = np.arange(1, 60, dtype=np.uint64)
     for p in range(passes):
         v = (k.astype(np.float64)[:, None] * [1, 2, 3] * 0.01 + p)
@@ -115,8 +115,20 @@ def test_manifest_newer_than_current_warns(tmp_path):
 
 
 def test_manifest_chain_gap_warns(tmp_path):
-    root = _write_root(tmp_path, passes=4)
+    # keep_history roots retain every generation (no-history roots sweep
+    # old manifests at each commit, so only they can have a chain)
+    root = _write_root(tmp_path, passes=4, keep_history=True)
     os.remove(os.path.join(root, "manifest-00000002.json"))
     errors, warnings = check_store_root(root)
     assert errors == []
     assert any("chain gap" in w for w in warnings)
+
+
+def test_no_history_root_has_no_manifest_chain(tmp_path):
+    """keep_history=False commits sweep superseded manifests — a long
+    run's root must stay lint-clean with only the committed manifest
+    (r17 review finding)."""
+    root = _write_root(tmp_path, passes=6)
+    manifests = [n for n in os.listdir(root) if n.startswith("manifest-")]
+    assert len(manifests) == 1
+    assert check_store_root(root) == ([], [])

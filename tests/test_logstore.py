@@ -145,6 +145,48 @@ class TestLogStore:
         np.testing.assert_array_equal(vals[0], _rows([5], salt=9.0)[0])
         again.close()
 
+    def test_single_bucket_store(self, tmp_path):
+        """n_buckets=1 makes the bucket shift 64 — undefined for numpy
+        uint64; every key must land in bucket 0 (r17 review finding)."""
+        ls = _store(tmp_path, n_buckets=1)
+        k = np.array([1, 2**63, 2**64 - 1], dtype=np.uint64)
+        ls.append(k, _rows(k))
+        ls.commit()
+        ls.close()
+        again = _store(tmp_path, n_buckets=1)
+        mk, mv = again.materialize()
+        np.testing.assert_array_equal(mk, k)
+        np.testing.assert_array_equal(mv, _rows(k))
+        _, found = again.lookup(k)
+        assert found.all()
+        again.close()
+
+    def test_no_history_manifest_files_bounded(self, tmp_path):
+        """keep_history=False: per-merge-batch commit()s must not
+        accumulate manifest-<gen>.json files — only the committed
+        generation's manifest survives (r17 review finding)."""
+        ls = _store(tmp_path / "flat")
+        for i in range(12):
+            k = np.arange(1 + i, 20 + i, dtype=np.uint64)
+            ls.append(k, _rows(k, salt=float(i)))
+            ls.commit()
+        manifests = sorted(
+            n for n in os.listdir(str(tmp_path / "flat"))
+            if n.startswith("manifest-")
+        )
+        assert manifests == [f"manifest-{ls.gen:08d}.json"]
+        ls.close()
+        # keep_history stores keep every generation materializable
+        hs = _store(tmp_path / "hist", keep_history=True)
+        for i in range(3):
+            k = np.arange(1, 5, dtype=np.uint64)
+            hs.append(k, _rows(k, salt=float(i)))
+            hs.commit()
+        hist = [n for n in os.listdir(str(tmp_path / "hist"))
+                if n.startswith("manifest-")]
+        assert len(hist) == 3
+        hs.close()
+
     def test_lookup_skips_segments_without_disk(self, tmp_path):
         ls = _store(tmp_path)
         lo = np.arange(1, 50, dtype=np.uint64)

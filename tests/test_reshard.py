@@ -128,6 +128,32 @@ class TestReshardGuards:
         assert table.n_shards == 4
         table.close()
 
+    def test_cutover_merges_hottest_first_payload(self, mesh2):
+        """Multi-host staged rows arrive hottest-first (unsorted), but
+        BucketStore.update requires sorted unique keys — the cutover must
+        re-sort before merging, or buckets lose their sorted invariant
+        and migrated rows silently vanish from later lookups (r17 review
+        finding; exercised directly since tier-1 runs single-process)."""
+        tconf = SparseTableConfig(embedding_dim=4)
+        table = ShardedSparseTable(tconf, mesh2, seed=0)
+        rng = np.random.default_rng(5)
+        keys = np.unique(rng.integers(1, 2**63, size=64, dtype=np.uint64))
+        rows = rng.standard_normal(
+            (keys.shape[0], tconf.row_width + 1)  # +g2sum, the store row
+        ).astype(np.float32)
+        order = rng.permutation(keys.shape[0])  # wire order: by frequency
+        staged = {
+            "multi": True,
+            "drop_keys": np.empty(0, np.uint64),
+            "in_keys": keys[order],
+            "in_rows": rows[order],
+        }
+        table._reshard_cutover(mesh2, staged)
+        got, found = table._store.lookup(keys)
+        assert found.all(), "migrated rows vanished after cutover merge"
+        np.testing.assert_array_equal(got, rows)
+        table.close()
+
     def test_same_mesh_reshard_is_a_no_op(self, mesh2):
         tconf = SparseTableConfig(embedding_dim=4)
         table = ShardedSparseTable(tconf, mesh2, seed=0)

@@ -1,5 +1,7 @@
 """Bucketed host store (sparse/store.py) — the CPU/SSD tier analog."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -142,6 +144,62 @@ class TestBucketStore:
     def test_bad_bucket_count_rejected(self):
         with pytest.raises(ValueError):
             BucketStore(n_cols=1, n_buckets=3)
+
+    def test_single_bucket_store(self):
+        """n_buckets=1 makes the bucket shift 64 — undefined for numpy
+        uint64 (x86 leaves the value unchanged); every key must land in
+        bucket 0 (r17 review finding)."""
+        st = BucketStore(n_cols=2, n_buckets=1)
+        k = np.array([1, 2**63, 2**64 - 1], dtype=np.uint64)
+        v = _vals_for(k, 2)
+        st.update(k, v)
+        got, found = st.lookup(k)
+        assert found.all()
+        np.testing.assert_array_equal(got, v)
+        keys, _ = st.materialize()
+        np.testing.assert_array_equal(keys, k)
+
+    def test_update_unsorted_or_duplicate_keys_loud(self):
+        """The sorted-insert merge silently corrupts buckets on unsorted
+        input (keys lost to later searchsorted), so the contract is
+        enforced loudly (r17 review finding)."""
+        st = BucketStore(n_cols=1, n_buckets=4)
+        with pytest.raises(ValueError, match="sorted unique"):
+            st.update(np.array([9, 3], dtype=np.uint64),
+                      np.zeros((2, 1), np.float32))
+        with pytest.raises(ValueError, match="sorted unique"):
+            st.update(np.array([3, 3], dtype=np.uint64),
+                      np.zeros((2, 1), np.float32))
+        assert st.n == 0  # refused before any bucket mutated
+
+    def test_legacy_spill_without_crc_loads(self, tmp_path):
+        """Spill files written before the checksum rode along have no
+        'crc' entry: they must load unverified (with a warning), not be
+        treated as corruption (r17 review finding)."""
+        from paddlebox_tpu.utils.monitor import stats
+
+        st = BucketStore(n_cols=1, n_buckets=4,
+                         spill_dir=str(tmp_path / "s"), max_resident=1)
+        k = np.arange(1, 64, dtype=np.uint64)
+        v = np.full((k.shape[0], 1), 2.5, np.float32)
+        st.update(k, v)
+        assert st.spill_writes > 0
+        # rewrite every spilled bucket in the legacy (crc-less) format
+        rewritten = 0
+        for b in range(st.n_buckets):
+            p = st._path(b)
+            if not os.path.exists(p):
+                continue
+            with np.load(p) as z:
+                sk, sv = z["keys"], z["vals"]
+            np.savez(p, keys=sk, vals=sv)
+            rewritten += 1
+        assert rewritten > 0
+        before = stats.get("store.spill_corrupt")
+        got, found = st.lookup(k)  # cycles every bucket through reload
+        assert found.all()
+        np.testing.assert_array_equal(got, v)
+        assert stats.get("store.spill_corrupt") == before
 
 
 class TestSparseTableIntegration:
