@@ -33,6 +33,36 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+_RUN_IDENTITY: dict = {}
+
+
+def _run_identity() -> dict:
+    """Cached run-identity stamp (git sha, start time, backend, jax
+    version, host) for every emitted row.  Resolved ONCE and never from a
+    backend query — emit() also runs on the hang-watchdog thread while the
+    axon tunnel is wedged, so this must never touch a device RPC.  main()
+    prewarms it before backend init for exactly that reason."""
+    if not _RUN_IDENTITY:
+        try:
+            from paddlebox_tpu.telemetry.flight import run_identity
+
+            _RUN_IDENTITY.update(run_identity())
+        except Exception as e:  # the stamp is telemetry, never a failure
+            _RUN_IDENTITY.update({"error": repr(e)[:120]})
+    return dict(_RUN_IDENTITY)
+
+
+def _history_path() -> str:
+    """Bench-history target: PBOX_BENCH_HISTORY overrides (empty string
+    disables the append), default is BENCH_HISTORY.jsonl next to bench.py
+    so repeated runs in one checkout accumulate the per-(metric, backend)
+    trend tools/bench_trend.py gates on."""
+    if "PBOX_BENCH_HISTORY" in os.environ:
+        return os.environ["PBOX_BENCH_HISTORY"]
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_HISTORY.jsonl")
+
+
 def emit(obj: dict) -> None:
     """Print a result JSON line to stdout and flush immediately.
 
@@ -42,8 +72,23 @@ def emit(obj: dict) -> None:
     so the final line supersedes the partial one — but if the process dies
     mid-naive (the axon tunnel can drop at any point), the flushed partial
     line still yields a parsed artifact instead of rc!=0 with parsed:null
-    (the r2/r3 failure shape)."""
-    print(json.dumps(obj), flush=True)
+    (the r2/r3 failure shape).
+
+    Every row is stamped with the cached run identity and appended to the
+    bench history file (best-effort: a read-only checkout must not turn a
+    measurement into a crash) — including ``backend: unavailable`` rows,
+    so a tunnel outage is an explicit history entry, not a silent gap."""
+    if "run" not in obj:
+        obj = {**obj, "run": _run_identity()}
+    line = json.dumps(obj)
+    print(line, flush=True)
+    path = _history_path()
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass  # history append is best-effort; stdout is the artifact
 
 
 def telemetry_summary(max_counters: int = 40) -> dict:
@@ -174,6 +219,14 @@ def init_backend(max_tries: int = 5, base_delay: float = 5.0,
                 )
 
                 install_compile_listener()
+                # cache the REAL platform into the run identity now that
+                # the backend answered — dump/emit paths must never ask
+                # jax.default_backend() themselves (it can hang the same
+                # way the device query does)
+                from paddlebox_tpu.telemetry.flight import set_run_backend
+
+                set_run_backend(devs[0].platform)
+                _RUN_IDENTITY.clear()  # re-resolve with the live backend
                 log(f"backend ok (attempt {attempt}): "
                     f"{[f'{d.platform}:{d.id}' for d in devs]}")
                 return devs
@@ -3097,6 +3150,66 @@ def stage_trainer_path(backend, args, tconf, trconf, n_slots, dense, bsz,
           "backend": backend, "telemetry": telemetry_summary()})
 
 
+def stage_health(backend, args, tconf, trconf, n_slots, dense, bsz,
+                 n_ins, hidden) -> None:
+    """Run-health smoke: a short multi-pass training run with ONE injected
+    degradation — a fault-plan pass whose batches are label-poisoned to
+    NaN (site ``train.nan``, nan_policy=skip_batch) — and a hard assert
+    that the health monitor converts it into an alert.  The row carries
+    the monitor snapshot, the alert must show up in this row's telemetry
+    counter summary (``health.alerts{...}``), and emit() lands the same
+    row in BENCH_HISTORY.jsonl, so the smoke proves the whole plane:
+    signal -> rule -> counter -> row -> history."""
+    import dataclasses
+
+    from paddlebox_tpu.sparse.table import SparseTable
+    from paddlebox_tpu.telemetry import get_monitor
+    from paddlebox_tpu.train.trainer import Trainer
+    from paddlebox_tpu.utils import faults
+
+    monitor = get_monitor()
+    trconf = dataclasses.replace(trconf, nan_policy="skip_batch",
+                                 check_nan_inf=True, scan_steps=1)
+    n_passes = max(monitor.warmup + 3, 6)
+    bad_pass = n_passes - 2  # after warmup: the alert must fire, not bed in
+    with tempfile.TemporaryDirectory() as td:
+        conf, ds, _, model = _data_and_model(
+            td, args, tconf, n_slots, dense, bsz, 6 * bsz, hidden,
+            args.model)
+        table = SparseTable(tconf, seed=0)
+        trainer = Trainer(model, tconf, trconf, seed=0)
+        try:
+            for p in range(n_passes):
+                table.begin_pass(ds.unique_keys())
+                if p == bad_pass:
+                    faults.install(faults.FaultPlan(
+                        {"train.nan": "p:1.0"}, seed=0))
+                try:
+                    trainer.train_from_dataset(ds, table, drop_last=True)
+                finally:
+                    faults.clear()
+                table.end_pass()
+        finally:
+            ds.close()
+    snap = monitor.snapshot()
+    alerts = [a["rule"] for a in snap.get("recent", [])]
+    log(f"health smoke: {snap['alerts_total']} alert(s) over "
+        f"{snap['windows']} window(s): {sorted(set(alerts))}")
+    if not snap["alerts_total"]:
+        raise RuntimeError(
+            "health smoke failed: injected train.nan degradation fired "
+            "no alert — the run-health plane is not watching")
+    tele = telemetry_summary()
+    if not any(k.startswith("health.alerts") for k in tele["counters"]):
+        raise RuntimeError(
+            "health smoke failed: alert fired but health.alerts{...} "
+            "is missing from the row's telemetry counter summary")
+    emit({"metric": "health_smoke_alerts",
+          "value": snap["alerts_total"], "unit": "alerts",
+          "vs_baseline": None, "backend": backend,
+          "health": snap, "telemetry": tele})
+
+
 def stage_ops(backend, args) -> None:
     """Per-op micro-benchmarks of the CTR op zoo on the live backend — the
     analog of the reference's op_tester harness
@@ -3348,6 +3461,12 @@ def main() -> None:
                     help="append rate (records/s) for --streaming")
     ap.add_argument("--stream-staleness", type=float, default=1.5,
                     help="freshness budget (s) for --streaming")
+    ap.add_argument("--health", action="store_true",
+                    help="run-health smoke: short multi-pass training run "
+                         "with one injected degradation (a NaN-poisoned "
+                         "pass); asserts the health monitor fires and the "
+                         "alert lands in the row's telemetry summary and "
+                         "BENCH_HISTORY.jsonl")
     ap.add_argument("--all", action="store_true",
                     help="one process, every measurement: headline (plain "
                          "AND scan trainer path) + naive, device profile, "
@@ -3416,6 +3535,8 @@ def main() -> None:
         fail_metric, fail_unit = "pallas_vs_xla_gather_scatter", "ms"
     elif args.device_profile:
         fail_metric, fail_unit = f"{args.model}_device_profile", "ms/step"
+    elif args.health:
+        fail_metric, fail_unit = "health_smoke_alerts", "alerts"
     elif args.pass_boundary:
         fail_metric, fail_unit = "pass_boundary_gap_ms", "ms"
     elif args.hbm_cache:
@@ -3432,6 +3553,10 @@ def main() -> None:
     else:  # headline and --all lead with the headline metric
         fail_metric = f"{args.model}_samples_per_sec"
         fail_unit = "samples/sec"
+    # prewarm the run-identity stamp BEFORE the first backend RPC: the
+    # hang-watchdog's emit_unavailable() must never be the first caller
+    # (a first-time resolve on that thread would race a wedged process)
+    _run_identity()
     devs = init_backend(metric=fail_metric, unit=fail_unit)
     # "axon"/"tpu" = real chip through the tunnel; "cpu" would mean the
     # tunnel was unavailable and the number is NOT a TPU number — the judge
@@ -3494,6 +3619,10 @@ def main() -> None:
 
     if args.device_profile:
         stage_device_profile(*common, scan_k=args.scan)
+        return
+
+    if args.health:
+        stage_health(*common)
         return
 
     if args.pass_boundary:

@@ -197,6 +197,21 @@ class _Flags:
         # the operational escape hatch if the log path misbehaves (the
         # table then runs the pre-durability in-RAM lifecycle)
         "durable_store": True,
+        # run-health plane (telemetry/health.py): PBOX_HEALTH_ENABLED=0
+        # silences the per-pass rule evaluation entirely (signals still
+        # flow; nothing alerts); alpha is the EWMA smoothing factor the
+        # z-score baselines use; warmup is how many windows a baseline
+        # rule observes before it may fire (steady-state rules like the
+        # recompile check wait the same count); max_alerts bounds the
+        # in-process recent-alert ring /healthz serves
+        "health_enabled": True,
+        "health_ewma_alpha": 0.3,
+        "health_warmup": 3,
+        "health_max_alerts": 256,
+        # bench trend history (bench.py + tools/bench_trend.py): path of
+        # the JSONL every emitted bench row appends to ("" = the default
+        # BENCH_HISTORY.jsonl next to bench.py)
+        "bench_history": "",
     }
 
     def __getattr__(self, name: str):

@@ -489,7 +489,11 @@ class ScoringServer:
                          # admission-wait estimate for the queue as it
                          # stands: the autoscaler's latency-pressure
                          # signal (EWMA service time × queue / width)
-                         "estimated_wait_s": server.gate.estimated_wait_s()},
+                         "estimated_wait_s": server.gate.estimated_wait_s(),
+                         # run-health plane: this process's alert summary
+                         # (telemetry/health.py) — the router's fleet view
+                         # aggregates it across replicas
+                         "health": telemetry.health_view()},
                     )
                 elif self.path == "/models":
                     # per-model version lineage + freshness: base tag,

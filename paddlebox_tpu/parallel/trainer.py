@@ -30,7 +30,9 @@ overlaps the all_to_alls with the dense tower compute where possible.
 
 from __future__ import annotations
 
+import math
 import os
+import time
 from typing import Iterable, Iterator, Optional, Sequence
 
 import jax
@@ -380,6 +382,20 @@ class MultiChipTrainer:
             mstate["auc"] = update_auc_state(
                 mstate["auc"], primary, batch["labels"], batch["ins_mask"]
             )
+            # grad-norm health stream in the donated metric state (no
+            # step-signature change): [sum of squared grad norms,
+            # steps] per device; pass end sums the device axis.  With
+            # sync_step the psummed pgrads are identical per device —
+            # the device-axis mean (sum/steps) stays the step value.
+            # "gn" is always present: _init_mstate seeds it and the
+            # restore path backfills it.
+            gsq = jnp.zeros((), jnp.float32)
+            for leaf in jax.tree.leaves(pgrads):
+                gsq += jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+            gsq += jnp.sum(jnp.square(row_grads.astype(jnp.float32)))
+            mstate["gn"] = mstate["gn"] + jnp.stack(
+                [gsq, jnp.ones((), jnp.float32)]
+            )
             if n_tasks > 1:
                 mstate["task"] = jax.vmap(
                     lambda s, pr, lb: update_auc_state(
@@ -517,7 +533,10 @@ class MultiChipTrainer:
             # the step donates mstate: copy so the caller's reference (often
             # trainer.last_metric_state itself) is not invalidated by the
             # first step's buffer donation
-            return self._copy_state(auc_state)
+            out = self._copy_state(auc_state)
+            if "gn" not in out:
+                out["gn"] = self._stack_local(jnp.zeros((2,), jnp.float32))
+            return out
         if auc_state is not None and (self.n_tasks > 1 or self.metric_group):
             raise ValueError(
                 "pass trainer.last_metric_state (dict) to continue metrics "
@@ -527,7 +546,8 @@ class MultiChipTrainer:
         mstate = {
             "auc": self._copy_state(auc_state)
             if auc_state is not None
-            else self.init_auc()
+            else self.init_auc(),
+            "gn": self._stack_local(jnp.zeros((2,), jnp.float32)),
         }
         if self.n_tasks > 1:
             base = stack_auc_states(
@@ -603,6 +623,15 @@ class MultiChipTrainer:
         pending_grads: list = []  # device grads fetched one step behind
         pull_every = max(self.conf.sync_weight_step, 1)
         mstate = self._init_mstate(auc_state)
+        from paddlebox_tpu.parallel.multiprocess import merge_device_axis
+
+        # grad-norm baseline: the accumulator carries across continued
+        # passes — snapshot NOW (a lockstep device-axis merge on every
+        # rank), the first step donates the buffer
+        gn_base = np.asarray(
+            merge_device_axis(mstate["gn"]), dtype=np.float64
+        )
+        pass_t0 = time.monotonic()
         values, g2sum = table.values, table.g2sum
         losses, counts, n_steps = [], [], 0
         uses_rank = getattr(self.model, "uses_rank_offset", False)
@@ -868,9 +897,31 @@ class MultiChipTrainer:
             else:
                 # psummed loss is replicated across the axis
                 metrics["loss"] = float(per_step[:, 0].mean())
+            metrics["samples"] = float(cnts.sum())
         else:
             metrics["loss"] = 0.0
+            metrics["samples"] = 0.0
         metrics["steps"] = n_steps
+        metrics["duration_s"] = time.monotonic() - pass_t0
+        gn_now = np.asarray(merge_device_axis(mstate["gn"]), dtype=np.float64)
+        d_sq, d_n = gn_now[0] - gn_base[0], gn_now[1] - gn_base[1]
+        if d_n > 0:
+            grad_norm = float(np.sqrt(d_sq / d_n)) if d_sq >= 0 else float(
+                "nan")
+            metrics["grad_norm"] = grad_norm
+            telemetry.gauge(
+                "train.grad_norm",
+                "per-pass RMS global gradient norm (dense + sparse)",
+            ).set(grad_norm)
+        wsq = sum(
+            float(jnp.sum(jnp.square(read_replicated(leaf).astype(
+                jnp.float32))))
+            for leaf in jax.tree.leaves(self.params)
+        )
+        metrics["weight_norm"] = math.sqrt(wsq) if wsq >= 0 else float("nan")
+        telemetry.gauge(
+            "train.weight_norm", "dense parameter L2 norm at pass end"
+        ).set(metrics["weight_norm"])
         metrics["missing_keys"] = table.missing_key_count
         metrics["overflow_keys"] = table.overflow_key_count  # always 0 now
         metrics["capacity_bumps"] = table.capacity_bumps
@@ -904,8 +955,16 @@ class MultiChipTrainer:
                 logging.getLogger(__name__).warning(
                     "fleet snapshot gather failed", exc_info=True
                 )
+        # run-health plane: evaluate the rule catalog on the SAME window
+        # the pass_end record carries, BEFORE the record is written so
+        # the window's health_alert events precede its pass_end record
+        snap = telemetry.registry.delta_snapshot()
+        telemetry.observe_pass(
+            self.global_step, metrics=metrics, telemetry=snap, table=table
+        )
         if event_log is not None:
-            event_log.log_pass(metrics, global_step=self.global_step)
+            event_log.log_pass(metrics, telemetry=snap,
+                               global_step=self.global_step)
         if plan_channel is not None:
             # every peer has joined the metric collectives above, which it
             # can only do after its producer read ALL of this channel's

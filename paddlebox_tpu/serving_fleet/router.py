@@ -138,6 +138,9 @@ class ReplicaHandle:
             "degraded_reasons": self.health.get("degraded_reasons") or {},
             "queue_depth": self.health.get("queue_depth"),
             "estimated_wait_s": self.health.get("estimated_wait_s"),
+            # run-health summary straight off the probe payload
+            # (telemetry/health.py health_view on the replica)
+            "health": self.health.get("health") or {},
             "models": {
                 n: {"seq": m.get("seq"), "age_seconds": m.get("age_seconds"),
                     "lineage": m.get("lineage"),
@@ -471,6 +474,16 @@ class FleetRouter:
             "ok": bool(serving),
             "n_replicas": len(replicas),
             "n_serving": len(serving),
+            # fleet-level run-health rollup: total/critical alert counts
+            # summed over every replica's health summary
+            "health_alerts": sum(
+                int((r.get("health") or {}).get("alerts_total") or 0)
+                for r in replicas
+            ),
+            "health_critical": sum(
+                int((r.get("health") or {}).get("critical_total") or 0)
+                for r in replicas
+            ),
             "replicas": replicas,
         }
 

@@ -286,6 +286,32 @@ class SparseTable:
         c = self._get_cache()
         return [c] if c is not None else []
 
+    def health_stats(self) -> dict:
+        """Cheap per-pass health snapshot for telemetry/health.py: O(1)
+        gauges only — never the store's full finiteness scan.  The
+        ``cache_hit_rate`` key is present only once the cache has served
+        a pass (absent signals make the collapse rule skip, not fire)."""
+        hits = int(self.last_cache_hits)
+        misses = int(self.last_cache_misses)
+        out = {
+            "cache_hits": hits,
+            "cache_misses": misses,
+            # the begin-pass promotion patch is exactly the miss rows
+            "promotion_patch_rows": misses,
+            "merge_backlog": len(self._merge_futures),
+            "overlay_entries": len(self._overlay),
+            "missing_keys": int(self.missing_key_count),
+            "store_rows": int(self._store.n),
+            "store_resident_buckets": int(self._store.resident_buckets),
+        }
+        if hits + misses > 0:
+            out["cache_hit_rate"] = hits / (hits + misses)
+        caches = self._caches()
+        if caches:
+            out["cache_capacity"] = int(sum(c.capacity for c in caches))
+            out["cache_resident"] = int(sum(c.resident for c in caches))
+        return out
+
     def _cache_fetch_rows(self, miss: np.ndarray, _entries=None) -> np.ndarray:
         """Host-tier fetch of cache-MISS rows — the begin-pass promotion
         patch, now O(cold keys).  Chaos site ``cache.fetch``: a failure

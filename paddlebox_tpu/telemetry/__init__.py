@@ -18,7 +18,11 @@ PrintSyncTimer + log_for_profile + CUPTI timeline, rebuilt TPU-native):
   * :mod:`flight` — the always-on flight recorder: a bounded ring of
     recent spans/events dumped to JSON on stalls, rollbacks, sync
     fallbacks, replica crashes and SIGTERM (``tools/pbox_doctor.py``
-    correlates the dumps offline).
+    correlates the dumps offline);
+  * :mod:`health` — the run-health plane: a declarative rule catalog
+    (EWMA z-score + absolute checks over training/table/pipeline
+    signals) evaluated per pass; firing rules alert, count, and at
+    ``critical`` dump the flight ring.
 """
 
 from paddlebox_tpu.telemetry.metrics import (  # noqa: F401
@@ -74,7 +78,18 @@ from paddlebox_tpu.telemetry.flight import (  # noqa: F401
     FlightRecorder,
     dump_flight,
     install_signal_dump,
+    run_identity,
     set_process_name,
+    set_run_backend,
+)
+from paddlebox_tpu.telemetry.health import (  # noqa: F401
+    HealthAlert,
+    HealthMonitor,
+    HealthRule,
+    default_rules,
+    get_monitor,
+    health_view,
+    observe_pass,
 )
 from paddlebox_tpu.telemetry.compiles import (  # noqa: F401
     CountedJit,

@@ -111,14 +111,19 @@ class EventLog:
             pass
         self._f = open(self.path, "a")
 
-    def log_pass(self, pass_metrics: dict, **fields) -> None:
-        """The per-pass record: pass metrics + this pass's metric deltas."""
-        self.log(
-            "pass_end",
-            metrics=pass_metrics,
-            telemetry=registry.delta_snapshot(),
-            **fields,
-        )
+    def log_pass(self, pass_metrics: dict, telemetry: dict = None,
+                 **fields) -> dict:
+        """The per-pass record: pass metrics + this pass's metric deltas.
+
+        Returns the delta snapshot it logged: ``delta_snapshot()`` resets
+        its baseline per call, so the health monitor must evaluate the
+        SAME window the JSONL record carries, not take a second (empty)
+        snapshot.  Callers that evaluate health FIRST (so the window's
+        ``health_alert`` events precede its ``pass_end`` record in the
+        stream) pass the snapshot they already took via ``telemetry``."""
+        snap = registry.delta_snapshot() if telemetry is None else telemetry
+        self.log("pass_end", metrics=pass_metrics, telemetry=snap, **fields)
+        return snap
 
     def close(self) -> None:
         with self._lock:

@@ -39,6 +39,8 @@ import json
 import logging
 import os
 import signal
+import socket
+import subprocess
 import threading
 import time
 from typing import Optional
@@ -63,6 +65,75 @@ def _default_rank() -> int:
         return int(os.environ.get("PBOX_PROCESS_ID", "0"))
     except ValueError:
         return 0
+
+
+# --------------------------------------------------------------------------- #
+# run identity: the correlation key across bench rows, dumps and history
+# --------------------------------------------------------------------------- #
+_identity_lock = threading.Lock()
+_identity: Optional[dict] = None
+_run_backend: Optional[str] = None
+
+
+def set_run_backend(name: str) -> None:
+    """Record the backend this run actually initialized.  Identity
+    stamping must NEVER call ``jax.default_backend()`` itself — backend
+    init can hang (the axon failure mode), and a crash dump is exactly
+    when we cannot afford to block — so whoever initializes the backend
+    tells us, and until then we fall back to JAX_PLATFORMS."""
+    global _run_backend, _identity
+    with _identity_lock:
+        _run_backend = str(name)
+        if _identity is not None:
+            _identity["backend"] = _run_backend
+
+
+def _git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            capture_output=True, text=True, timeout=5,
+        )
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else "unknown"
+    except (OSError, subprocess.SubprocessError):
+        return "unknown"
+
+
+def run_identity() -> dict:
+    """Who/what/when of this process's run: git sha, a wall timestamp
+    anchored at first call (monotonic offsets stay comparable within the
+    run), backend, jax version, host.  Cached after the first call —
+    cheap and hang-free from then on, so dumps can stamp it."""
+    global _identity
+    with _identity_lock:
+        if _identity is not None:
+            return dict(_identity)
+    # resolve the slow pieces (a git subprocess spawn, the jax import)
+    # OUTSIDE the lock — two racing first callers just do the work twice
+    sha = _git_sha()
+    try:
+        import jax
+
+        jax_version = getattr(jax, "__version__", "unknown")
+    except ImportError:
+        jax_version = "unavailable"
+    with _identity_lock:
+        if _identity is None:
+            backend = _run_backend or os.environ.get(
+                "JAX_PLATFORMS", "") or "unset"
+            _identity = {
+                "git_sha": sha,
+                "started_at": time.time(),
+                "started_monotonic": time.monotonic(),
+                "backend": backend,
+                "jax_version": jax_version,
+                "host": socket.gethostname(),
+                "pid": os.getpid(),
+            }
+        return dict(_identity)
 
 
 class FlightRecorder:
@@ -131,12 +202,19 @@ class FlightRecorder:
                 "pid": os.getpid(),
                 "reason": reason,
                 "detail": dict(detail or {}),
+                "run": run_identity(),
                 "ring": self.snapshot(),
                 "metrics": registry.snapshot(),
             }
             fname = (f"flight-{self.name}-r{self.rank}-pid{os.getpid()}"
                      f"-{reason}-{int(now * 1e3)}.json")
             path = os.path.join(d, fname)
+            # two dumps in the same millisecond (e.g. two critical health
+            # alerts from one window) must not overwrite each other
+            seq = 1
+            while os.path.exists(path):
+                path = os.path.join(d, f"{fname[:-5]}-{seq}.json")
+                seq += 1
             tmp = path + ".tmp"
             with open(tmp, "w") as fh:
                 json.dump(payload, fh, default=_json_default)

@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import math
 import os
+import time
 from typing import Any, Iterable, Optional
 
 import jax
@@ -429,6 +431,17 @@ class Trainer:
             mstate["auc"] = update_auc_state(
                 mstate["auc"], primary, batch["labels"], batch["ins_mask"]
             )
+            if "gn" in mstate:
+                # grad-norm health stream rides the donated metric state —
+                # no step-signature change: [sum of squared global grad
+                # norms, steps]; a skip_batch discard drops its sample too
+                gsq = jnp.zeros((), jnp.float32)
+                for leaf in jax.tree.leaves(pgrads):
+                    gsq += jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+                gsq += jnp.sum(jnp.square(row_grads.astype(jnp.float32)))
+                mstate["gn"] = mstate["gn"] + jnp.stack(
+                    [gsq, jnp.ones((), jnp.float32)]
+                )
             if n_tasks > 1:
                 mstate["task"] = jax.vmap(
                     lambda s, pr, lb: update_auc_state(
@@ -552,7 +565,10 @@ class Trainer:
             # the step donates mstate: copy so the caller's reference (often
             # trainer.last_metric_state itself) is not invalidated by the
             # first step's buffer donation
-            return jax.tree.map(jnp.array, auc_state)
+            out = jax.tree.map(jnp.array, auc_state)
+            if "gn" not in out:
+                out["gn"] = jnp.zeros((2,), jnp.float32)
+            return out
         if auc_state is not None and (self.n_tasks > 1 or self.metric_group):
             raise ValueError(
                 "pass trainer.last_metric_state (dict) to continue metrics "
@@ -562,7 +578,8 @@ class Trainer:
         mstate = {
             "auc": jax.tree.map(jnp.array, auc_state)
             if auc_state is not None
-            else init_auc_state(self.conf.auc_buckets)
+            else init_auc_state(self.conf.auc_buckets),
+            "gn": jnp.zeros((2,), jnp.float32),
         }
         if self.n_tasks > 1:
             mstate["task"] = stack_auc_states(
@@ -646,6 +663,12 @@ class Trainer:
         if self._step_fn is None:
             self._step_fn = self._build_step()
         mstate = self._init_mstate(auc_state)
+        # grad-norm baseline: the accumulator carries across continued
+        # passes, so the per-pass value is a delta between host snapshots
+        # (materialized NOW — the first step donates the buffer)
+        gn_base = np.asarray(mstate["gn"], dtype=np.float64)
+        pass_t0 = time.monotonic()
+        n_samples = [0.0]
         values, g2sum = table.values, table.g2sum
         losses, n_steps = [], 0
         uses_rank = getattr(self.model, "uses_rank_offset", False)
@@ -763,6 +786,7 @@ class Trainer:
                     # loss/grads genuinely go NaN and the configured
                     # nan_policy is exercised end to end on device
                     host["labels"] = np.full_like(host["labels"], np.nan)
+                n_samples[0] += float(batch.ins_mask.sum())
                 yield batch, host
 
         def feeds():
@@ -951,6 +975,28 @@ class Trainer:
             else 0.0
         )
         metrics["steps"] = n_steps
+        # samples/s without trace files: the pass_end record carries
+        # wall-clock duration and the instance count it covered
+        metrics["duration_s"] = time.monotonic() - pass_t0
+        metrics["samples"] = float(n_samples[0])
+        gn_now = np.asarray(mstate["gn"], dtype=np.float64)
+        d_sq, d_n = gn_now[0] - gn_base[0], gn_now[1] - gn_base[1]
+        if d_n > 0:
+            grad_norm = float(np.sqrt(d_sq / d_n)) if d_sq >= 0 else float(
+                "nan")
+            metrics["grad_norm"] = grad_norm
+            telemetry.gauge(
+                "train.grad_norm",
+                "per-pass RMS global gradient norm (dense + sparse)",
+            ).set(grad_norm)
+        wsq = sum(
+            float(jnp.sum(jnp.square(leaf.astype(jnp.float32))))
+            for leaf in jax.tree.leaves(self.params)
+        )
+        metrics["weight_norm"] = math.sqrt(wsq) if wsq >= 0 else float("nan")
+        telemetry.gauge(
+            "train.weight_norm", "dense parameter L2 norm at pass end"
+        ).set(metrics["weight_norm"])
         if prof.enabled:
             metrics["profile"] = prof.report()
             stage_q = prof.quantiles()
@@ -965,8 +1011,18 @@ class Trainer:
                 host_trace_dir,
                 f"host-trace-r{_default_rank()}-pass{self._pass_idx}.json",
             ))
+        # run-health plane: evaluate the rule catalog against the SAME
+        # window the pass_end record carries (the delta snapshot resets
+        # its baseline per call — there is exactly one consumer chain),
+        # BEFORE the record is written so a consumer that tails up to
+        # pass_end already has the window's health_alert events
+        snap = telemetry.registry.delta_snapshot()
+        telemetry.observe_pass(
+            self._pass_idx, metrics=metrics, telemetry=snap, table=table
+        )
         if event_log is not None:
-            event_log.log_pass(metrics, pass_idx=self._pass_idx)
+            event_log.log_pass(metrics, telemetry=snap,
+                               pass_idx=self._pass_idx)
         self._pass_idx += 1
         self.last_auc_state = mstate["auc"]
         self.last_metric_state = mstate

@@ -21,6 +21,13 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Tests that exercise bench.py stages go through its emit() path, which
+# appends every row to the bench-trend history file.  Test rows must
+# never pollute the checked-in BENCH_HISTORY at the repo root.
+os.environ.setdefault(
+    "PBOX_BENCH_HISTORY", os.path.join("/tmp", f"pbox-test-bench-{os.getpid()}.jsonl")
+)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

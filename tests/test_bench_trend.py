@@ -1,0 +1,147 @@
+"""Bench trend gate (tools/bench_trend.py): per-(metric, backend)
+baselines with median+MAD noise bands over fabricated BENCH_HISTORY
+files — a 20% throughput regression must gate, an in-band wiggle must
+not, and ``backend: unavailable`` diagnostic rows must be tolerated."""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _trend():
+    sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+    try:
+        return importlib.import_module("bench_trend")
+    finally:
+        sys.path.pop(0)
+
+
+def _row(metric, value, backend="cpu", started_at=None, **kw):
+    r = {"metric": metric, "value": value, "unit": "u",
+         "vs_baseline": None, "backend": backend, **kw}
+    if started_at is not None:
+        r["run"] = {"git_sha": "abc", "started_at": started_at,
+                    "backend": backend, "host": "h", "pid": 1}
+    return r
+
+
+def _write(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+HIST_SPS = [11000.0, 10500.0, 10800.0, 11200.0, 10900.0]
+
+
+def test_regression_flagged_and_in_band_passes():
+    bt = _trend()
+    history = [_row("ctr_dnn_samples_per_sec", v) for v in HIST_SPS]
+    # 20% below the 10900 median: far outside max(10% rel band, 3*MAD)
+    bad = bt.compare([_row("ctr_dnn_samples_per_sec", 8720.0)], history)
+    assert [v["status"] for v in bad] == ["regression"]
+    assert bad[0]["direction"] == "higher"
+    # 2% below: inside the 10% floor
+    ok = bt.compare([_row("ctr_dnn_samples_per_sec", 10682.0)], history)
+    assert [v["status"] for v in ok] == ["ok"]
+    # and a 20% IMPROVEMENT never gates
+    up = bt.compare([_row("ctr_dnn_samples_per_sec", 13080.0)], history)
+    assert [v["status"] for v in up] == ["ok"]
+
+
+def test_lower_is_better_direction():
+    bt = _trend()
+    history = [_row("pass_boundary_gap_ms", v)
+               for v in [50.0, 52.0, 48.0, 51.0]]
+    worse = bt.compare([_row("pass_boundary_gap_ms", 70.0)], history)
+    assert [v["status"] for v in worse] == ["regression"]
+    better = bt.compare([_row("pass_boundary_gap_ms", 40.0)], history)
+    assert [v["status"] for v in better] == ["ok"]
+
+
+def test_backends_never_cross_and_min_history():
+    bt = _trend()
+    history = [_row("m_samples_per_sec", v, backend="tpu")
+               for v in HIST_SPS]
+    # cpu candidate vs tpu-only history: no baseline, never a regression
+    v = bt.compare([_row("m_samples_per_sec", 10.0, backend="cpu")],
+                   history)
+    assert [x["status"] for x in v] == ["no_baseline"]
+    v = bt.compare([_row("m_samples_per_sec", 10.0, backend="tpu")],
+                   history[:2])
+    assert [x["status"] for x in v] == ["no_baseline"]
+
+
+def test_unavailable_rows_tolerated_both_sides():
+    bt = _trend()
+    history = ([_row("m_samples_per_sec", v) for v in HIST_SPS]
+               + [_row("m_samples_per_sec", None, backend="unavailable",
+                       error_kind="backend_init_hang")] * 3)
+    # unavailable rows poison neither the baseline...
+    v = bt.compare([_row("m_samples_per_sec", 10900.0)], history)
+    assert [x["status"] for x in v] == ["ok"]
+    assert v[0]["n_history"] == 5
+    # ...nor the verdict when the CANDIDATE is an outage row
+    v = bt.compare(
+        [_row("m_samples_per_sec", None, backend="unavailable")], history)
+    assert [x["status"] for x in v] == ["unavailable"]
+
+
+def test_mad_band_absorbs_noisy_history():
+    bt = _trend()
+    # noisy group: MAD ~ 1000, so 3*MAD dominates the 10% floor
+    history = [_row("noisy_samples_per_sec", v)
+               for v in [10000.0, 12000.0, 9000.0, 11000.0, 13000.0]]
+    v = bt.compare([_row("noisy_samples_per_sec", 8200.0)], history)
+    assert [x["status"] for x in v] == ["ok"]  # inside 3*MAD
+
+
+def test_split_last_run_and_cli_exit_codes(tmp_path):
+    bt = _trend()
+    hist = tmp_path / "BENCH_HISTORY.jsonl"
+    rows = [_row("ctr_dnn_samples_per_sec", v, started_at=float(i))
+            for i, v in enumerate(HIST_SPS)]
+    # the newest run regressed 20%
+    rows.append(_row("ctr_dnn_samples_per_sec", 8720.0, started_at=99.0))
+    _write(hist, rows)
+    history, current = bt.split_last_run(bt.load_rows(str(hist)))
+    assert len(current) == 1 and current[0]["value"] == 8720.0
+    assert len(history) == 5
+    assert bt.main(["--history", str(hist)]) == 1
+    # replace the regressed row with an in-band one: gate passes
+    rows[-1] = _row("ctr_dnn_samples_per_sec", 10682.0, started_at=99.0)
+    _write(hist, rows)
+    assert bt.main(["--history", str(hist)]) == 0
+    # --list and empty-history paths exit 0
+    assert bt.main(["--history", str(hist), "--list"]) == 0
+    assert bt.main(["--history", str(tmp_path / "missing.jsonl")]) == 0
+
+
+def test_malformed_and_unstamped_lines_skipped(tmp_path):
+    bt = _trend()
+    p = tmp_path / "h.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(_row("m_samples_per_sec", 1.0)) + "\n")
+        f.write("{truncated\n")
+        f.write("\n")
+        f.write(json.dumps({"no_metric": 1}) + "\n")
+    assert len(bt.load_rows(str(p))) == 1
+    # a history of only unstamped rows has no "last run" to judge
+    history, current = bt.split_last_run(bt.load_rows(str(p)))
+    assert current == [] and len(history) == 1
+
+
+def test_direction_heuristics():
+    bt = _trend()
+    assert bt.metric_direction("ctr_dnn_samples_per_sec") == "higher"
+    assert bt.metric_direction("serving_qps_sweep_curve") == "higher"
+    assert bt.metric_direction("hbm_cache_hit_rate") == "higher"
+    assert bt.metric_direction("fleet_router_p99_ms") == "lower"
+    assert bt.metric_direction("pass_boundary_gap_ms") == "lower"
+    assert bt.metric_direction("storage_bytes_per_pass") == "lower"
+    assert bt.metric_direction("quantized_auc_delta") == "higher"

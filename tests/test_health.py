@@ -1,0 +1,435 @@
+"""Run-health plane (telemetry/health.py + tools/pbox_doctor.py):
+EWMA z-score math, window flattening, alert plumbing (counter + JSONL
+event + critical flight dump with the run-identity stamp), the seeded
+fault -> specific-alert pins, the clean-run false-positive pin, the
+health-rule-drift guard, and the doctor's first-bad-pass verdict
+reconstructed from dump files alone."""
+
+import importlib
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import telemetry
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import CtrDnn
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.telemetry import flight, health
+from paddlebox_tpu.telemetry.events import EventLog
+from paddlebox_tpu.telemetry.health import (
+    HealthMonitor,
+    HealthRule,
+    _Ewma,
+    flatten_window,
+    rule_names,
+)
+from paddlebox_tpu.telemetry.metrics import registry
+from paddlebox_tpu.train.trainer import Trainer
+from paddlebox_tpu.utils import faults
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+N_SLOTS = 3
+DENSE = 2
+
+
+def _tool(mod: str):
+    sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+    try:
+        return importlib.import_module(mod)
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor():
+    """Every test gets a clean singleton (EWMA state and alert rings are
+    per-run state, not per-process state)."""
+    health.reset_for_tests()
+    faults.clear()
+    yield
+    health.reset_for_tests()
+    faults.clear()
+
+
+def _rule(**kw) -> HealthRule:
+    base = dict(name="t.rule", family="training", signal="metrics.x",
+                kind="zscore", direction="above", threshold=4.0)
+    base.update(kw)
+    return HealthRule(**base)
+
+
+# --------------------------------------------------------------------------- #
+# unit: EWMA + rule evaluation
+# --------------------------------------------------------------------------- #
+def test_ewma_update_math():
+    e = _Ewma()
+    e.update(10.0, 0.5)
+    assert (e.mean, e.var) == (10.0, 0.0)  # first sample seeds the mean
+    e.update(14.0, 0.5)
+    # d=4: mean 10+2=12, var = 0.5*(0 + 0.5*16) = 4
+    assert e.mean == pytest.approx(12.0)
+    assert e.var == pytest.approx(4.0)
+
+
+def test_zscore_fires_after_warmup_only():
+    m = HealthMonitor(rules=[_rule(min_delta=0.5)], ewma_alpha=0.5,
+                      warmup=3, enabled=True)
+    fired = []
+    for i, x in enumerate([1.0, 1.0, 1.0, 1.0, 50.0]):
+        fired.append(m.observe(i, metrics={"x": x}))
+    assert [len(a) for a in fired] == [0, 0, 0, 0, 1]
+    a = fired[-1][0]
+    assert a.rule == "t.rule" and a.window == 4
+    assert a.observed == 50.0 and a.baseline == pytest.approx(1.0)
+
+
+def test_zscore_noise_floor_suppresses_small_deviation():
+    # zero-variance baseline makes z infinite — only the min_delta floor
+    # keeps a 0.1 wiggle from alerting
+    m = HealthMonitor(rules=[_rule(min_delta=0.5)], ewma_alpha=0.5,
+                      warmup=2, enabled=True)
+    for i, x in enumerate([1.0, 1.0, 1.0, 1.1]):
+        assert m.observe(i, metrics={"x": x}) == []
+
+
+def test_zscore_direction_below_and_min_rel():
+    m = HealthMonitor(
+        rules=[_rule(direction="below", min_rel=0.3)],
+        ewma_alpha=0.5, warmup=2, enabled=True)
+    assert m.observe(0, metrics={"x": 10.0}) == []
+    assert m.observe(1, metrics={"x": 10.0}) == []
+    # floor = 0.3*10 = 3: an 8.0 reading (dev 2) stays quiet...
+    assert m.observe(2, metrics={"x": 8.0}) == []
+    # ...a collapse to 1.0 does not
+    alerts = m.observe(3, metrics={"x": 1.0})
+    assert [a.rule for a in alerts] == ["t.rule"]
+
+
+def test_nonfinite_observation_fires_even_during_warmup():
+    m = HealthMonitor(rules=[_rule()], ewma_alpha=0.5, warmup=10,
+                      enabled=True)
+    alerts = m.observe(0, metrics={"x": float("nan")})
+    assert len(alerts) == 1 and alerts[0].detail == "non-finite observation"
+    # and the dict form survives JSON round-tripping
+    d = json.loads(json.dumps(alerts[0].to_dict()))
+    assert d["observed"] == "nan"
+
+
+def test_abs_max_and_nonzero_kinds():
+    rules = [
+        _rule(name="t.abs", kind="abs_max", threshold=2.0),
+        _rule(name="t.zero", kind="nonzero", signal="counter.jit.compiles"),
+    ]
+    m = HealthMonitor(rules=rules, ewma_alpha=0.5, warmup=1, enabled=True)
+    # window 0: inside warmup — nonzero must NOT fire (warmup = compiles
+    # are expected); abs_max has no warmup and fires immediately
+    a0 = m.observe(0, metrics={"x": 3.0},
+                   telemetry={"counters": {"jit.compiles{stage=s}": 2}})
+    assert [a.rule for a in a0] == ["t.abs"]
+    # window 1: past warmup, a compile is an incident; absent counter = 0
+    a1 = m.observe(1, metrics={"x": 0.0},
+                   telemetry={"counters": {"jit.compiles{stage=s}": 1}})
+    assert [a.rule for a in a1] == ["t.zero"]
+    assert m.observe(2, metrics={"x": 0.0}) == []
+
+
+def test_disabled_monitor_is_inert():
+    m = HealthMonitor(rules=[_rule()], enabled=False)
+    assert m.observe(0, metrics={"x": float("nan")}) == []
+    assert m.snapshot()["enabled"] is False
+
+
+# --------------------------------------------------------------------------- #
+# unit: window flattening
+# --------------------------------------------------------------------------- #
+def test_flatten_window_namespace_and_derived_rates():
+    sig = flatten_window(
+        metrics={"loss": 0.5, "steps": 90, "samples": 1000.0,
+                 "duration_s": 2.0, "path": "scan8"},
+        telemetry={
+            "counters": {"train.nan_skipped_steps": 10,
+                         "data.quarantined_lines": 20,
+                         "x.y{a=1}": 3, "x.y{a=2}": 4},
+            "gauges": {"g.z{a=1}": 5.0, "g.z{a=2}": 9.0},
+            "histograms": {
+                "h.s{a=1}": {"boundaries": [1.0], "counts": [2, 0],
+                             "sum": 1.0, "count": 2, "min": 0.4,
+                             "max": 0.6},
+                "h.s{a=2}": {"boundaries": [1.0], "counts": [0, 2],
+                             "sum": 8.0, "count": 2, "min": 3.0,
+                             "max": 5.0},
+            },
+        },
+        table_stats={"cache_hit_rate": 0.75, "note": "str ignored"},
+    )
+    assert sig["metrics.loss"] == 0.5
+    assert "metrics.path" not in sig  # non-numeric fields dropped
+    assert sig["counter.x.y"] == 7.0  # label variants sum
+    assert sig["gauge.g.z"] == 9.0  # gauges take the max
+    assert sig["hist.h.s.count"] == 4.0
+    assert sig["hist.h.s.mean"] == pytest.approx(9.0 / 4)
+    assert sig["hist.h.s.p99"] == pytest.approx(5.0, abs=0.2)
+    assert sig["table.cache_hit_rate"] == 0.75
+    assert sig["derived.nan_skip_rate"] == pytest.approx(10 / 100)
+    assert sig["derived.quarantine_rate"] == pytest.approx(20 / 1000)
+    assert sig["derived.samples_per_s"] == pytest.approx(500.0)
+
+
+# --------------------------------------------------------------------------- #
+# plumbing: counter + event + critical flight dump (+ run identity stamp)
+# --------------------------------------------------------------------------- #
+def test_alert_plumbing_counter_event_and_critical_dump(tmp_path,
+                                                        monkeypatch):
+    monkeypatch.setenv("PBOX_FLIGHT_DIR", str(tmp_path))
+    events_path = tmp_path / "events.jsonl"
+    el = EventLog(str(events_path))
+    monkeypatch.setattr("paddlebox_tpu.telemetry.events._event_log", el)
+    m = health.reset_for_tests(warmup=0)
+    before = registry.snapshot()["counters"].get(
+        "health.alerts{rule=train.loss_spike,severity=critical}", 0)
+    alerts = m.observe(7, metrics={"loss": float("nan")})
+    assert [a.rule for a in alerts] == ["train.loss_spike"]
+    after = registry.snapshot()["counters"][
+        "health.alerts{rule=train.loss_spike,severity=critical}"]
+    assert after == before + 1
+    el.close()
+    # the JSONL event
+    recs = [json.loads(ln) for ln in events_path.read_text().splitlines()]
+    evs = [r for r in recs if r["event"] == "health_alert"]
+    assert evs and evs[0]["rule"] == "train.loss_spike"
+    assert evs[0]["window"] == 7
+    # the critical dump, carrying the alert as detail AND the run identity
+    dumps = [f for f in os.listdir(tmp_path) if "-health-" in f]
+    assert len(dumps) == 1
+    d = json.loads((tmp_path / dumps[0]).read_text())
+    assert d["reason"] == "health"
+    assert d["detail"]["rule"] == "train.loss_spike"
+    assert d["detail"]["window"] == 7
+    run = d["run"]
+    assert run["git_sha"] and run["host"] and run["pid"] == os.getpid()
+    assert "jax_version" in run and "backend" in run
+    # snapshot view (what /healthz and the router fleet view expose)
+    view = telemetry.health_view()
+    assert view["alerts_total"] == 1 and view["critical_total"] == 1
+    assert view["recent"][0]["rule"] == "train.loss_spike"
+
+
+def test_doctor_health_report_from_dumps_alone(tmp_path, monkeypatch):
+    """pbox_doctor must name the first bad pass with ONLY flight dump
+    files on disk — no JSONL event log survived the crash."""
+    monkeypatch.setenv("PBOX_FLIGHT_DIR", str(tmp_path))
+    flight.reset_for_tests()  # drop health_alert ring records of prior tests
+    m = health.reset_for_tests(warmup=0)
+    m.observe(7, metrics={"loss": float("nan")})
+    m.observe(5, metrics={"auc": float("nan")})
+    assert len([f for f in os.listdir(tmp_path) if "-health-" in f]) == 2
+    doctor = _tool("pbox_doctor")
+    report = doctor.analyze(str(tmp_path))
+    hr = report["health"]
+    assert hr["by_severity"] == {"critical": 2}
+    assert hr["first_bad_window"] == 5  # smallest window, not earliest t
+    assert hr["first_bad"]["rule"] == "train.auc_drop"
+    assert "FIRST BAD PASS/WINDOW: 5" in doctor.format_summary(report)
+
+
+# --------------------------------------------------------------------------- #
+# e2e pins: seeded fault -> its specific alert within 2 passes
+# --------------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def synth(tmp_path_factory):
+    d = tmp_path_factory.mktemp("health_synth")
+    paths = write_synth_files(
+        str(d), n_files=2, ins_per_file=256, n_sparse_slots=N_SLOTS,
+        vocab_per_slot=40, dense_dim=DENSE, seed=3,
+    )
+    conf = make_synth_config(
+        n_sparse_slots=N_SLOTS, dense_dim=DENSE, batch_size=32,
+        max_feasigns_per_ins=8,
+    )
+    return paths, conf
+
+
+def _world(conf, nan_policy="raise", seed=0):
+    tconf = SparseTableConfig(embedding_dim=4, learning_rate=0.4,
+                              initial_range=0.05)
+    table = SparseTable(tconf, seed=seed)
+    model = CtrDnn(N_SLOTS, tconf.row_width, dense_dim=DENSE, hidden=(16, 8))
+    trainer = Trainer(
+        model, tconf,
+        TrainerConfig(auc_buckets=1 << 10, nan_policy=nan_policy,
+                      check_nan_inf=True),
+        seed=seed,
+    )
+    return table, trainer
+
+
+def _load(paths, conf):
+    from paddlebox_tpu.data.dataset import DatasetFactory
+
+    ds = DatasetFactory().create_dataset("BoxPSDataset", conf)
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    return ds
+
+
+def test_clean_run_fires_zero_alerts(synth):
+    """The false-positive pin: five ordinary passes (loss moving, AUC
+    improving, weights growing — normal early-training drift) must not
+    trip any rule."""
+    paths, conf = synth
+    monitor = health.reset_for_tests()
+    ds = _load(paths, conf)
+    table, trainer = _world(conf)
+    try:
+        for p in range(5):
+            table.begin_pass(ds.unique_keys())
+            metrics = trainer.train_from_dataset(ds, table, drop_last=True)
+            table.end_pass()
+    finally:
+        ds.close()
+    snap = monitor.snapshot()
+    assert snap["alerts_total"] == 0, snap["recent"]
+    assert snap["windows"] == 5
+    # satellite: pass metrics now carry wall-clock + sample count
+    assert metrics["duration_s"] > 0
+    assert metrics["samples"] == 512.0
+    assert metrics["grad_norm"] > 0 and metrics["weight_norm"] > 0
+
+
+def test_nan_fault_fires_training_alert_within_two_passes(synth):
+    paths, conf = synth
+    monitor = health.reset_for_tests()
+    ds = _load(paths, conf)
+    table, trainer = _world(conf, nan_policy="skip_batch")
+    bad_pass = 3
+    try:
+        for p in range(5):
+            table.begin_pass(ds.unique_keys())
+            if p == bad_pass:
+                # poison 8 of the 16 batches of this pass
+                faults.install(faults.FaultPlan({"train.nan": "first:8"}))
+            try:
+                trainer.train_from_dataset(ds, table, drop_last=True)
+            finally:
+                faults.clear()
+            table.end_pass()
+    finally:
+        ds.close()
+    fired = {(a["rule"], a["window"]) for a in monitor.snapshot()["recent"]}
+    windows = {w for r, w in fired if r == "train.nan_rate"}
+    assert windows, f"train.nan_rate never fired: {fired}"
+    assert min(windows) <= bad_pass + 1  # within 2 passes of the fault
+    # the clean passes around it stayed quiet on this rule
+    assert all(w >= bad_pass for w in windows)
+
+
+def test_cache_starvation_fires_hit_rate_collapse():
+    """Mid-run HBM-cache starvation: swap the warm cache for a tiny one
+    (the operational shape: capacity reconfigured way under the working
+    set) and the collapse rule must fire within 2 passes."""
+    from paddlebox_tpu.sparse.engine import HbmCache
+
+    tconf = SparseTableConfig(
+        embedding_dim=4, store_buckets=16, plan_scratch_rows=64,
+        hbm_cache_rows=512,
+    )
+    table = SparseTable(tconf, seed=0)
+    keys = np.arange(1, 300, dtype=np.uint64)
+    monitor = HealthMonitor(ewma_alpha=0.5, warmup=2, enabled=True)
+    fired = {}
+    starve_at = 10
+    for p in range(starve_at + 2):
+        if p == starve_at:
+            table._drain_cache()
+            table._cache = HbmCache(8, tconf.row_width + 1)
+        table.begin_pass(keys)
+        table.end_pass()
+        for a in monitor.observe(
+                p, metrics={"steps": 1},
+                telemetry=registry.delta_snapshot(), table=table):
+            fired.setdefault(a.rule, a)
+    assert "table.hit_rate_collapse" in fired, fired
+    a = fired["table.hit_rate_collapse"]
+    assert a.window >= starve_at and a.observed < 0.2
+    assert a.severity == "critical"
+
+
+def test_steady_state_recompile_alert():
+    from paddlebox_tpu.telemetry.compiles import (
+        counted_jit,
+        install_compile_listener,
+    )
+
+    if not install_compile_listener():
+        pytest.skip("no compile-event listener on this jax")
+    monitor = HealthMonitor(ewma_alpha=0.5, warmup=0, enabled=True)
+    registry.delta_snapshot()  # reset the delta baseline
+    assert monitor.observe(0, telemetry=registry.delta_snapshot()) == []
+    import jax.numpy as jnp
+
+    fn = counted_jit(lambda x: x * 2 + 1, stage="health_test")
+    fn(jnp.ones((4,), jnp.float32))  # fresh wrapper -> a compile
+    alerts = monitor.observe(1, telemetry=registry.delta_snapshot())
+    assert "pipeline.steady_state_recompile" in [a.rule for a in alerts]
+
+
+# --------------------------------------------------------------------------- #
+# shared window: log_pass returns the snapshot the monitor must see
+# --------------------------------------------------------------------------- #
+def test_log_pass_returns_the_logged_snapshot(tmp_path):
+    registry.counter("health_test.c", help="t").inc(3)
+    el = EventLog(str(tmp_path / "ev.jsonl"))
+    registry.delta_snapshot()
+    registry.counter("health_test.c").inc(2)
+    snap = el.log_pass({"loss": 0.1}, pass_idx=0)
+    el.close()
+    assert snap["counters"]["health_test.c"] == 2
+    rec = [json.loads(ln) for ln in
+           (tmp_path / "ev.jsonl").read_text().splitlines()
+           if json.loads(ln)["event"] == "pass_end"][0]
+    assert rec["telemetry"]["counters"]["health_test.c"] == 2
+
+
+# --------------------------------------------------------------------------- #
+# drift guard: catalog <-> ARCHITECTURE.md "## Run health", both ways
+# --------------------------------------------------------------------------- #
+def test_health_rule_drift_guard_clean():
+    rd = _tool("pbox_analyze.rules_drift")
+    names = rd.health_rule_names()
+    assert set(names) == set(rule_names())
+    missing, stale = rd.health_check()
+    assert missing == [] and stale == []
+
+
+def test_health_rule_drift_guard_detects_both_directions(monkeypatch):
+    rd = _tool("pbox_analyze.rules_drift")
+    real = rd.health_rule_names()
+    extra = dict(real)
+    extra["train.made_up_rule"] = "health.py:1"
+    monkeypatch.setattr(rd, "health_rule_names", lambda: extra)
+    missing, stale = rd.health_check()
+    assert [n for n, _ in missing] == ["train.made_up_rule"]
+    shrunk = dict(real)
+    shrunk.pop("train.loss_spike")
+    monkeypatch.setattr(rd, "health_rule_names", lambda: shrunk)
+    missing, stale = rd.health_check()
+    assert missing == []
+    assert any("train.loss_spike" in pat for pat, _ in stale)
+
+
+def test_rule_catalog_is_well_formed():
+    rules = health.default_rules()
+    assert len(rules) == len({r.name for r in rules})  # unique names
+    fams = {r.family for r in rules}
+    assert fams == {"training", "table", "pipeline"}
+    with pytest.raises(ValueError):
+        HealthRule(name="x", family="training", signal="s", kind="bogus")
+    with pytest.raises(ValueError):
+        HealthRule(name="x", family="training", signal="s", kind="zscore",
+                   severity="loud")

@@ -40,10 +40,15 @@ RULES = {
         "span recorded but missing from the tracing catalog, or "
         "cataloged but never recorded"
     ),
+    "health-rule-drift": (
+        "health rule in telemetry/health.py but missing from the "
+        "ARCHITECTURE.md Run health table, or documented but gone"
+    ),
 }
 
 FAULTS_PY = os.path.join(REPO, "paddlebox_tpu", "utils", "faults.py")
 CONFIG_PY = os.path.join(REPO, "paddlebox_tpu", "config.py")
+HEALTH_PY = os.path.join(REPO, "paddlebox_tpu", "telemetry", "health.py")
 
 # -- metric names ----------------------------------------------------------- #
 _METRIC_CALL_RE = re.compile(
@@ -259,6 +264,53 @@ def span_check() -> tuple:
     return missing, stale, found, pats
 
 
+# -- health rules ----------------------------------------------------------- #
+def health_rule_names() -> dict:
+    """{rule name: 'telemetry/health.py:line'} parsed statically out of
+    the _RULE_SPECS literal (no package import — same discipline as
+    KNOWN_SITES / _DEFAULTS)."""
+    text = open(HEALTH_PY).read()
+    tree = ast.parse(text)
+    for node in cached_walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "_RULE_SPECS":
+                    specs = ast.literal_eval(node.value)
+                    return {
+                        spec["name"]:
+                            f"paddlebox_tpu/telemetry/health.py:"
+                            f"{node.lineno}"
+                        for spec in specs
+                    }
+    raise SystemExit(f"ERROR: no _RULE_SPECS literal found in {HEALTH_PY}")
+
+
+def health_catalog_patterns() -> dict:
+    """{glob pattern: 'ARCHITECTURE.md:line'} from the Run health rule
+    table."""
+    return catalog.table_patterns("run health")
+
+
+def health_check() -> tuple:
+    """(missing, stale) drift lists, both directions: every _RULE_SPECS
+    rule needs a Run-health table row, every row must name a live rule."""
+    names = health_rule_names()
+    pats = health_catalog_patterns()
+    missing = []
+    for name, where in sorted(names.items()):
+        concrete = name.replace("*", "ANY")
+        if not any(fnmatch.fnmatchcase(concrete, p) for p in pats):
+            missing.append((name, where))
+    stale = []
+    for pat, where in sorted(pats.items()):
+        if not any(
+            fnmatch.fnmatchcase(name.replace("*", "ANY"), pat)
+            for name in names
+        ):
+            stale.append((pat, where))
+    return missing, stale
+
+
 # -- the pass --------------------------------------------------------------- #
 def _finding(ctx: Context, rule: str, where: str, message: str) -> Finding:
     file, _, line = where.partition(":")
@@ -312,5 +364,18 @@ def run(ctx: Context) -> list:
         findings.append(_finding(
             ctx, "span-name-drift", where,
             f"span catalog row {pat!r} matches no recorded span",
+        ))
+    h_missing, h_stale = health_check()
+    for name, where in h_missing:
+        findings.append(_finding(
+            ctx, "health-rule-drift", where,
+            f"health rule {name!r} has no row in the ARCHITECTURE.md "
+            "Run health table",
+        ))
+    for pat, where in h_stale:
+        findings.append(_finding(
+            ctx, "health-rule-drift", where,
+            f"Run health table row {pat!r} names no rule in "
+            "telemetry/health.py _RULE_SPECS",
         ))
     return findings

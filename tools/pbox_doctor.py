@@ -38,7 +38,11 @@ What it correlates:
     spans), ordered: a failover reads as attempt 1 dying on replica A
     and attempt 2 serving on replica B, under one ID;
   * **replica crashes** — the supervisor's ``replica_crash`` dumps name
-    the dead child (id, pid, rc) and list any dumps the child left.
+    the dead child (id, pid, rc) and list any dumps the child left;
+  * **run-health alerts** — ``health`` flight dumps (critical alerts
+    carry the full HealthAlert as dump detail) merged with
+    ``health_alert`` events/ring records, deduped per (rule, window);
+    the summary's HEALTH verdict names the FIRST bad pass/window.
 """
 
 from __future__ import annotations
@@ -394,6 +398,76 @@ def collective_report(data: dict) -> dict:
     return {"channels": summary, "divergences": divergences, "first": first}
 
 
+def _as_window_num(w) -> Optional[float]:
+    try:
+        return float(w)
+    except (TypeError, ValueError):
+        return None
+
+
+def health_report(data: dict) -> dict:
+    """Run-health alerts merged from every source the run left behind:
+    ``health`` flight dumps (a critical alert's dump carries the full
+    alert as its ``detail`` — the report works from dumps ALONE),
+    ``health_alert`` JSONL events, and ``health_alert`` ring records.
+    The verdict names the FIRST BAD PASS: the smallest numeric
+    pass/window id any alert fired on (earliest wall time among
+    non-numeric windows)."""
+    raw: List[dict] = []
+    for d in data["dumps"]:
+        if d.get("reason") != "health":
+            continue
+        det = d.get("detail") or {}
+        a = {k: det.get(k) for k in (
+            "rule", "severity", "family", "signal", "observed",
+            "baseline", "threshold", "window", "detail")}
+        a["t"] = d.get("t", 0.0)
+        a["proc"] = _proc_label(d.get("proc"), d.get("rank"), d.get("pid"))
+        a["src"] = "dump"
+        raw.append(a)
+    for t, who, kind, name, rec in _iter_all_records(data):
+        if name != "health_alert":
+            continue
+        a = {k: rec.get(k) for k in (
+            "rule", "severity", "family", "signal", "observed",
+            "baseline", "threshold", "window", "detail")}
+        a["t"] = t
+        a["proc"] = who
+        a["src"] = kind
+        raw.append(a)
+    # one alert reaches us through up to three artifacts (dump detail,
+    # JSONL event, ring record) under different proc labels: collapse by
+    # (rule, window), keeping the earliest sighting
+    uniq: Dict[tuple, dict] = {}
+    for a in raw:
+        key = (a.get("rule"), str(a.get("window")))
+        cur = uniq.get(key)
+        if cur is None or (a.get("t") or 0.0) < (cur.get("t") or 0.0):
+            uniq[key] = a
+    alerts = sorted(
+        uniq.values(),
+        key=lambda a: (
+            _as_window_num(a.get("window"))
+            if _as_window_num(a.get("window")) is not None else float("inf"),
+            a.get("t") or 0.0,
+        ),
+    )
+    by_rule: Dict[str, int] = {}
+    by_severity: Dict[str, int] = {}
+    for a in alerts:
+        by_rule[str(a.get("rule"))] = by_rule.get(str(a.get("rule")), 0) + 1
+        by_severity[str(a.get("severity"))] = by_severity.get(
+            str(a.get("severity")), 0) + 1
+    first_bad = alerts[0] if alerts else None
+    return {
+        "alerts": alerts,
+        "by_rule": by_rule,
+        "by_severity": by_severity,
+        "first_bad": first_bad,
+        "first_bad_window": first_bad.get("window") if first_bad else None,
+    }
+
+
 def trace_report(data: dict, trace_id: Optional[str] = None) -> Dict[str, list]:
     """Records grouped by trace ID (all traces, or just one), each list
     wall-time ordered: a request's full cross-process path."""
@@ -455,6 +529,7 @@ def analyze(run_dir: str) -> dict:
         "crashes": crash_report(data),
         "lineage": lineage_report(data),
         "collectives": collective_report(data),
+        "health": health_report(data),
         "traces": trace_report(data),
         "dump_reasons": sorted(
             {d.get("reason", "?") for d in data["dumps"]}
@@ -513,6 +588,17 @@ def format_summary(report: dict) -> str:
             f"REPLICA CRASH: replica {c['replica_id']} (pid {c['pid']}, "
             f"rc={c['returncode']}, port {c['port']}) at t={c['t']:.3f}; "
             f"{len(c['child_dumps'])} dump(s) left by the child"
+        )
+    health = report.get("health") or {}
+    if health.get("alerts"):
+        fb = health["first_bad"]
+        sev = health["by_severity"]
+        lines.append(
+            f"HEALTH: {len(health['alerts'])} alert(s) "
+            f"({sev.get('critical', 0)} critical) across "
+            f"{len(health['by_rule'])} rule(s); FIRST BAD PASS/WINDOW: "
+            f"{fb['window']} — {fb['rule']} (observed {fb['observed']}, "
+            f"baseline {fb['baseline']})"
         )
     div = report.get("collectives", {}).get("first")
     if div is not None:
