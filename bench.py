@@ -3306,6 +3306,126 @@ def _data_and_model(td, args, tconf, n_slots, dense, bsz, n_ins, hidden,
     return conf, ds, parse_s, model
 
 
+def stage_models(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
+                 hidden) -> None:
+    """The model-zoo sweep on its own: one measured samples/s row per
+    BASELINE.md zoo model (DeepFM, Wide&Deep fused-seqpool, xDeepFM, DCN,
+    MMoE) without paying for the full --all stage list.  Rows land in
+    BENCH_HISTORY.jsonl with run identity, so tools/bench_trend.py gates
+    their trend like any other metric."""
+    for name in ("deepfm", "widedeep", "xdeepfm", "dcn", "mmoe"):
+        t0 = time.perf_counter()
+        try:
+            stage_headline(backend, args, tconf, trconf, n_slots, dense,
+                           bsz, n_ins, hidden, model_name=name,
+                           with_naive=False)
+            log(f"== model {name} done in {time.perf_counter() - t0:.0f}s")
+        except Exception as e:
+            log(f"== model {name} FAILED: {e!r}")
+            emit({"metric": f"{name}_samples_per_sec", "value": None,
+                  "unit": "error", "vs_baseline": None, "backend": backend,
+                  "error": repr(e)[:200]})
+
+
+def bench_retrieval(qps: float = 50.0, duration_s: float = 6.0,
+                    n_slots: int = 4, dense: int = 4, emb: int = 16,
+                    vocab: int = 200, n_queries: int = 64,
+                    k: int = 10) -> dict:
+    """The retrieval serving row: train a TwoTower over synth data,
+    publish the item-tower ANN artifact (publish_ann_base), hot-sync it
+    into a live ScoringServer and drive open-loop /retrieve traffic
+    THROUGH the fleet router — p50/p99/QPS of the full client path plus
+    the int8-coarse-tier recall@10 against the exact scorer on the same
+    query set."""
+    from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+    from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+    from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+    from paddlebox_tpu.inference import ScoringServer
+    from paddlebox_tpu.inference.ann import AnnIndex
+    from paddlebox_tpu.models import TwoTower
+    from paddlebox_tpu.scenarios import MultiScenarioTrainer, ScenarioSpec
+    from paddlebox_tpu.serving_fleet import FleetRouter
+    from paddlebox_tpu.serving_sync import Publisher, Syncer
+    from paddlebox_tpu.sparse.table import SparseTable
+
+    B = 64
+    res: dict = {"duration_s": duration_s, "k": k}
+    with tempfile.TemporaryDirectory() as td:
+        conf = make_synth_config(n_sparse_slots=n_slots, dense_dim=dense,
+                                 batch_size=B, max_feasigns_per_ins=16)
+        files = write_synth_files(
+            td, n_files=2, ins_per_file=512, n_sparse_slots=n_slots,
+            vocab_per_slot=vocab, dense_dim=dense, seed=13,
+        )
+        tconf = SparseTableConfig(embedding_dim=emb, learning_rate=0.5,
+                                  initial_range=0.05)
+        table = SparseTable(tconf, seed=0)
+        item_slot = n_slots - 1
+        model = TwoTower(n_sparse_slots=n_slots, emb_width=tconf.row_width,
+                         item_slots=(item_slot,), dense_dim=dense,
+                         hidden=(64, 32), temperature=0.05)
+        mst = MultiScenarioTrainer(tconf, [ScenarioSpec(
+            "retrieval", model, kind="retrieval",
+            trainer_conf=TrainerConfig(dense_lr=3e-3, auc_buckets=1 << 12),
+            seed=3,
+        )])
+        ds = PadBoxSlotDataset(conf, read_threads=2)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        t0 = time.perf_counter()
+        metrics = mst.train_pass({"retrieval": ds}, table)["retrieval"]
+        res["train_samples_per_sec"] = round(
+            metrics["samples"] / max(metrics["duration_s"], 1e-9), 1)
+        res["train_auc"] = round(metrics.get("auc", 0.0), 4)
+        ds.close()
+        root = os.path.join(td, "pub")
+        pub = Publisher(root, staging_dir=os.path.join(td, "stage"))
+        lo, hi = item_slot * vocab + 1, (item_slot + 1) * vocab
+        pub.publish_ann_base("r0", table, item_key_lo=lo, item_key_hi=hi,
+                             meta={"scenario": "retrieval"})
+        res["publish_s"] = round(time.perf_counter() - t0, 2)
+
+        rng = np.random.default_rng(7)
+        q = rng.normal(size=(n_queries, emb)).astype(np.float32)
+        idx = AnnIndex.load(os.path.join(root, "base-r0"))
+        res["n_items"] = idx.n_items
+        ek, _ = idx.search(q, k=k, tier="exact")
+        qk, _ = idx.search(q, k=k, tier="int8")
+        res["recall_at_k_int8"] = round(float(np.mean([
+            len(set(ek[i]) & set(qk[i])) / k for i in range(n_queries)
+        ])), 4)
+
+        srv = ScoringServer()
+        syncer = Syncer(root, srv, "retrieval",
+                        cache_dir=os.path.join(td, "cache"),
+                        poll_interval_s=0.05)
+        syncer.poll_once()
+        port = srv.start(port=0, host="127.0.0.1")
+        router = FleetRouter([f"127.0.0.1:{port}"])
+        rport = router.start(port=0, host="127.0.0.1")
+        try:
+            body = json.dumps(
+                {"queries": q[:8].tolist(), "k": k, "tier": "int8"}
+            ).encode()
+            load = _open_loop_http(rport, body, qps, duration_s,
+                                   path="/retrieve/retrieval")
+            res.update({f"router_{kk}": vv for kk, vv in load.items()})
+        finally:
+            router.stop()
+            srv.stop()
+    return res
+
+
+def stage_retrieval(backend, args) -> None:
+    res = bench_retrieval(qps=args.retrieval_qps,
+                          duration_s=args.retrieval_seconds)
+    emit({"metric": "retrieval_router_p99_ms",
+          "value": res.get("router_p99_ms"),
+          "unit": "ms p99 (8-query /retrieve, int8 tier)",
+          "vs_baseline": None, "backend": backend, **res,
+          "telemetry": telemetry_summary()})
+
+
 def run_all(backend, args, tconf, trconf, n_slots, dense, bsz, n_ins,
             hidden) -> None:
     """Every measurement in ONE process (one tunnel client, one backend
@@ -3461,6 +3581,20 @@ def main() -> None:
                     help="append rate (records/s) for --streaming")
     ap.add_argument("--stream-staleness", type=float, default=1.5,
                     help="freshness budget (s) for --streaming")
+    ap.add_argument("--models", action="store_true",
+                    help="model-zoo sweep: one measured samples/s row per "
+                         "BASELINE.md zoo model (deepfm, widedeep, "
+                         "xdeepfm, dcn, mmoe) without the rest of --all")
+    ap.add_argument("--retrieval", action="store_true",
+                    help="retrieval serving row: train a TwoTower, "
+                         "publish the ANN item artifact, hot-sync it and "
+                         "drive open-loop /retrieve through the fleet "
+                         "router — p50/p99/QPS + int8-tier recall@10 vs "
+                         "the exact scorer")
+    ap.add_argument("--retrieval-qps", type=float, default=50.0,
+                    help="open-loop target QPS for --retrieval")
+    ap.add_argument("--retrieval-seconds", type=float, default=6.0,
+                    help="load duration for --retrieval")
     ap.add_argument("--health", action="store_true",
                     help="run-health smoke: short multi-pass training run "
                          "with one injected degradation (a NaN-poisoned "
@@ -3531,6 +3665,11 @@ def main() -> None:
     elif args.streaming:
         fail_metric = "streaming_freshness_p99_ms"
         fail_unit = "ms p99 (event-time -> served score)"
+    elif args.retrieval:
+        fail_metric = "retrieval_router_p99_ms"
+        fail_unit = "ms p99 (8-query /retrieve, int8 tier)"
+    elif args.models:
+        fail_metric, fail_unit = "deepfm_samples_per_sec", "samples/sec"
     elif args.pallas:
         fail_metric, fail_unit = "pallas_vs_xla_gather_scatter", "ms"
     elif args.device_profile:
@@ -3611,6 +3750,14 @@ def main() -> None:
 
     if args.streaming:
         stage_streaming(backend, args)
+        return
+
+    if args.retrieval:
+        stage_retrieval(backend, args)
+        return
+
+    if args.models:
+        stage_models(*common)
         return
 
     if args.all:

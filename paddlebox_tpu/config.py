@@ -768,6 +768,61 @@ class StreamingConfig:
 
 
 # --------------------------------------------------------------------------- #
+# Per-scenario serving policy (scenarios/ plane).  One scenario = one
+# served model name; each picks its own artifact dtype, micro-batch
+# linger, request deadline and freshness budget instead of inheriting
+# server-wide knobs (a retrieval surface and a CTR surface have very
+# different latency/freshness contracts over the same table).
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass
+class ScenarioServingConfig:
+    """Serving knobs for one scenario's model name.
+
+    * ``embedding_dtype`` — publish-side: the artifact/delta transport
+      dtype this scenario publishes (Publisher ``embedding_dtype=``);
+    * ``batch_linger_ms`` — the coalescer linger for THIS model's
+      micro-batches (None = the server-wide default; leaders are
+      per-model so the override is exact);
+    * ``deadline_ms`` — this model's default request deadline (the
+      X-Request-Deadline-Ms header still outranks it; None/0 = server
+      default);
+    * ``max_staleness_s`` — the scenario's freshness budget when it runs
+      through the streaming plane (DeadlinePublishPolicy).
+
+    Attach request-path knobs with ``ScoringServer.set_serving_policy``.
+    """
+
+    name: str
+    embedding_dtype: str = "fp32"
+    batch_linger_ms: Optional[float] = None
+    deadline_ms: Optional[float] = None
+    max_staleness_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.embedding_dtype not in ("fp32", "int8", "fp8"):
+            raise ValueError(
+                f"embedding_dtype must be fp32|int8|fp8, got "
+                f"{self.embedding_dtype!r}"
+            )
+        if self.batch_linger_ms is not None and self.batch_linger_ms < 0:
+            raise ValueError("batch_linger_ms must be >= 0")
+        if self.deadline_ms is not None and self.deadline_ms < 0:
+            raise ValueError("deadline_ms must be >= 0")
+        if self.max_staleness_s is not None and self.max_staleness_s <= 0:
+            raise ValueError("max_staleness_s must be positive")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "ScenarioServingConfig":
+        known = {f.name for f in dataclasses.fields(ScenarioServingConfig)}
+        return ScenarioServingConfig(
+            **{k: v for k, v in d.items() if k in known}
+        )
+
+
+# --------------------------------------------------------------------------- #
 # Trainer config — replaces trainer_desc.proto (reference:
 # trainer_desc.proto:21-66,100-108 BoxPSWorkerParameter).
 # --------------------------------------------------------------------------- #

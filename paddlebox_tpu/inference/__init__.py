@@ -1,3 +1,4 @@
+from paddlebox_tpu.inference.ann import AnnIndex, export_ann_index
 from paddlebox_tpu.inference.export import (
     export_model,
     export_serving_programs,
@@ -9,7 +10,9 @@ from paddlebox_tpu.inference.predictor import (
 from paddlebox_tpu.inference.server import ScoringServer
 
 __all__ = [
+    "AnnIndex",
     "EmbeddingDtypeMismatch",
+    "export_ann_index",
     "export_model",
     "export_serving_programs",
     "Predictor",

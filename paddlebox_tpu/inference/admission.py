@@ -334,7 +334,14 @@ class BatchCoalescer:
         up to ``max_batch`` jobs FIFO."""
         q = self._pending[model]
         gate = self._server.gate
-        deadline = time.monotonic() + self.linger_s
+        # per-scenario serving policy: a model's configured linger
+        # (ScoringServer.set_serving_policy) overrides the server-wide
+        # default — leaders are per-model, so the override is exact
+        policy_fn = getattr(self._server, "_policy_linger_s", None)
+        linger_s = policy_fn(model) if policy_fn is not None else None
+        if linger_s is None:
+            linger_s = self.linger_s
+        deadline = time.monotonic() + linger_s
         while len(q) < self.max_batch:
             # an idle queue never waits: linger only while more requests
             # are demonstrably in flight (admitted at the gate but not

@@ -1,0 +1,339 @@
+"""ANN-servable item index exported from the shared SparseTable.
+
+The serving artifact of the two-tower retrieval scenario
+(models/two_tower.py): because the item tower is the IDENTITY over the
+pooled item-slot embedding, the servable index is literally the table's
+item rows — ``row[cvm_offset:]`` L2-normalized — so the existing
+base/delta publish chain (serving_sync/) keeps the index fresh by
+shipping sparse rows, exactly like a ranking artifact.  The serving hot
+loop is the embedding-bag-bound gather+dot profile of "Dissecting
+Embedding Bag Performance in DLRM Inference" (PAPERS.md).
+
+Two scoring tiers over one matrix:
+
+  * ``exact`` — f32 ``queries @ emb.T`` + top-k (the oracle);
+  * ``int8``  — the same matrix through the row codec of
+    inference/quant.py (``quantize_rows`` with ``cvm_offset=0``: first
+    embedding column f32, the rest int8 with one f32 scale per row) —
+    the memory-footprint/bandwidth tier, pinned to recall@10 >= 0.95
+    against exact in tests/test_ann.py.
+
+:class:`AnnIndex` duck-types the Predictor surface the delivery plane
+touches (``meta`` / ``n_features`` / ``bucket_shapes`` /
+``embedding_dtype`` / ``artifact_bytes`` / ``load`` / ``with_delta``),
+so Syncer applies ANN bases and sparse deltas through the same code
+path; ``meta["artifact_kind"] == "ann"`` is the dispatch key.  Like
+Predictor, this module is numpy-only — a retrieval replica needs no
+jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from paddlebox_tpu.inference import quant
+
+META_NAME = "meta.json"
+KEYS_NAME = "ann_keys.npy"
+EMB_NAME = "ann_emb.npy"
+COARSE_NAME = "ann_coarse.npz"
+
+ARTIFACT_KIND = "ann"
+
+
+def _l2_normalize(x: np.ndarray, eps: float = 1e-12) -> np.ndarray:
+    x = np.asarray(x, np.float32)
+    norm = np.sqrt(np.maximum((x * x).sum(axis=1, keepdims=True), eps))
+    return (x / norm).astype(np.float32)
+
+
+def rows_to_item_embeddings(values: np.ndarray, cvm_offset: int,
+                            row_width: int) -> np.ndarray:
+    """Table rows -> normalized item vectors: the ``use_cvm=False``
+    pooled view of a single-key instance (``row[cvm_offset:row_width]``,
+    embed_w scalar + embedx), L2-normalized — bit-identical to what the
+    trained item tower serves for that key."""
+    vals = np.asarray(values, np.float32)[:, cvm_offset:row_width]
+    return _l2_normalize(vals)
+
+
+class AnnIndex:
+    """Normalized item-embedding matrix + exact/int8 top-k scorers."""
+
+    def __init__(self, keys: np.ndarray, emb: np.ndarray, meta: dict):
+        self.keys = np.asarray(keys, dtype=np.uint64)
+        self.emb = np.ascontiguousarray(emb, dtype=np.float32)
+        if self.keys.shape[0] != self.emb.shape[0]:
+            raise ValueError(
+                f"keys/emb row mismatch: {self.keys.shape[0]} vs "
+                f"{self.emb.shape[0]}"
+            )
+        if self.keys.shape[0] > 1 and not bool(
+            np.all(self.keys[1:] > self.keys[:-1])
+        ):
+            raise ValueError("AnnIndex keys must be strictly sorted")
+        self.meta = dict(meta)
+        self.meta.setdefault("artifact_kind", ARTIFACT_KIND)
+        self.meta.setdefault("n_tasks", 1)
+        self._coarse = None  # (head, q, scales) lazily built
+
+    # -- Predictor duck-type surface (delivery plane) ----------------------- #
+    @property
+    def n_features(self) -> int:
+        return int(self.keys.shape[0])
+
+    @property
+    def n_items(self) -> int:
+        return self.n_features
+
+    @property
+    def bucket_shapes(self) -> list:
+        return []  # no compiled program ladder: host-numpy scoring
+
+    @property
+    def embedding_dtype(self) -> str:
+        # the index itself is f32 (the int8 COARSE tier is a per-request
+        # choice, not the artifact's storage dtype)
+        return self.meta.get("embedding_dtype", "fp32")
+
+    @property
+    def artifact_bytes(self) -> int:
+        head, q, scales = self._coarse_tier()
+        return int(self.keys.nbytes + self.emb.nbytes + head.nbytes
+                   + q.nbytes + scales.nbytes)
+
+    def predict(self, batch):
+        raise ValueError(
+            "this model is a retrieval index: POST /retrieve (it has no "
+            "slot-text scoring program)"
+        )
+
+    # -- persistence -------------------------------------------------------- #
+    def save(self, out_dir: str) -> None:
+        os.makedirs(out_dir, exist_ok=True)
+        np.save(os.path.join(out_dir, KEYS_NAME), self.keys)
+        np.save(os.path.join(out_dir, EMB_NAME), self.emb)
+        head, q, scales = self._coarse_tier()
+        np.savez(os.path.join(out_dir, COARSE_NAME),
+                 head=head, q=quant.store_q(q), scales=scales)
+        with open(os.path.join(out_dir, META_NAME), "w") as fh:
+            json.dump(self.meta, fh, indent=1)
+
+    @classmethod
+    def load(cls, artifact_dir: str) -> "AnnIndex":
+        with open(os.path.join(artifact_dir, META_NAME)) as fh:
+            meta = json.load(fh)
+        if meta.get("artifact_kind") != ARTIFACT_KIND:
+            raise ValueError(
+                f"{artifact_dir} is not an ANN artifact "
+                f"(artifact_kind={meta.get('artifact_kind')!r})"
+            )
+        keys = np.load(os.path.join(artifact_dir, KEYS_NAME))
+        emb = np.load(os.path.join(artifact_dir, EMB_NAME))
+        idx = cls(keys, emb, meta)
+        coarse_path = os.path.join(artifact_dir, COARSE_NAME)
+        if os.path.exists(coarse_path):
+            with np.load(coarse_path) as c:
+                idx._coarse = (
+                    np.asarray(c["head"], np.float32),
+                    quant.load_q(c["q"], meta.get("coarse_dtype", "int8")),
+                    np.asarray(c["scales"], np.float32),
+                )
+        return idx
+
+    # -- delta merge (Syncer hot-apply path) -------------------------------- #
+    def with_delta(
+        self,
+        keys: np.ndarray,
+        values: Optional[np.ndarray] = None,
+        program_dir: Optional[str] = None,
+        bucket_meta=None,
+        *,
+        head: Optional[np.ndarray] = None,
+        embedx_q: Optional[np.ndarray] = None,
+        scales: Optional[np.ndarray] = None,
+        embedding_dtype: str = "fp32",
+    ) -> "AnnIndex":
+        """Build-aside merge of a sparse-delta publish: delta rows are
+        FULL table rows (the shared table's union working set — every
+        scenario's touched keys ride one chain), so only keys inside
+        this index's item range update it; the rest are other towers'
+        features and drop out here.  Quantized chains dequantize through
+        the shared codec first (the index stays f32).  program_dir /
+        bucket_meta (re-frozen ranking programs) do not apply to an ANN
+        artifact and are ignored."""
+        del program_dir, bucket_meta
+        keys = np.asarray(keys, dtype=np.uint64)
+        if values is None:
+            if head is None or embedx_q is None or scales is None:
+                raise ValueError(
+                    "with_delta needs values=... or head/embedx_q/scales"
+                )
+            quant.validate_dtype(embedding_dtype)
+            # pbox-lint: ignore[num-dtype-flow] build-aside merge, not a
+            # request path: an ANN artifact stores a normalized f32
+            # matrix, so a quantized delta chain must widen once here
+            values = quant.dequantize_rows(head, embedx_q, scales)
+        values = np.asarray(values, dtype=np.float32)
+        w = int(self.meta["row_width"])
+        co = int(self.meta["cvm_offset"])
+        if values.shape[0] and values.shape[1] < w:
+            raise ValueError(
+                f"delta rows of width {values.shape[1]} < artifact "
+                f"row_width {w}"
+            )
+        lo = np.uint64(self.meta["item_key_lo"])
+        hi = np.uint64(self.meta["item_key_hi"])
+        in_range = (keys >= lo) & (keys <= hi)
+        keys, values = keys[in_range], values[in_range]
+        thr = float(self.meta.get("create_threshold", 0.0))
+        if thr > 0 and keys.shape[0]:
+            # admission parity with pull_rows: rows whose show count sits
+            # below create_threshold serve a zero embedding in training,
+            # so they are not retrievable candidates yet
+            admitted = values[:, 0] >= thr
+            keys, values = keys[admitted], values[admitted]
+        if not keys.shape[0]:
+            return self
+        # dedup delta keys, LAST write wins (publish order within one
+        # delta file is append order)
+        order = np.argsort(keys, kind="stable")
+        keys, values = keys[order], values[order]
+        last = np.ones(keys.shape[0], bool)
+        last[:-1] = keys[1:] != keys[:-1]
+        keys, values = keys[last], values[last]
+        new_emb = rows_to_item_embeddings(values, co, w)
+        pos = np.searchsorted(self.keys, keys)
+        pos_c = np.minimum(pos, max(self.n_features - 1, 0))
+        exists = (self.n_features > 0) & (self.keys[pos_c] == keys)
+        merged_keys = self.keys.copy()
+        merged_emb = self.emb.copy()
+        if exists.any():
+            merged_emb[pos_c[exists]] = new_emb[exists]
+        ins = ~exists
+        if ins.any():
+            merged_keys = np.insert(merged_keys, pos[ins], keys[ins])
+            merged_emb = np.insert(merged_emb, pos[ins], new_emb[ins],
+                                   axis=0)
+        meta = dict(self.meta)
+        meta["n_items"] = int(merged_keys.shape[0])
+        return AnnIndex(merged_keys, merged_emb, meta)
+
+    # -- scoring ------------------------------------------------------------ #
+    def _coarse_tier(self):
+        if self._coarse is None:
+            dtype = self.meta.get("coarse_dtype", "int8")
+            if self.emb.shape[0] == 0:
+                d = self.emb.shape[1] if self.emb.ndim == 2 else 1
+                self._coarse = (
+                    np.zeros((0, 1), np.float32),
+                    np.zeros((0, max(d - 1, 0)),
+                             np.int8 if dtype == "int8"
+                             else quant.fp8_numpy_dtype()),
+                    np.zeros((0,), np.float32),
+                )
+            else:
+                self._coarse = quant.quantize_rows(self.emb, 0, dtype)
+        return self._coarse
+
+    def coarse_matrix(self) -> np.ndarray:
+        """The int8 tier's dequantized matrix (what ``tier="int8"``
+        actually scores against) — the recall-pin oracle pairs this with
+        ``self.emb``."""
+        head, q, scales = self._coarse_tier()
+        if self.emb.shape[0] == 0:
+            return self.emb
+        # pbox-lint: ignore[num-dtype-flow] this IS the coarse tier's
+        # score matrix (built once per artifact, cached) and the recall
+        # oracle the int8-vs-exact pin compares against
+        return quant.dequantize_rows(head, q, scales)
+
+    def search(self, queries: np.ndarray, k: int = 10,
+               tier: str = "exact"):
+        """Top-k by inner product over normalized vectors.  Returns
+        ``(keys [Q, k] uint64, scores [Q, k] f32)``; k clamps to the
+        index size.  Queries are L2-normalized here — callers send raw
+        user-tower outputs."""
+        if tier not in ("exact", "int8"):
+            raise ValueError(f"unknown tier {tier!r} (want exact | int8)")
+        q = np.atleast_2d(np.asarray(queries, np.float32))
+        d = self.emb.shape[1]
+        if q.shape[1] != d:
+            raise ValueError(
+                f"query dim {q.shape[1]} != index embed_dim {d}"
+            )
+        n = self.n_features
+        k = int(k)
+        if k <= 0:
+            raise ValueError(f"k must be positive, got {k}")
+        k = min(k, n)
+        if k == 0:
+            return (np.zeros((q.shape[0], 0), np.uint64),
+                    np.zeros((q.shape[0], 0), np.float32))
+        q = _l2_normalize(q)
+        mat = self.emb if tier == "exact" else self.coarse_matrix()
+        scores = q @ mat.T  # [Q, n]
+        part = np.argpartition(scores, n - k, axis=1)[:, n - k:]
+        part_scores = np.take_along_axis(scores, part, axis=1)
+        order = np.argsort(-part_scores, axis=1, kind="stable")
+        top = np.take_along_axis(part, order, axis=1)
+        return (self.keys[top],
+                np.take_along_axis(scores, top, axis=1).astype(np.float32))
+
+
+def export_ann_index(
+    out_dir: str,
+    table,
+    *,
+    item_key_lo: int,
+    item_key_hi: int,
+    coarse_dtype: str = "int8",
+    feed_conf=None,
+    meta: Optional[dict] = None,
+) -> AnnIndex:
+    """Build + save the ANN artifact from the table's item-key range
+    ``[item_key_lo, item_key_hi]`` (synth data assigns each slot a
+    contiguous feasign range — data/synth.py — so an item SLOT is a key
+    range).  Writes meta.json / keys / emb / int8 coarse tier (+
+    feed.json so the artifact is self-contained like export_model's)."""
+    quant.validate_dtype(coarse_dtype)
+    if coarse_dtype == "fp32":
+        raise ValueError("coarse_dtype must be a quantized tier (int8/fp8)")
+    state = table.state_dict()
+    keys = np.asarray(state["keys"], dtype=np.uint64)
+    values = np.asarray(state["values"], dtype=np.float32)
+    w = int(table.conf.row_width)
+    co = int(table.conf.cvm_offset)
+    lo, hi = np.uint64(item_key_lo), np.uint64(item_key_hi)
+    in_range = (keys >= lo) & (keys <= hi)
+    keys, values = keys[in_range], values[in_range]
+    thr = float(table.conf.create_threshold)
+    if thr > 0 and keys.shape[0]:
+        admitted = values[:, 0] >= thr
+        keys, values = keys[admitted], values[admitted]
+    emb = rows_to_item_embeddings(values, co, w)
+    full_meta = {
+        "artifact_kind": ARTIFACT_KIND,
+        "model_class": "TwoTower",
+        "row_width": w,
+        "cvm_offset": co,
+        "embed_dim": int(w - co),
+        "n_tasks": 1,
+        "embedding_dtype": "fp32",
+        "coarse_dtype": coarse_dtype,
+        "item_key_lo": int(item_key_lo),
+        "item_key_hi": int(item_key_hi),
+        "n_items": int(keys.shape[0]),
+        "create_threshold": thr,
+    }
+    full_meta.update(meta or {})
+    idx = AnnIndex(keys, emb, full_meta)
+    idx.save(out_dir)
+    if feed_conf is not None:
+        with open(os.path.join(out_dir, "feed.json"), "w") as fh:
+            json.dump(feed_conf.to_dict(), fh)
+    return idx

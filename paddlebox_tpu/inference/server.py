@@ -11,11 +11,20 @@ Endpoints:
   POST /score               — body = slot-text lines; scores the default
                               (first-registered) model
   POST /score/<name>        — scores a named model
+  POST /retrieve[/<name>]   — body = {"queries": [[f32...]...], "k": K,
+                              "tier": "exact"|"int8"}; ANN top-k over a
+                              retrieval index (inference/ann.py) behind
+                              the same admission gate as /score
   GET  /healthz             — liveness + per-model metadata
   GET  /models              — registered model names + meta
   GET  /metrics             — Prometheus text exposition (request counts
                               by status class, request-latency histograms
                               by model, every process metric)
+
+Per-scenario serving policy (config.ScenarioServingConfig via
+``set_serving_policy``): a model name can carry its own request
+deadline and micro-batch linger — the scenario plane's serving half
+(a retrieval surface lingers differently than a CTR surface).
 
 A serving host needs JAX (any StableHLO runtime) but none of this
 framework's training machinery beyond the feed parser; clients need only
@@ -40,6 +49,7 @@ from paddlebox_tpu.inference.admission import (
     ShedRequest,
 )
 from paddlebox_tpu.inference.predictor import Predictor
+from paddlebox_tpu.utils import faults
 from paddlebox_tpu.utils.monitor import stats
 
 # per-request serving telemetry: counts split by HTTP status class and
@@ -87,6 +97,13 @@ _DEGRADED = telemetry.gauge(
     "serve.degraded",
     help="1 while this server advertises degraded-mode serving",
 )
+# the retrieval surface's own volume series (requests/latency ride the
+# standard per-request counters; this one counts QUERIES, split by the
+# scoring tier actually used)
+_RETRIEVE_QUERIES = telemetry.counter(
+    "server.retrieve_queries",
+    help="ANN retrieval queries by model + tier (exact/int8)",
+)
 
 
 def _status_class(code: int) -> str:
@@ -127,15 +144,19 @@ class _Httpd(ThreadingHTTPServer):
 
 class ModelEntry:
     def __init__(self, name: str, predictor: Predictor,
-                 feed_conf: DataFeedConfig, version: Optional[dict] = None):
+                 feed_conf: Optional[DataFeedConfig],
+                 version: Optional[dict] = None):
         self.name = name
         self.predictor = predictor
         self.feed_conf = feed_conf
         # one parser per model, reused across requests (thread-safe: the
-        # lock below serializes scoring; parsing itself is stateless)
+        # lock below serializes scoring; parsing itself is stateless).
+        # Retrieval (ANN) artifacts carry no feed schema — their queries
+        # are raw vectors over POST /retrieve — so feed_conf may be None;
+        # /score on such a model refuses cleanly.
         from paddlebox_tpu.data.slot_parser import SlotParser
 
-        self.parser = SlotParser(feed_conf)
+        self.parser = SlotParser(feed_conf) if feed_conf is not None else None
         self.requests = 0
         self.instances = 0
         # delivery lineage (serving_sync registry: base tag + applied
@@ -204,6 +225,10 @@ class ScoringServer:
             BatchCoalescer(self, self.max_batch, linger_ms / 1e3)
             if self.max_batch > 1 else None
         )
+        # per-model serving policies (config.ScenarioServingConfig):
+        # scenario-chosen deadline / linger overrides, consulted by the
+        # request path and the micro-batch coalescer
+        self._policies: dict = {}
         # degraded-mode advertisements: reason -> detail.  The server
         # keeps serving while any are set; /healthz carries them so the
         # fleet router deprioritizes-but-keeps this replica.
@@ -251,13 +276,22 @@ class ScoringServer:
                                 feed_conf, version=version)
 
     def register_predictor(self, name: str, predictor: Predictor,
-                           feed_conf: DataFeedConfig,
+                           feed_conf: Optional[DataFeedConfig],
                            version: Optional[dict] = None) -> None:
         """Register an already-loaded Predictor (the serving_sync syncer's
         entry point: it builds predictors from publish-root artifacts and
         delta merges, then installs them here).  Same hot-swap semantics
         as register(): everything slow/fallible happens BEFORE the lock,
-        the install is one guarded assignment."""
+        the install is one guarded assignment.
+
+        feed_conf None is valid ONLY for retrieval artifacts (predictors
+        exposing ``search``): they take raw query vectors over /retrieve
+        and have no slot-text feed to parse."""
+        if feed_conf is None and not hasattr(predictor, "search"):
+            raise ValueError(
+                f"model {name!r}: a scoring predictor needs a feed schema "
+                "(only retrieval/ANN artifacts register without one)"
+            )
         entry = ModelEntry(name, predictor, feed_conf, version=version)
         if entry.predictor.meta.get("n_tasks", 1) > 1:
             raise ValueError(
@@ -302,6 +336,36 @@ class ScoringServer:
         with self._meta_lock:
             entry = self._models[name or self._default]
             return dict(entry.version) if entry.version else None
+
+    # -- per-scenario serving policy ------------------------------------------ #
+    def set_serving_policy(self, name: str, policy) -> None:
+        """Attach a per-scenario serving policy
+        (config.ScenarioServingConfig) to a model name: its
+        ``deadline_ms`` becomes that model's default request deadline
+        (the X-Request-Deadline-Ms header still outranks it) and its
+        ``batch_linger_ms`` overrides the coalescer's linger for that
+        model's micro-batches.  The policy's ``embedding_dtype`` /
+        ``max_staleness_s`` are publish-side knobs (Publisher /
+        DeadlinePublishPolicy); they ride here only for /healthz
+        introspection."""
+        with self._meta_lock:
+            self._policies[name] = policy
+
+    def serving_policy(self, name: Optional[str]):
+        with self._meta_lock:
+            return self._policies.get(name or self._default)
+
+    def _policy_deadline_s(self, name: Optional[str]):
+        p = self.serving_policy(name)
+        if p is not None and getattr(p, "deadline_ms", None):
+            return float(p.deadline_ms) / 1e3
+        return None
+
+    def _policy_linger_s(self, name: Optional[str]):
+        p = self.serving_policy(name)
+        if p is not None and getattr(p, "batch_linger_ms", None) is not None:
+            return max(0.0, float(p.batch_linger_ms) / 1e3)
+        return None
 
     # -- degraded-mode advertisement ----------------------------------------- #
     def set_degraded(self, reason: str, detail: str = "") -> None:
@@ -361,6 +425,11 @@ class ScoringServer:
             predictor = entry.predictor
         from paddlebox_tpu.data.feed import BatchBuilder
 
+        if entry.parser is None:
+            raise ValueError(
+                f"model {entry.name!r} is a retrieval index with no feed "
+                "schema: query it via POST /retrieve, not /score"
+            )
         lines = [ln for ln in text.decode().splitlines() if ln.strip()]
         block = entry.parser.parse_lines(lines)
         builder = BatchBuilder(entry.feed_conf)
@@ -413,6 +482,72 @@ class ScoringServer:
             entry.requests += 1
             entry.instances += len(scores)
         return scores
+
+    # -- retrieval ----------------------------------------------------------- #
+    def retrieve(self, body: bytes, name: Optional[str] = None) -> dict:
+        """ANN top-k over a registered retrieval index (inference/ann.py).
+
+        ``body`` is JSON: ``{"queries": [[f32...], ...], "k": 10,
+        "tier": "exact" | "int8"}`` — queries are user-tower output
+        vectors (the user tower runs client-side; the standard
+        two-tower serving split).  Raises KeyError for an unknown model
+        (404), ValueError for a non-retrieval model or malformed
+        request (400).  Scoring is host numpy over a predictor snapshot
+        pinned at entry — no device lock: /retrieve never queues behind
+        /score's device work."""
+        with self._meta_lock:
+            entry = self._models[name or self._default]
+            # pin ONE index snapshot: a concurrent delta hot-swap must
+            # never split a request across two index versions
+            predictor = entry.predictor
+        if not hasattr(predictor, "search"):
+            raise ValueError(
+                f"model {entry.name!r} is a scoring artifact, not a "
+                "retrieval index: POST /score"
+            )
+        try:
+            req = json.loads(body.decode())
+        except json.JSONDecodeError as e:
+            raise ValueError(f"retrieve body must be JSON: {e}") from e
+        if not isinstance(req, dict) or "queries" not in req:
+            raise ValueError(
+                'retrieve body needs {"queries": [[f32...], ...]}'
+            )
+        import numpy as np
+
+        queries = np.asarray(req["queries"], dtype=np.float32)
+        if queries.ndim == 1:
+            queries = queries[None, :]
+        if queries.ndim != 2 or queries.shape[0] == 0:
+            raise ValueError(
+                f"queries must be a non-empty [n, d] float matrix, got "
+                f"shape {queries.shape}"
+            )
+        k = int(req.get("k", 10))
+        tier = str(req.get("tier", "exact"))
+        # chaos site: an injected fault here exercises the 5xx path +
+        # the router's failover through a live /retrieve
+        faults.inject("retrieve.query")
+        with telemetry.span(
+            "server.retrieve", model=entry.name,
+            n_queries=int(queries.shape[0]), tier=tier,
+        ):
+            keys, scores = predictor.search(queries, k=k, tier=tier)
+        _RETRIEVE_QUERIES.inc(
+            int(queries.shape[0]), model=entry.name, tier=tier
+        )
+        with self._meta_lock:
+            entry.requests += 1
+            entry.instances += int(queries.shape[0])
+        return {
+            "results": [
+                {"keys": [int(x) for x in kk],
+                 "scores": [float(s) for s in ss]}
+                for kk, ss in zip(keys, scores)
+            ],
+            "tier": tier,
+            "n_items": int(predictor.n_features),
+        }
 
     def _count_extra_requests(self, name: str, n: int) -> None:
         """The coalescer scored ``n + 1`` client requests as one combined
@@ -546,17 +681,34 @@ class ScoringServer:
 
             def _do_post_traced(self):
                 t0 = time.perf_counter()
-                if self.path == "/score":
-                    name = None
-                elif self.path.startswith("/score/"):
-                    name = self.path[len("/score/"):]
-                    if not name or "/" in name or "?" in name:
-                        self._send(404, {"error": "not found"})
-                        server._record_request(name, self._status, t0)
-                        return
-                else:
+                # strict routing: exactly /score[/<name>] or
+                # /retrieve[/<name>].  Any other POST path is a clean 404
+                # counted under the standard request split (model "-",
+                # status 4xx) — never scoring-shaped error handling.
+                op = name = None
+                for prefix, handler in (("/score", self._do_score),
+                                        ("/retrieve", self._do_retrieve)):
+                    if self.path == prefix:
+                        op, name = handler, None
+                        break
+                    if self.path.startswith(prefix + "/"):
+                        name = self.path[len(prefix) + 1:]
+                        if not name or "/" in name or "?" in name:
+                            # malformed names also count under "-": raw
+                            # client junk must not mint counter series
+                            # (counted before the reply flushes so the
+                            # counter is visible once the client has it)
+                            server._record_request("-", 404, t0)
+                            self._send(404, {"error": "not found"})
+                            return
+                        op = handler
+                        break
+                if op is None:
+                    # unroutable path: count under "-", never the default
+                    # model (its p99/error split must not absorb junk);
+                    # counted before the reply flushes
+                    server._record_request("-", 404, t0)
                     self._send(404, {"error": "not found"})
-                    server._record_request(None, self._status, t0)
                     return
                 if not server._begin_request():
                     # draining: a rolling deploy already unrouted us, but a
@@ -566,7 +718,7 @@ class ScoringServer:
                     server._record_request(name, self._status, t0)
                     return
                 try:
-                    self._do_score(name)
+                    op(name)
                 finally:
                     server._end_request()
                     server._record_request(name, self._status, t0)
@@ -597,11 +749,12 @@ class ScoringServer:
                     return None
                 return self.rfile.read(n)
 
-            def _deadline_s(self):
+            def _deadline_s(self, name=None):
                 """Per-request deadline: X-Request-Deadline-Ms header
-                outranks the server's configured default.  Unparsable
-                header values fall back to the default (a malformed hint
-                must not turn a scorable request into an error)."""
+                outranks the model's serving-policy deadline, which
+                outranks the server default.  Unparsable header values
+                fall back down the ladder (a malformed hint must not
+                turn a scorable request into an error)."""
                 raw = self.headers.get("X-Request-Deadline-Ms")
                 if raw is not None:
                     try:
@@ -610,6 +763,9 @@ class ScoringServer:
                             return ms / 1e3
                     except ValueError:
                         pass
+                policy = server._policy_deadline_s(name)
+                if policy is not None:
+                    return policy
                 return server.gate.default_deadline_s
 
             def _do_score(self, name):
@@ -618,7 +774,7 @@ class ScoringServer:
                     if body is None:
                         return
                     t_arrival = time.monotonic()
-                    deadline_s = self._deadline_s()
+                    deadline_s = self._deadline_s(name)
                     try:
                         server.gate.admit(deadline_s)
                     except ShedRequest as shed:
@@ -689,6 +845,44 @@ class ScoringServer:
                     # server itself survives either way
                     logging.getLogger(__name__).exception(
                         "internal error scoring %s", self.path
+                    )
+                    self._send(500, {"error": repr(e)[:300]})
+
+            def _do_retrieve(self, name):
+                """/score's admission/error contract over the ANN
+                surface: gate admit → server.retrieve → release.  No
+                coalescer — retrieval is host-numpy matrix work, there
+                is no device batch to amortize."""
+                try:
+                    body = self._read_body()
+                    if body is None:
+                        return
+                    deadline_s = self._deadline_s(name)
+                    try:
+                        server.gate.admit(deadline_s)
+                    except ShedRequest as shed:
+                        self._send(
+                            429,
+                            {"error": f"overloaded: {shed.reason}",
+                             "retry_after_s": round(shed.retry_after_s, 3)},
+                            headers={"Retry-After": shed.retry_after_header},
+                        )
+                        return
+                    service_s = None
+                    try:
+                        t_q = time.perf_counter()
+                        payload = server.retrieve(body, name)
+                        service_s = time.perf_counter() - t_q
+                    finally:
+                        server.gate.release(service_s)
+                    self._send(200, payload)
+                except KeyError:
+                    self._send(404, {"error": f"unknown model {name!r}"})
+                except (ValueError, UnicodeDecodeError) as e:
+                    self._send(400, {"error": repr(e)[:300]})
+                except Exception as e:
+                    logging.getLogger(__name__).exception(
+                        "internal error retrieving %s", self.path
                     )
                     self._send(500, {"error": repr(e)[:300]})
 

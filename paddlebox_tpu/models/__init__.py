@@ -8,6 +8,7 @@ from paddlebox_tpu.models.longseq_ctr import LongSeqCtrDnn
 from paddlebox_tpu.models.mmoe import MMoE
 from paddlebox_tpu.models.pipelined_ctr import PipelinedCtrDnn
 from paddlebox_tpu.models.rank_ctr import RankCtrDnn
+from paddlebox_tpu.models.two_tower import TwoTower
 from paddlebox_tpu.models.wide_deep import WideDeep
 from paddlebox_tpu.models.xdeepfm import XDeepFM
 
@@ -19,6 +20,7 @@ __all__ = [
     "MMoE",
     "PipelinedCtrDnn",
     "RankCtrDnn",
+    "TwoTower",
     "WideDeep",
     "XDeepFM",
     "bce_with_logits",

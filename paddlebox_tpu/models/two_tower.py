@@ -1,0 +1,128 @@
+"""Two-tower retrieval model over the shared SparseTable.
+
+The candidate-generation half of a recsys next to the ranking towers
+(SURVEY.md north star: "as many scenarios as you can imagine" on one
+table).  The USER tower is a dense MLP over the pooled user-slot
+embeddings + dense features; the ITEM tower is deliberately the
+IDENTITY over the pooled item-slot embedding — no dense layers — so a
+served ANN index is exactly the table's item rows (``row[cvm_offset:]``,
+the ``use_cvm=False`` pooled view) L2-normalized, and a sparse delta
+publish honestly updates the serving index with no re-export of dense
+params (inference/ann.py builds the index straight from those rows).
+
+Trained with in-batch sampled-softmax negatives
+(scenarios/retrieval.py): every other instance's item in the batch is a
+negative, the diagonal is the positive — the standard two-tower recipe
+("Sampling-bias-corrected neural modeling", and the embedding-bag-bound
+serving profile of "Dissecting Embedding Bag Performance in DLRM
+Inference", PAPERS.md).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddlebox_tpu.models.layers import init_mlp, mlp, resolve_compute_dtype
+from paddlebox_tpu.ops import fused_seqpool_cvm
+
+
+class TwoTower:
+    """params-in/params-out; ``apply_towers`` returns the normalized
+    (user, item) embedding pair, ``apply`` their scaled dot logits [B]
+    (so Trainer-style AUC over clicked/unclicked pairs still works)."""
+
+    retrieval = True  # scenario plumbing dispatches on this marker
+
+    def __init__(
+        self,
+        n_sparse_slots: int,
+        emb_width: int,  # pulled row width (cvm_offset + embedding_dim)
+        item_slots: Sequence[int],
+        dense_dim: int = 0,
+        hidden: Sequence[int] = (128, 64),
+        cvm_offset: int = 2,
+        temperature: float = 0.05,
+        compute_dtype: str = "",
+    ):
+        self.compute_dtype = resolve_compute_dtype(compute_dtype)
+        self.n_sparse_slots = n_sparse_slots
+        self.emb_width = emb_width
+        self.dense_dim = dense_dim
+        self.hidden = tuple(hidden)
+        self.cvm_offset = cvm_offset
+        self.temperature = float(temperature)
+        items = sorted(set(int(s) for s in item_slots))
+        bad = [s for s in items if not 0 <= s < n_sparse_slots]
+        if bad:
+            raise ValueError(
+                f"item_slots {bad} out of range [0, {n_sparse_slots})"
+            )
+        if not items or len(items) == n_sparse_slots:
+            raise ValueError(
+                "item_slots must be a proper non-empty subset of the slots "
+                "(both towers need features)"
+            )
+        self.item_slots = tuple(items)
+        self.user_slots = tuple(
+            s for s in range(n_sparse_slots) if s not in set(items)
+        )
+        # the pooled use_cvm=False view of one slot: row[cvm_offset:]
+        self.embed_dim = emb_width - cvm_offset
+        if self.embed_dim <= 0:
+            raise ValueError(
+                f"emb_width {emb_width} leaves no embedding columns past "
+                f"cvm_offset {cvm_offset}"
+            )
+        # the user MLP projects into the item-embedding space: its output
+        # width is pinned to embed_dim so user @ item.T is well-formed
+        self.input_dim = len(self.user_slots) * self.embed_dim + dense_dim
+
+    def init(self, key: jax.Array) -> dict:
+        return {"user": init_mlp(key, self.input_dim, self.hidden,
+                                 self.embed_dim)}
+
+    def apply_towers(
+        self,
+        params: dict,
+        rows: jax.Array,  # [K, emb_width] pulled rows
+        key_segments: jax.Array,  # [K]
+        dense: jax.Array,  # [B, dense_dim]
+        batch_size: int,
+    ):
+        """(user [B, D], item [B, D]), both L2-normalized."""
+        pooled = fused_seqpool_cvm(
+            rows, key_segments, batch_size, self.n_sparse_slots,
+            use_cvm=False, cvm_offset=self.cvm_offset,
+        ).reshape(batch_size, self.n_sparse_slots, self.embed_dim)
+        user_x = pooled[:, self.user_slots, :].reshape(batch_size, -1)
+        if self.dense_dim:
+            user_x = jnp.concatenate([user_x, dense], axis=1)
+        user = mlp(params["user"], user_x, self.compute_dtype)
+        # identity item tower: the summed pooled item-slot embedding IS
+        # the servable vector (see module docstring)
+        item = pooled[:, self.item_slots, :].sum(axis=1)
+        return _l2_normalize(user), _l2_normalize(item)
+
+    def apply(
+        self,
+        params: dict,
+        rows: jax.Array,
+        key_segments: jax.Array,
+        dense: jax.Array,
+        batch_size: int,
+    ) -> jax.Array:
+        """Pointwise logits [B]: each instance's own (user, item) pair
+        scored — the eval/AUC view of the retrieval tower."""
+        user, item = self.apply_towers(
+            params, rows, key_segments, dense, batch_size
+        )
+        return (user * item).sum(axis=1) / self.temperature
+
+
+def _l2_normalize(x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    norm = jnp.sqrt(jnp.maximum(jnp.sum(jnp.square(x), axis=-1,
+                                        keepdims=True), eps))
+    return x / norm

@@ -1,0 +1,17 @@
+"""Multi-scenario training plane: N heterogeneous towers, ONE SparseTable.
+
+The "as many scenarios as you can imagine" half of the north star —
+many surfaces (CTR, CVR, long-sequence, retrieval) train concurrently
+against one shared sparse table with per-scenario slot policies, and
+the pass machinery (census, promotion, HBM cache) sees the UNION
+working set (the hybrid-by-sparsity regime of Parallax, PAPERS.md).
+"""
+
+from paddlebox_tpu.scenarios.multi import MultiScenarioTrainer, ScenarioSpec
+from paddlebox_tpu.scenarios.retrieval import RetrievalTrainer
+
+__all__ = [
+    "MultiScenarioTrainer",
+    "RetrievalTrainer",
+    "ScenarioSpec",
+]

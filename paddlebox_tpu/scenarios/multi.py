@@ -1,0 +1,252 @@
+"""MultiScenarioTrainer: interleaved passes of N towers over ONE table.
+
+Each scenario is a (model, slot policy, trainer config) triple — a CTR
+tower over one slot subset, a CVR tower with its own create-threshold, a
+two-tower retrieval objective — all pulling from and pushing to the SAME
+:class:`~paddlebox_tpu.sparse.table.SparseTable`.  One shared pass per
+round: the census is the UNION of every scenario's keys (so promotion /
+HBM-cache machinery sees the true working set), scenario mini-batches
+interleave round-robin inside the pass, and the shared ``values`` /
+``g2sum`` device buffers thread through every scenario's jitted step in
+arrival order — bit-deterministic given fixed seeds and datasets (the
+determinism pin in tests/test_scenarios.py).
+
+Slot-policy semantics per scenario:
+
+  * ``slot_mask`` — participating slots (Trainer slot gating: excluded
+    slots pool zero, receive no gradients, bump no counters);
+  * per-slot embedding-dim views ride the MODEL (``slot_embed_dims`` on
+    CtrDnn: masked embedx columns read zero and get zero grads);
+  * ``create_threshold`` — a pull-time admission override: the scenario's
+    step gathers embeddings only for rows whose show count cleared ITS
+    threshold, while the shared table keeps one physical row per key.
+
+Scenario is a first-class telemetry label: per-scenario AUC/loss gauges,
+step/sample counters, a ``scenario_pass`` event per scenario per pass,
+and the pass span carries the scenario count — all riding the lineage
+plumbing, so ≥3 concurrent scenarios stay separately attributable.
+Publishes tag their scenario through ``PublishEntry.meta`` (pass
+``meta={"scenario": name}`` / a scenario ``tag_prefix`` on the streaming
+plane's DeadlinePublishPolicy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from paddlebox_tpu import telemetry
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.metrics.auc import compute_metrics
+from paddlebox_tpu.scenarios.retrieval import RetrievalTrainer
+from paddlebox_tpu.train.trainer import (
+    NonFiniteBatchError,
+    Trainer,
+    _host_batch_dict,
+    _to_device,
+)
+from paddlebox_tpu.utils.monitor import stats
+
+_SCENARIO_STEPS = telemetry.counter(
+    "scenario.steps", help="interleaved train steps by scenario"
+)
+_SCENARIO_SAMPLES = telemetry.counter(
+    "scenario.samples", help="trained instances by scenario"
+)
+_SCENARIO_AUC = telemetry.gauge(
+    "scenario.auc", help="per-pass AUC by scenario"
+)
+_SCENARIO_LOSS = telemetry.gauge(
+    "scenario.loss", help="per-pass mean loss by scenario"
+)
+
+_KINDS = ("ranking", "retrieval")
+
+
+@dataclasses.dataclass
+class ScenarioSpec:
+    """One scenario: a dense tower + its slot/admission/trainer policy."""
+
+    name: str
+    model: Any
+    kind: str = "ranking"  # "ranking" (pointwise logloss) | "retrieval"
+    slot_mask: Optional[tuple] = None  # participating slots (None = all)
+    create_threshold: Optional[float] = None  # pull-time admission override
+    trainer_conf: Optional[TrainerConfig] = None
+    seed: int = 0
+
+
+class MultiScenarioTrainer:
+    """Owns one Trainer per scenario; drives them through shared passes."""
+
+    def __init__(self, table_conf: SparseTableConfig, specs):
+        specs = list(specs)
+        if not specs:
+            raise ValueError("need at least one ScenarioSpec")
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate scenario names in {names}")
+        self.table_conf = table_conf
+        self.specs = {s.name: s for s in specs}
+        self._order = tuple(names)  # interleave order = spec order
+        self.trainers: dict = {}
+        for spec in specs:
+            if spec.kind not in _KINDS:
+                raise ValueError(
+                    f"scenario {spec.name!r}: unknown kind {spec.kind!r} "
+                    f"(want one of {_KINDS})"
+                )
+            tconf = table_conf
+            if spec.create_threshold is not None:
+                # pull-time-only parameter: safe to vary over the shared
+                # physical rows (row width and layout are the table's)
+                tconf = dataclasses.replace(
+                    table_conf, create_threshold=spec.create_threshold
+                )
+            cls = RetrievalTrainer if spec.kind == "retrieval" else Trainer
+            self.trainers[spec.name] = cls(
+                spec.model, tconf, spec.trainer_conf, seed=spec.seed,
+                slot_mask=spec.slot_mask,
+            )
+        self._pass_idx = 0
+        self.last_metrics: Optional[dict] = None
+
+    def scenario_names(self) -> tuple:
+        return self._order
+
+    def union_census(self, datasets: dict) -> np.ndarray:
+        """The shared pass's key census: the union of every scenario's
+        working set, so table promotion/caching decisions see what will
+        actually be touched."""
+        parts = [
+            np.asarray(datasets[name].unique_keys(), dtype=np.uint64)
+            for name in self._order
+        ]
+        return np.unique(np.concatenate(parts)) if parts else np.empty(
+            0, np.uint64
+        )
+
+    def train_pass(self, datasets: dict, table,
+                   drop_last: bool = False) -> dict:
+        """One interleaved pass: begin_pass(union census) -> round-robin
+        one mini-batch per scenario until all datasets drain -> end_pass.
+        Returns ``{scenario: metrics}`` (AUC/loss/steps/samples per
+        scenario).  The caller maps ``datasets`` by scenario name; every
+        scenario needs one."""
+        missing = [n for n in self._order if n not in datasets]
+        if missing:
+            raise ValueError(f"no dataset for scenario(s) {missing}")
+        table.begin_pass(self.union_census(datasets))
+        try:
+            results = self._run_interleaved(datasets, table, drop_last)
+        except BaseException:
+            table.abort_pass()
+            raise
+        table.end_pass()
+        self._observe_pass(results)
+        self._pass_idx += 1
+        self.last_metrics = results
+        return results
+
+    def _run_interleaved(self, datasets: dict, table,
+                         drop_last: bool) -> dict:
+        for tr in self.trainers.values():
+            if tr._step_fn is None:
+                tr._step_fn = tr._build_step()
+        mstates = {
+            n: self.trainers[n]._init_mstate(
+                self.trainers[n].last_metric_state
+            )
+            for n in self._order
+        }
+        losses: dict = {n: [] for n in self._order}
+        steps = {n: 0 for n in self._order}
+        samples = {n: 0.0 for n in self._order}
+        t0 = time.monotonic()
+        values, g2sum = table.values, table.g2sum
+        try:
+            with telemetry.span(
+                "scenarios.pass", pass_idx=self._pass_idx,
+                n_scenarios=len(self._order),
+            ):
+                iters = {
+                    n: datasets[n].batches(drop_last=drop_last)
+                    for n in self._order
+                }
+                alive = list(self._order)
+                while alive:
+                    for name in list(alive):
+                        try:
+                            batch = next(iters[name])
+                        except StopIteration:
+                            alive.remove(name)
+                            continue
+                        tr = self.trainers[name]
+                        plan = table.plan_batch(batch)
+                        host = _host_batch_dict(
+                            batch, plan, batch.n_sparse_slots,
+                            tr.conf.counter_label_tasks,
+                            slot_lr_vec=tr._slot_lr_vec,
+                        )
+                        dev = _to_device(host)
+                        # the SHARED values/g2sum buffers thread through
+                        # every scenario's step in interleave order; each
+                        # step donates and returns them
+                        (tr.params, tr.opt_state, values, g2sum,
+                         mstates[name], loss, finite, _preds) = tr._step_fn(
+                            tr.params, tr.opt_state, values, g2sum,
+                            mstates[name], dev,
+                        )
+                        if tr._check_nan and not bool(finite):
+                            if tr.conf.nan_policy == "skip_batch":
+                                # the guarded step already kept pre-batch
+                                # state: the batch contributed nothing
+                                stats.add("train.nan_skipped_steps")
+                                continue
+                            raise NonFiniteBatchError(
+                                f"non-finite loss/grad in scenario "
+                                f"{name!r} at step {tr.global_step}"
+                            )
+                        losses[name].append(loss)
+                        steps[name] += 1
+                        tr.global_step += 1
+                        samples[name] += float(batch.ins_mask.sum())
+        finally:
+            # buffers were donated to the jitted steps: hand the live
+            # ones back so end_pass/abort_pass write back real state
+            table.values, table.g2sum = values, g2sum
+        duration = time.monotonic() - t0
+        results = {}
+        for name in self._order:
+            tr = self.trainers[name]
+            m = compute_metrics(mstates[name]["auc"])
+            m["loss"] = (
+                float(np.mean([float(l) for l in losses[name]]))
+                if losses[name] else 0.0
+            )
+            m["steps"] = steps[name]
+            m["samples"] = samples[name]
+            m["duration_s"] = duration
+            tr.last_auc_state = mstates[name]["auc"]
+            tr.last_metric_state = mstates[name]
+            tr._pass_idx += 1
+            results[name] = m
+        return results
+
+    def _observe_pass(self, results: dict) -> None:
+        for name, m in results.items():
+            if "auc" in m:
+                _SCENARIO_AUC.set(float(m["auc"]), scenario=name)
+            _SCENARIO_LOSS.set(float(m["loss"]), scenario=name)
+            if m["steps"]:
+                _SCENARIO_STEPS.inc(m["steps"], scenario=name)
+            if m["samples"]:
+                _SCENARIO_SAMPLES.inc(m["samples"], scenario=name)
+            telemetry.emit_event(
+                "scenario_pass", scenario=name, pass_idx=self._pass_idx,
+                auc=m.get("auc"), loss=m["loss"], steps=m["steps"],
+                samples=m["samples"],
+            )

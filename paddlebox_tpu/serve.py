@@ -106,6 +106,14 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="max wait for a forming micro-batch to fill "
                          "(default PBOX_SERVE_BATCH_LINGER_MS; an idle "
                          "queue never waits)")
+    ap.add_argument("--serving-policy", action="append", default=[],
+                    metavar="NAME:k=v[,k=v...]",
+                    help="per-scenario serving policy (repeatable): "
+                         "NAME[:deadline_ms=..][,batch_linger_ms=..]"
+                         "[,embedding_dtype=fp32|int8|fp8]"
+                         "[,max_staleness_s=..] — overrides the server "
+                         "defaults for POST /score/NAME and "
+                         "/retrieve/NAME")
     ap.add_argument("--log-dir", default=None,
                     help="fleet mode: write per-replica logs here")
     ap.add_argument("--autoscale", action="store_true",
@@ -115,6 +123,33 @@ def _build_parser() -> argparse.ArgumentParser:
                          "PBOX_AUTOSCALE_MIN_REPLICAS / "
                          "PBOX_AUTOSCALE_MAX_REPLICAS band")
     return ap
+
+
+def _parse_serving_policy(spec: str):
+    """``NAME:k=v,k=v`` -> ScenarioServingConfig.  Numeric keys take
+    floats; embedding_dtype is passed through for the config's own
+    validation to reject."""
+    from paddlebox_tpu.config import ScenarioServingConfig
+
+    name, _, rest = spec.partition(":")
+    name = name.strip()
+    if not name:
+        raise ValueError(f"--serving-policy {spec!r}: empty scenario name")
+    kw = {}
+    for part in filter(None, (p.strip() for p in rest.split(","))):
+        key, sep, val = part.partition("=")
+        key = key.strip()
+        if not sep:
+            raise ValueError(
+                f"--serving-policy {spec!r}: expected k=v, got {part!r}")
+        if key in ("deadline_ms", "batch_linger_ms", "max_staleness_s"):
+            kw[key] = float(val)
+        elif key == "embedding_dtype":
+            kw[key] = val.strip()
+        else:
+            raise ValueError(
+                f"--serving-policy {spec!r}: unknown key {key!r}")
+    return ScenarioServingConfig(name=name, **kw)
 
 
 def _replica_argv(args, replica_id: int, port: int) -> list:
@@ -148,6 +183,8 @@ def _replica_argv(args, replica_id: int, port: int) -> list:
         argv += ["--max-batch", str(args.max_batch)]
     if args.batch_linger_ms is not None:
         argv += ["--batch-linger-ms", str(args.batch_linger_ms)]
+    for spec in args.serving_policy:
+        argv += ["--serving-policy", spec]
     return argv
 
 
@@ -229,6 +266,13 @@ def main(argv=None) -> None:
         max_batch=args.max_batch,
         batch_linger_ms=args.batch_linger_ms,
     )
+    for spec in args.serving_policy:
+        try:
+            policy = _parse_serving_policy(spec)
+        except ValueError as exc:
+            ap.error(str(exc))
+        server.set_serving_policy(policy.name, policy)
+        print(f"serving policy {policy.name!r}: {policy.to_dict()}")
     for spec in args.artifact:
         name, sep, path = spec.partition("=")
         if not sep:

@@ -521,8 +521,14 @@ class FleetRouter:
                     self._send_json(404, {"error": "not found"})
 
             def do_POST(self):
-                if self.path != "/score" \
-                        and not self.path.startswith("/score/"):
+                # the router fronts both serving surfaces: /score[/name]
+                # (ranking) and /retrieve[/name] (ANN retrieval) share
+                # the same failover/deadline/outcome machinery — the
+                # forwarded path is opaque to route_request.  Anything
+                # else is a clean 404 here, never forwarded.
+                if self.path not in ("/score", "/retrieve") \
+                        and not self.path.startswith("/score/") \
+                        and not self.path.startswith("/retrieve/"):
                     self._send_json(404, {"error": "not found"})
                     return
                 try:

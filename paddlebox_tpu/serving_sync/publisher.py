@@ -248,6 +248,80 @@ class Publisher:
             )
             return entry
 
+    def publish_ann_base(
+        self,
+        tag: str,
+        table,
+        *,
+        item_key_lo: int,
+        item_key_hi: int,
+        feed_conf=None,
+        coarse_dtype: str = "int8",
+        metrics: Optional[dict] = None,
+        meta: Optional[dict] = None,
+        lineage: Optional[str] = None,
+    ) -> Optional[PublishEntry]:
+        """Publish a retrieval scenario's ANN artifact as the chain's
+        base (inference/ann.py: normalized item rows + int8 coarse
+        tier).  Same discipline and donefile chain as publish_base —
+        stage, manifest, verified upload, donefile LAST, delta tracker
+        cleared only once visible — so subsequent ``publish_delta(tag,
+        table)`` calls keep the index fresh: the syncer dispatches on
+        ``meta.json["artifact_kind"]`` and merges delta rows through
+        ``AnnIndex.with_delta`` (item-range keys update the index, the
+        other scenarios' rows drop out).  The chain's embedding dtype is
+        fp32: the index stores f32 vectors; int8 is a per-request
+        scoring tier, not the transport dtype."""
+        if self._gated(metrics):
+            return None
+        meta = dict(meta or {})
+        if lineage is not None:
+            meta["lineage"] = str(lineage)
+        from paddlebox_tpu.inference.ann import export_ann_index
+
+        with telemetry.span("publish.ann", tag=tag), \
+                _PUBLISH_SECONDS.time(kind="base"):
+            local = os.path.join(self.staging, f"base-{tag}")
+            if os.path.exists(local):
+                shutil.rmtree(local)
+            idx = export_ann_index(
+                local, table,
+                item_key_lo=item_key_lo, item_key_hi=item_key_hi,
+                coarse_dtype=coarse_dtype, feed_conf=feed_conf,
+                meta={k: v for k, v in meta.items()
+                      if k in ("scenario", "lineage")},
+            )
+            write_manifest(local, "manifest.json", recursive=True)
+            self._upload(local, f"base-{tag}", site="publish.upload",
+                         kind="base")
+            # remember the delta-export shape: an ANN chain's deltas are
+            # rows-only (no re-frozen programs), fp32 transport
+            self._export_kw = {
+                "row_width": table.conf.row_width,
+                "embedding_dtype": "fp32",
+                "cvm_offset": table.conf.cvm_offset,
+                "create_threshold": table.conf.create_threshold,
+                "pull_embedx_scale": table.conf.pull_embedx_scale,
+                "feed_conf": feed_conf,
+            }
+            entry = PublishEntry(
+                seq=self.next_seq, kind="base", tag=tag, dir=f"base-{tag}",
+                base_tag=tag, prev_tag=self.last_tag,
+                published_at=time.time(), n_rows=int(idx.n_items),
+                has_programs=False, embedding_dtype="fp32",
+                n_bytes=_dir_bytes(local),
+                meta={**meta, "artifact_kind": "ann"},
+            )
+            self._append_donefile(entry)
+            table.clear_delta()
+            _PUBLISHED.inc(kind="base")
+            telemetry.emit_event(
+                "published", kind="base", tag=tag, seq=entry.seq,
+                lineage=meta.get("lineage"), n_rows=entry.n_rows,
+                scenario=meta.get("scenario"),
+            )
+            return entry
+
     def publish_delta(
         self,
         tag: str,

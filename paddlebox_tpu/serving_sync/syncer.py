@@ -274,7 +274,26 @@ class Syncer:
         from paddlebox_tpu.inference.predictor import Predictor
 
         local = self._fetch(entry)
-        predictor = Predictor.load(local)
+        # artifact-kind dispatch: an ANN (retrieval) base loads as an
+        # AnnIndex — it duck-types the Predictor surface this plane
+        # touches, so the chain check / delta merge / install path below
+        # is shared verbatim.  The kind rides BOTH the artifact's
+        # meta.json and the donefile entry meta; the artifact's copy is
+        # authoritative (it was manifest-verified with the bytes).
+        kind = entry.meta.get("artifact_kind")
+        meta_path = os.path.join(local, "meta.json")
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as fh:
+                    kind = json.load(fh).get("artifact_kind", kind)
+            except (OSError, ValueError):
+                pass  # corrupt meta surfaces from the loader below
+        if kind == "ann":
+            from paddlebox_tpu.inference.ann import AnnIndex
+
+            predictor = AnnIndex.load(local)
+        else:
+            predictor = Predictor.load(local)
         feed_conf = self.feed_conf
         if feed_conf is None:
             path = os.path.join(local, "feed.json")
@@ -283,7 +302,9 @@ class Syncer:
 
                 with open(path) as fh:
                     feed_conf = DataFeedConfig.from_dict(json.load(fh))
-            else:
+            elif kind != "ann":
+                # an ANN index serves raw query vectors (/retrieve): it
+                # has no slot-text feed and registers without one
                 raise CheckpointCorrupt(
                     f"base {entry.tag}: no feed.json in the artifact and "
                     "no feed_conf configured on the syncer"
@@ -350,7 +371,7 @@ class Syncer:
         if self.name in self.server.model_names():
             self.server.swap_model(self.name, predictor, version=lineage)
         else:
-            if feed_conf is None:
+            if feed_conf is None and not hasattr(predictor, "search"):
                 raise CheckpointCorrupt(
                     f"model {self.name!r} not registered and no feed "
                     "schema available to register it"

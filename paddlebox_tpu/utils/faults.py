@@ -118,6 +118,11 @@ KNOWN_SITES = {
     # tracker is NOT cleared, the next save retries the same rows)
     "store.segment_write", "store.compact", "store.manifest_commit",
     "ckpt.delta_save",
+    # ANN retrieval surface (inference/server.py retrieve): a failure
+    # between admission and search (failure => 500 to the caller; behind
+    # the fleet router the verbatim-body failover retries the request on
+    # the next replica, same as a failed /score forward)
+    "retrieve.query",
 }
 
 

@@ -1,0 +1,333 @@
+"""ANN retrieval serving (inference/ann.py + POST /retrieve): artifact
+roundtrip, exact/int8 search with the recall@10 pin, delta merge
+semantics, and the full train -> publish -> sync -> /retrieve-through-
+the-router e2e with failover chaos on the retrieve path."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.inference import ScoringServer
+from paddlebox_tpu.inference.ann import (
+    AnnIndex,
+    export_ann_index,
+    rows_to_item_embeddings,
+)
+from paddlebox_tpu.models import TwoTower
+from paddlebox_tpu.scenarios import MultiScenarioTrainer, ScenarioSpec
+from paddlebox_tpu.serving_fleet import FleetRouter
+from paddlebox_tpu.serving_sync import Publisher, Syncer
+from paddlebox_tpu.sparse.table import SparseTable
+from paddlebox_tpu.utils.faults import fault_plan
+from paddlebox_tpu.utils.monitor import stats
+
+S, DENSE, B, VOCAB = 4, 4, 32, 50
+ITEM_SLOT = S - 1
+LO, HI = ITEM_SLOT * VOCAB + 1, (ITEM_SLOT + 1) * VOCAB
+
+
+def _unit_rows(n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(n, d)).astype(np.float32)
+    return e / np.linalg.norm(e, axis=1, keepdims=True)
+
+
+def _index(n=64, d=8, seed=0, **meta):
+    keys = np.arange(1, n + 1, dtype=np.uint64)
+    return AnnIndex(keys, _unit_rows(n, d, seed),
+                    {"embed_dim": d, "row_width": d + 2, "cvm_offset": 2,
+                     "item_key_lo": 1, "item_key_hi": n,
+                     "create_threshold": 0.0, **meta})
+
+
+# --------------------------------------------------------------------------- #
+# embeddings + search
+# --------------------------------------------------------------------------- #
+def test_rows_to_item_embeddings_normalizes():
+    values = np.random.default_rng(0).normal(size=(5, 11)).astype(np.float32)
+    emb = rows_to_item_embeddings(values, cvm_offset=2, row_width=10)
+    assert emb.shape == (5, 8)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, rtol=1e-5)
+
+
+def test_exact_search_matches_brute_force():
+    idx = _index(n=40, d=8)
+    q = _unit_rows(6, 8, seed=1)
+    keys, scores = idx.search(q, k=5, tier="exact")
+    ref = q @ idx.emb.T
+    for i in range(len(q)):
+        want = np.argsort(-ref[i])[:5]
+        np.testing.assert_array_equal(keys[i], idx.keys[want])
+        np.testing.assert_allclose(scores[i], ref[i][want], rtol=1e-5)
+    # scores are sorted descending
+    assert all((np.diff(s) <= 1e-6).all() for s in scores)
+
+
+def test_search_validation():
+    idx = _index()
+    q = _unit_rows(2, 8)
+    with pytest.raises(ValueError, match="tier"):
+        idx.search(q, k=3, tier="fp64")
+    with pytest.raises(ValueError, match="k"):
+        idx.search(q, k=0)
+    with pytest.raises(ValueError):
+        idx.search(_unit_rows(2, 5), k=3)  # dim mismatch
+
+
+def test_int8_recall_at_10_pin():
+    """The acceptance pin: the int8 coarse tier's top-10 agrees with the
+    exact scorer at >= 0.95 recall on unit-norm queries."""
+    idx = _index(n=300, d=16, seed=2)
+    q = _unit_rows(64, 16, seed=3)
+    ek, _ = idx.search(q, k=10, tier="exact")
+    qk, _ = idx.search(q, k=10, tier="int8")
+    recall = np.mean([
+        len(set(ek[i]) & set(qk[i])) / 10.0 for i in range(len(q))
+    ])
+    assert recall >= 0.95, f"int8 recall@10 {recall:.3f} < 0.95"
+
+
+# --------------------------------------------------------------------------- #
+# artifact roundtrip + delta merge
+# --------------------------------------------------------------------------- #
+def test_save_load_roundtrip(tmp_path):
+    idx = _index(n=20, d=8)
+    idx.save(str(tmp_path / "a"))
+    back = AnnIndex.load(str(tmp_path / "a"))
+    np.testing.assert_array_equal(back.keys, idx.keys)
+    np.testing.assert_array_equal(back.emb, idx.emb)
+    assert back.meta["artifact_kind"] == "ann"
+    assert back.n_features == idx.n_features
+    # predict() is not this artifact's surface
+    with pytest.raises(ValueError, match="retrieve"):
+        back.predict({})
+
+
+def test_with_delta_replaces_inserts_and_range_filters():
+    idx = _index(n=10, d=8, item_key_hi=20)  # range [1, 20], keys 1..10
+    co, w = idx.meta["cvm_offset"], idx.meta["row_width"]
+    rng = np.random.default_rng(4)
+
+    def rows(n, show=10.0):
+        v = rng.normal(size=(n, w)).astype(np.float32)
+        v[:, 0] = show  # show counter clears admission
+        return v
+
+    # key 3 replaced, key 25 outside [1, 20] dropped, key 15 inserted
+    # twice (last write wins)
+    keys = np.array([3, 25, 15, 15], np.uint64)
+    vals = rows(4)
+    new = idx.with_delta(
+        keys, vals, program_dir=None, bucket_meta=None)
+    assert new.n_items == 11  # +15 only
+    np.testing.assert_array_equal(
+        new.keys, np.sort(np.concatenate([idx.keys, [np.uint64(15)]])))
+    i3 = int(np.searchsorted(new.keys, 3))
+    want3 = vals[0, co:w] / np.linalg.norm(vals[0, co:w])
+    np.testing.assert_allclose(new.emb[i3], want3, rtol=1e-5)
+    i15 = int(np.searchsorted(new.keys, 15))
+    want15 = vals[3, co:w] / np.linalg.norm(vals[3, co:w])  # LAST dup wins
+    np.testing.assert_allclose(new.emb[i15], want15, rtol=1e-5)
+    # the source index is untouched (hot-swap semantics)
+    assert idx.n_items == 10
+
+
+def test_with_delta_admits_below_threshold_when_configured():
+    idx = _index(n=4, d=8, create_threshold=5.0)
+    w = idx.meta["row_width"]
+    v = np.ones((1, w), np.float32)
+    v[0, 0] = 2.0  # show 2 < threshold 5
+    new = idx.with_delta(np.array([2], np.uint64), v,
+                         program_dir=None, bucket_meta=None)
+    assert new.n_items == 4  # rejected, not merged
+
+
+# --------------------------------------------------------------------------- #
+# export from a trained table
+# --------------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ann_synth")
+    paths = write_synth_files(
+        str(d), n_files=2, ins_per_file=256, n_sparse_slots=S,
+        vocab_per_slot=VOCAB, dense_dim=DENSE, seed=5,
+    )
+    conf = make_synth_config(n_sparse_slots=S, dense_dim=DENSE,
+                             batch_size=B, max_feasigns_per_ins=12)
+    tconf = SparseTableConfig(embedding_dim=8, learning_rate=0.5,
+                              initial_range=0.05)
+    table = SparseTable(tconf, seed=0)
+    model = TwoTower(S, tconf.row_width, item_slots=(ITEM_SLOT,),
+                     dense_dim=DENSE, hidden=(16, 8), temperature=0.05)
+    mst = MultiScenarioTrainer(tconf, [ScenarioSpec(
+        "retr", model, kind="retrieval",
+        trainer_conf=TrainerConfig(dense_lr=3e-3, auc_buckets=1 << 10),
+        seed=3)])
+    ds = PadBoxSlotDataset(conf, read_threads=1)
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    yield table, mst, ds, tconf
+    ds.close()
+
+
+def test_export_filters_to_item_key_range(trained, tmp_path):
+    table, mst, ds, tconf = trained
+    mst.train_pass({"retr": ds}, table)
+    idx = export_ann_index(str(tmp_path / "ann"), table,
+                           item_key_lo=LO, item_key_hi=HI)
+    assert idx.n_items > 0
+    assert idx.keys.min() >= LO and idx.keys.max() <= HI
+    assert idx.meta["embed_dim"] == tconf.embedding_dim
+    np.testing.assert_allclose(
+        np.linalg.norm(idx.emb, axis=1), 1.0, rtol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# e2e: publish -> sync -> /retrieve through the live router
+# --------------------------------------------------------------------------- #
+def _post(url, body, deadline_ms=None):
+    headers = {"Content-Type": "application/json"}
+    if deadline_ms:
+        headers["X-Request-Deadline-Ms"] = str(deadline_ms)
+    req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+def test_retrieve_e2e_through_router(trained, tmp_path):
+    """train -> publish_ann_base -> more training -> publish_delta ->
+    Syncer hot-apply -> POST /retrieve through the live fleet router;
+    the delta MOVES the candidates.  Plus: chaos failover on
+    retrieve.query, the 404 split for unknown POST paths, and the clean
+    /score refusal on a feed-less retrieval model."""
+    table, mst, ds, tconf = trained
+    mst.train_pass({"retr": ds}, table)
+    root = str(tmp_path / "pub")
+    pub = Publisher(root, staging_dir=str(tmp_path / "stage"))
+    pub.publish_ann_base("a0", table, item_key_lo=LO, item_key_hi=HI,
+                         meta={"scenario": "retr"})
+
+    srv = ScoringServer()
+    syncer = Syncer(root, srv, "retr", cache_dir=str(tmp_path / "cache"),
+                    poll_interval_s=0.05)
+    assert syncer.poll_once() == 1
+    port = srv.start(port=0, host="127.0.0.1")
+    router = FleetRouter([f"127.0.0.1:{port}"])
+    rport = router.start(port=0, host="127.0.0.1")
+    try:
+        base = f"http://127.0.0.1:{rport}"
+        q = _unit_rows(3, tconf.embedding_dim, seed=9)
+        st, out = _post(f"{base}/retrieve/retr",
+                        {"queries": q.tolist(), "k": 5})
+        assert st == 200
+        assert len(out["results"]) == 3
+        assert all(len(r["keys"]) == 5 for r in out["results"])
+        assert all(LO <= k <= HI for r in out["results"] for k in r["keys"])
+        before = out["results"]
+
+        # int8 tier serves through the same endpoint
+        st, out8 = _post(f"{base}/retrieve/retr",
+                         {"queries": q.tolist(), "k": 5, "tier": "int8"})
+        assert st == 200 and out8["tier"] == "int8"
+
+        # train more, ship a DELTA, hot-apply: candidates move
+        mst.train_pass({"retr": ds}, table)
+        pub.publish_delta("a1", table)
+        assert syncer.poll_once() == 1
+        assert syncer.applied_seq == 1
+        st, after = _post(f"{base}/retrieve/retr",
+                          {"queries": q.tolist(), "k": 5})
+        assert st == 200
+        moved = any(
+            a["keys"] != b["keys"] or not np.allclose(
+                a["scores"], b["scores"])
+            for a, b in zip(after["results"], before)
+        )
+        assert moved, "delta applied but top-k candidates did not move"
+
+        # chaos: one injected fault on the retrieve path -> the router's
+        # verbatim-body failover retries the OTHER replica and the
+        # CLIENT still sees 200.  Second replica = its own synced server
+        # over the same publish root.
+        srv2 = ScoringServer()
+        syncer2 = Syncer(root, srv2, "retr",
+                         cache_dir=str(tmp_path / "cache2"),
+                         poll_interval_s=0.05)
+        assert syncer2.poll_once() == 2  # base + delta
+        port2 = srv2.start(port=0, host="127.0.0.1")
+        router2 = FleetRouter([f"127.0.0.1:{port}", f"127.0.0.1:{port2}"])
+        rport2 = router2.start(port=0, host="127.0.0.1")
+        try:
+            n0 = stats.get("faults.injected.retrieve.query")
+            with fault_plan({"retrieve.query": "first:1"}):
+                st, _ = _post(f"http://127.0.0.1:{rport2}/retrieve/retr",
+                              {"queries": q.tolist(), "k": 5})
+            assert st == 200
+            assert stats.get("faults.injected.retrieve.query") == n0 + 1
+        finally:
+            router2.stop()
+            srv2.stop()
+
+        # unknown POST path: clean 404 on server AND router
+        st, _ = _post(f"{base}/bogus", {"x": 1})
+        assert st == 404
+        st, _ = _post(f"http://127.0.0.1:{port}/bogus", {"x": 1})
+        assert st == 404
+        # a retrieval model refuses /score with a clean 400
+        st, msg = _post(f"{base}/score/retr", {"x": 1})
+        assert st in (400, 404)
+
+        # unknown model name on /retrieve -> 404
+        st, _ = _post(f"{base}/retrieve/nope", {"queries": q.tolist()})
+        assert st == 404
+        # malformed body -> 400
+        st, _ = _post(f"http://127.0.0.1:{port}/retrieve/retr",
+                      {"queries": []})
+        assert st == 400
+    finally:
+        router.stop()
+        srv.stop()
+
+
+def test_unknown_post_path_hits_request_counter(trained, tmp_path):
+    """The 404 split satellite: an unknown POST path lands in
+    server.requests under the default-model label with status 4xx."""
+    from paddlebox_tpu import telemetry
+
+    table, mst, ds, tconf = trained
+    idx_dir = str(tmp_path / "ann")
+    mst.train_pass({"retr": ds}, table)
+    export_ann_index(idx_dir, table, item_key_lo=LO, item_key_hi=HI)
+    srv = ScoringServer()
+    srv.register_predictor("retr", AnnIndex.load(idx_dir), None)
+    port = srv.start(port=0, host="127.0.0.1")
+    try:
+        before = telemetry.registry.snapshot()["counters"]
+        st, _ = _post(f"http://127.0.0.1:{port}/nope", {"x": 1})
+        assert st == 404
+        after = telemetry.registry.snapshot()["counters"]
+        key = "server.requests{model=-,status=4xx}"
+        assert after.get(key, 0) == before.get(key, 0) + 1
+    finally:
+        srv.stop()
+
+
+def test_feedless_register_requires_search():
+    srv = ScoringServer()
+
+    class _NotRetrieval:
+        meta = {"n_tasks": 1}
+
+    with pytest.raises(ValueError, match="feed schema"):
+        srv.register_predictor("m", _NotRetrieval(), None)

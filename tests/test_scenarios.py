@@ -1,0 +1,224 @@
+"""Multi-scenario training plane (scenarios/): N heterogeneous towers
+over ONE shared SparseTable — interleave determinism (bit-exact rerun),
+union census, per-scenario slot/admission policy, per-scenario telemetry
+attribution, and pass-protocol discipline under mid-pass failure."""
+
+import numpy as np
+import pytest
+
+from paddlebox_tpu import telemetry
+from paddlebox_tpu.config import SparseTableConfig, TrainerConfig
+from paddlebox_tpu.data.dataset import PadBoxSlotDataset
+from paddlebox_tpu.data.synth import make_synth_config, write_synth_files
+from paddlebox_tpu.models import CtrDnn, TwoTower, WideDeep
+from paddlebox_tpu.scenarios import (
+    MultiScenarioTrainer,
+    RetrievalTrainer,
+    ScenarioSpec,
+)
+from paddlebox_tpu.sparse.table import SparseTable
+
+S, DENSE, B, VOCAB = 4, 4, 32, 40
+
+@pytest.fixture(scope="module")
+def synth(tmp_path_factory):
+    d = tmp_path_factory.mktemp("scen_synth")
+    paths = write_synth_files(
+        str(d), n_files=2, ins_per_file=256, n_sparse_slots=S,
+        vocab_per_slot=VOCAB, dense_dim=DENSE, seed=11,
+    )
+    conf = make_synth_config(
+        n_sparse_slots=S, dense_dim=DENSE, batch_size=B,
+        max_feasigns_per_ins=12,
+    )
+    return paths, conf
+
+def _specs(tconf):
+    return [
+        ScenarioSpec(
+            "feed", CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(16,)),
+            trainer_conf=TrainerConfig(dense_lr=3e-3, auc_buckets=1 << 10),
+            seed=1,
+        ),
+        ScenarioSpec(
+            "cvr", WideDeep(S, tconf.row_width, dense_dim=DENSE, hidden=(8,)),
+            slot_mask=(0, 1, 2), create_threshold=0.0,
+            trainer_conf=TrainerConfig(dense_lr=3e-3, auc_buckets=1 << 10),
+            seed=2,
+        ),
+        ScenarioSpec(
+            "retr",
+            TwoTower(S, tconf.row_width, item_slots=(3,), dense_dim=DENSE,
+                     hidden=(16, 8), temperature=0.05),
+            kind="retrieval",
+            trainer_conf=TrainerConfig(dense_lr=3e-3, auc_buckets=1 << 10),
+            seed=3,
+        ),
+    ]
+
+def _world(conf, paths, seed=0):
+    tconf = SparseTableConfig(embedding_dim=8, learning_rate=0.5,
+                              initial_range=0.05)
+    table = SparseTable(tconf, seed=seed)
+    mst = MultiScenarioTrainer(tconf, _specs(tconf))
+    datasets = {}
+    for name in mst.scenario_names():
+        ds = PadBoxSlotDataset(conf, read_threads=1)
+        ds.set_filelist(paths)
+        ds.load_into_memory()
+        datasets[name] = ds
+    return table, mst, datasets
+
+def _close(datasets):
+    for ds in datasets.values():
+        ds.close()
+
+def _run(conf, paths, passes=2):
+    table, mst, datasets = _world(conf, paths)
+    try:
+        results = [mst.train_pass(datasets, table) for _ in range(passes)]
+    finally:
+        _close(datasets)
+    return table, mst, results
+
+# --------------------------------------------------------------------------- #
+# determinism pin
+# --------------------------------------------------------------------------- #
+def test_interleaved_pass_is_bit_deterministic(synth):
+    """The pin the ISSUE demands: two independent worlds with the same
+    seeds and datasets produce BIT-EXACT shared-table state (keys, values
+    including counters and g2sum) and identical per-scenario AUC."""
+    paths, conf = synth
+    t1, _, r1 = _run(conf, paths)
+    t2, _, r2 = _run(conf, paths)
+    s1, s2 = t1.state_dict(), t2.state_dict()
+    np.testing.assert_array_equal(s1["keys"], s2["keys"])
+    np.testing.assert_array_equal(s1["values"], s2["values"])  # incl. g2sum
+    for a, b in zip(r1, r2):
+        assert set(a) == set(b) == {"feed", "cvr", "retr"}
+        for name in a:
+            assert a[name]["auc"] == b[name]["auc"], name
+            assert a[name]["loss"] == b[name]["loss"], name
+
+def test_scenarios_learn_and_share_one_table(synth):
+    paths, conf = synth
+    table, mst, results = _run(conf, paths, passes=3)
+    # every scenario's loss moves down against pass 0 on shared rows
+    for name in ("feed", "retr"):
+        assert results[-1][name]["loss"] < results[0][name]["loss"], name
+    assert table.n_features > 0
+    assert table.missing_key_count == 0  # union census covered everyone
+    # the retrieval trainer is the specialized subclass
+    assert isinstance(mst.trainers["retr"], RetrievalTrainer)
+
+# --------------------------------------------------------------------------- #
+# slot / admission policy
+# --------------------------------------------------------------------------- #
+def test_union_census_is_union_of_scenario_keys(synth):
+    paths, conf = synth
+    table, mst, datasets = _world(conf, paths)
+    try:
+        union = mst.union_census(datasets)
+        every = np.unique(np.concatenate([
+            np.asarray(ds.unique_keys(), np.uint64)
+            for ds in datasets.values()
+        ]))
+        np.testing.assert_array_equal(union, every)
+    finally:
+        _close(datasets)
+
+def test_per_scenario_create_threshold_resolves_on_trainer(synth):
+    paths, conf = synth
+    tconf = SparseTableConfig(embedding_dim=8, create_threshold=5.0)
+    mst = MultiScenarioTrainer(tconf, _specs(tconf))
+    # cvr overrides to 0.0; the others inherit the table's 5.0
+    assert mst.trainers["cvr"].table_conf.create_threshold == 0.0
+    assert mst.trainers["feed"].table_conf.create_threshold == 5.0
+    # the override must not fork the physical row layout
+    assert (mst.trainers["cvr"].table_conf.row_width
+            == mst.trainers["feed"].table_conf.row_width)
+
+def test_slot_mask_rides_each_scenario(synth):
+    paths, conf = synth
+    tconf = SparseTableConfig(embedding_dim=8)
+    mst = MultiScenarioTrainer(tconf, _specs(tconf))
+    assert mst.trainers["cvr"].slot_mask == (0, 1, 2)
+    assert mst.trainers["feed"].slot_mask is None
+
+# --------------------------------------------------------------------------- #
+# validation + pass protocol
+# --------------------------------------------------------------------------- #
+def test_spec_validation():
+    tconf = SparseTableConfig(embedding_dim=8)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(8,))
+    with pytest.raises(ValueError, match="at least one"):
+        MultiScenarioTrainer(tconf, [])
+    with pytest.raises(ValueError, match="duplicate"):
+        MultiScenarioTrainer(tconf, [
+            ScenarioSpec("a", model), ScenarioSpec("a", model)])
+    with pytest.raises(ValueError, match="unknown kind"):
+        MultiScenarioTrainer(tconf, [ScenarioSpec("a", model, kind="nope")])
+    # retrieval kind needs a two-tower model
+    with pytest.raises(ValueError, match="apply_towers"):
+        MultiScenarioTrainer(tconf, [
+            ScenarioSpec("a", model, kind="retrieval")])
+
+def test_missing_dataset_refused_before_begin_pass(synth):
+    paths, conf = synth
+    table, mst, datasets = _world(conf, paths)
+    try:
+        del datasets["cvr"]
+        with pytest.raises(ValueError, match="cvr"):
+            mst.train_pass(datasets, table)
+        # the refusal happened BEFORE begin_pass: the table is still idle
+        table.begin_pass(np.array([1], np.uint64))
+        table.abort_pass()
+    finally:
+        _close(datasets)
+
+def test_mid_pass_failure_aborts_pass(synth):
+    """A scenario step blowing up mid-pass must abort_pass (not leave the
+    table wedged in-pass) and re-raise."""
+    paths, conf = synth
+    table, mst, datasets = _world(conf, paths)
+    try:
+        boom = RuntimeError("boom")
+        real_feed = datasets["feed"]
+
+        class _Exploder:
+            def batches(self, drop_last=False):
+                raise boom
+
+            def unique_keys(self):
+                return real_feed.unique_keys()
+
+        datasets["feed"] = _Exploder()
+        with pytest.raises(RuntimeError, match="boom"):
+            mst.train_pass(datasets, table)
+        # abort_pass ran: a fresh pass can begin
+        table.begin_pass(np.array([1], np.uint64))
+        table.abort_pass()
+    finally:
+        datasets["feed"] = real_feed
+        _close(datasets)
+
+# --------------------------------------------------------------------------- #
+# telemetry attribution
+# --------------------------------------------------------------------------- #
+def test_three_scenarios_separately_attributable(synth):
+    """Scenario is a first-class telemetry label: after one interleaved
+    pass, per-scenario step/sample counters and AUC/loss gauges exist for
+    EVERY scenario under its own label."""
+    paths, conf = synth
+    before = telemetry.registry.snapshot()
+    _run(conf, paths, passes=1)
+    snap = telemetry.registry.snapshot()
+
+    def delta(kind, key):
+        return snap[kind].get(key, 0) - before[kind].get(key, 0)
+
+    for name in ("feed", "cvr", "retr"):
+        assert delta("counters", f"scenario.steps{{scenario={name}}}") > 0
+        assert delta("counters", f"scenario.samples{{scenario={name}}}") > 0
+        assert f"scenario.auc{{scenario={name}}}" in snap["gauges"]
+        assert f"scenario.loss{{scenario={name}}}" in snap["gauges"]
