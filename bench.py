@@ -1355,13 +1355,18 @@ def bench_hostplane(n_passes: int, tconf0, trconf, n_slots: int, dense: int,
          the cold tail ships as varint deltas) — plus gather p50/p99;
       2. shuffle wire: one routed RecordBlock serialized legacy vs varint
          (the key-column compression TcpShuffler ships);
-      3. the bit-exact check: the SAME dataset trained through the
-         MultiChipTrainer with placement off (``hash``) vs the full wire
-         path on (``loopback`` — census encode->decode in begin_pass),
-         final stores compared key-for-key, float-for-float.
+      3. the trained-arm ablation: the SAME dataset through the
+         MultiChipTrainer in three arms — placement off (``hash``),
+         wire-plane dictionary only (``wire`` — census encode->decode in
+         begin_pass, ``placement_realize=False``) and the realized hybrid
+         layout (``hybrid`` — replicated-hot device block, cold tail
+         sharded).  Per arm: begin/end-pass host row bytes, hot-tier
+         migration bytes, boundary gap and samples/s; final stores
+         compared key-for-key, float-for-float across all three (the
+         realized hot path must stay bit-exact, not just the wire).
 
     CPU-admissible by construction (ROADMAP bench caveat): no device
-    collective runs; the wire plane is the thing being measured.
+    collective runs; the host plane is the thing being measured.
     """
     import dataclasses
 
@@ -1455,11 +1460,26 @@ def bench_hostplane(n_passes: int, tconf0, trconf, n_slots: int, dense: int,
             ds.load_into_memory()
             datasets.append(ds)
         try:
+            from paddlebox_tpu.telemetry import registry
+
+            _HOST_CTRS = ("pass.host_row_bytes_in",
+                          "pass.host_row_bytes_out",
+                          "placement.hot_row_host_bytes")
             t_train: dict = {}
-            for mode in ("hash", "loopback"):
+            for arm, mode, realize in (
+                ("hash", "hash", False),
+                ("wire", "loopback", False),
+                ("hybrid", "loopback", True),
+            ):
+                # cache off: the per-arm row counters must read the RAW
+                # host plane (the default 64k-row HBM cache is larger than
+                # the toy census and would absorb every arm's hot traffic
+                # identically — that interplay is --hbm-cache's bench)
                 tconf = dataclasses.replace(
                     tconf0, placement=mode,
                     placement_update_interval=1,
+                    placement_realize=realize,
+                    hbm_cache_rows=0,
                 )
                 model = CtrDnn(n_slots, tconf.row_width, dense_dim=dense,
                                hidden=hidden)
@@ -1467,6 +1487,7 @@ def bench_hostplane(n_passes: int, tconf0, trconf, n_slots: int, dense: int,
                 trainer = MultiChipTrainer(model, tconf, mesh, trconf)
                 auc_state = None
                 total = prev = 0
+                snaps = [registry.snapshot()]
                 t0 = time.perf_counter()
                 for ds in datasets:
                     table.begin_pass(ds.unique_keys())
@@ -1477,33 +1498,72 @@ def bench_hostplane(n_passes: int, tconf0, trconf, n_slots: int, dense: int,
                     table.end_pass()
                     total += int(m["count"]) - prev
                     prev = int(m["count"])
+                    snaps.append(registry.snapshot())
                 table.flush()
-                t_train[mode] = time.perf_counter() - t0
-                states[mode] = table.state_dict()
-                states[mode]["auc"] = float(m["auc"])
-                if mode == "loopback":
+                t_train[arm] = time.perf_counter() - t0
+                # per-arm host-plane row traffic + boundary gap; the LAST
+                # pass is the steady-state figure (the hybrid arm's plan
+                # realizes after hysteresis clears, so early passes still
+                # pay the pre-realization traffic)
+                for c in _HOST_CTRS:
+                    d = (snaps[-1]["counters"].get(c, 0)
+                         - snaps[0]["counters"].get(c, 0))
+                    key = c.split(".", 1)[1]
+                    res[f"{arm}_{key}_per_pass"] = round(d / n_passes, 1)
+                    res[f"{arm}_{key}_last_pass"] = round(
+                        snaps[-1]["counters"].get(c, 0)
+                        - snaps[-2]["counters"].get(c, 0), 1)
+                g0 = snaps[0]["histograms"].get("pass.boundary_gap_seconds")
+                g1 = snaps[-1]["histograms"].get(
+                    "pass.boundary_gap_seconds")
+                if g1 is not None:
+                    dc = g1["count"] - (g0["count"] if g0 else 0)
+                    dsum = g1["sum"] - (g0["sum"] if g0 else 0.0)
+                    res[f"{arm}_boundary_gap_ms"] = round(
+                        dsum / max(dc, 1) * 1e3, 3)
+                res[f"{arm}_samples_per_sec"] = round(
+                    total / t_train[arm], 1)
+                states[arm] = table.state_dict()
+                states[arm]["auc"] = float(m["auc"])
+                if arm == "hybrid":
                     plan = table.placement_plan()
                     res["hot_keys"] = 0 if plan is None else plan.n_hot
                     res["plan_version"] = (
                         0 if plan is None else plan.version
                     )
+                    res["hot_resident_rows"] = int(
+                        table.hot_resident_keys().shape[0])
                 table.close()
-            res["samples_per_sec"] = round(total / t_train["loopback"], 1)
+            res["samples_per_sec"] = res["hybrid_samples_per_sec"]
         finally:
             for ds in datasets:
                 ds.close()
-    res["bitexact"] = bool(
-        np.array_equal(states["hash"]["keys"], states["loopback"]["keys"])
-        and np.array_equal(states["hash"]["values"],
-                           states["loopback"]["values"])
-        and states["hash"]["auc"] == states["loopback"]["auc"]
-    )
+    res["bitexact"] = bool(all(
+        np.array_equal(states["hash"]["keys"], states[arm]["keys"])
+        and np.array_equal(states["hash"]["values"], states[arm]["values"])
+        and states["hash"]["auc"] == states[arm]["auc"]
+        for arm in ("wire", "hybrid")
+    ))
+    # the realized-placement headline: hot lookups stopped paying the
+    # host plane — steady-state begin-pass row traffic collapses to the
+    # cold tail (last pass = first fully-realized pass at toy scale)
+    res["hybrid_host_in_collapse_x"] = round(
+        res["wire_host_row_bytes_in_last_pass"]
+        / max(res["hybrid_host_row_bytes_in_last_pass"], 1), 2)
     log(f"hostplane: bytes/pass {res['hash_raw_bytes_per_pass']:.0f} -> "
         f"{res['planned_varint_bytes_per_pass']:.0f} "
         f"({res['census_collapse_x']}x collapse, codec alone "
         f"{res['census_compression_x']}x), shuffle keys "
         f"{res['shuffle_key_compression_x']}x, "
         f"bitexact={res['bitexact']}")
+    log(f"hostplane hybrid: steady-state begin-pass row bytes "
+        f"{res['wire_host_row_bytes_in_last_pass']:.0f} -> "
+        f"{res['hybrid_host_row_bytes_in_last_pass']:.0f} "
+        f"({res['hybrid_host_in_collapse_x']}x, hot migration "
+        f"{res['hybrid_hot_row_host_bytes_per_pass']:.0f} B/pass), "
+        f"samples/s {res['wire_samples_per_sec']} -> "
+        f"{res['hybrid_samples_per_sec']}, hot rows resident "
+        f"{res['hot_resident_rows']}")
     return res
 
 
@@ -1519,6 +1579,16 @@ def stage_hostplane(backend, args, tconf, trconf, n_slots, dense, bsz,
           "unit": "bytes/pass (2-rank census wire)",
           "vs_baseline": res.get("hash_raw_bytes_per_pass"),
           "backend": backend, **res})
+    emit({"metric": "hostplane_hybrid_row_bytes_per_pass",
+          "value": res.get("hybrid_host_row_bytes_in_last_pass"),
+          "unit": "steady-state begin-pass host row bytes (hybrid arm)",
+          "vs_baseline": res.get("wire_host_row_bytes_in_last_pass"),
+          "backend": backend,
+          "samples_per_sec": res.get("hybrid_samples_per_sec"),
+          "boundary_gap_ms": res.get("hybrid_boundary_gap_ms"),
+          "hot_migration_bytes_per_pass":
+              res.get("hybrid_hot_row_host_bytes_per_pass"),
+          "bitexact": res.get("bitexact")})
 
 
 def bench_serving(n_slots: int = 8, dense: int = 13, n_requests: int = 100):

@@ -91,6 +91,13 @@ class _Flags:
         # encode->decode wire path exercised even single-process (tests,
         # bench).
         "placement": "hybrid",
+        # hybrid-placement device realization kill switch
+        # (parallel/sharded_table.py): PBOX_PLACEMENT_REALIZE=0 keeps the
+        # planner + census wire running but pins device row placement back
+        # to pure hash-sharding (the PR-15 v1 lifecycle) regardless of
+        # SparseTableConfig.placement_realize — the operational escape
+        # hatch if the replicated-hot block misbehaves
+        "placement_realize": True,
         # shuffle-transport wait bound (TcpShuffler default timeout)
         "shuffle_timeout_s": 120.0,
         # telemetry defaults (telemetry/): a non-zero metrics port starts
@@ -570,16 +577,30 @@ class SparseTableConfig:
     # Parameter Box): the planner classifies the top keys by aged census
     # frequency as replicated-hot, the tail stays hash-sharded.  The plan
     # drives the multi-host census wire (hot keys ride as membership bits
-    # — parallel/census.py); the device row placement stays hash-sharded,
-    # which is what keeps planned runs bit-exact vs hash-only ones.
+    # — parallel/census.py) AND, with placement_realize on, the device
+    # data plane: the hot set is materialized as a replicated [H, W+1]
+    # block on every device (parallel/sharded_table.py) so hot lookups are
+    # a purely local gather with zero host-plane row bytes inside a pass.
     # "" resolves PBOX_PLACEMENT ("hybrid" default); "hash" disables.
     placement: str = ""
-    # max replicated-hot keys the planner may classify (top-k bound)
+    # max replicated-hot keys the planner may classify (top-k bound); also
+    # the padded capacity H of the realized device-resident hot block —
+    # jit specializes on it once, never on the live plan (zero retrace
+    # under plan churn)
     placement_hot_capacity: int = 4096
     # per-pass aged-frequency decay of the planner's tracker
     placement_aging: float = 0.8
     # hysteresis: the hot set mutates at most once per this many passes
     placement_update_interval: int = 2
+    # realize the plan on device (replicated-hot / sharded-cold hybrid
+    # layout).  False = the PR-15 v1 wire-only lifecycle: the planner and
+    # census dictionary still run but rows stay hash-sharded end to end.
+    # PBOX_PLACEMENT_REALIZE=0 is the process-wide kill switch.  The
+    # realized lifecycle is bit-exact vs hash placement (pinned by
+    # tests/test_placement.py): hot-gradient reduction is a
+    # deterministic-order fold over the device axis, matching the cold
+    # path's requester-major segment-sum order.
+    placement_realize: bool = True
 
     @property
     def row_width(self) -> int:

@@ -32,6 +32,10 @@ cache above the per-pass working set):
     nothing accumulates).  Negative slots are dropped; duplicate slots
     resolve last-occurrence-wins (within a tile via an explicit
     last-of-group mask, across tiles by grid-step ordering).
+  * ``pallas_hot_cold_select(hot_ext, hot_occ, cold_rows)`` — the realized
+    hybrid placement's fused gather routing (parallel/trainer.hybrid_pull):
+    occurrences with a hot slot read the replicated local hot block,
+    sink-slot occurrences keep the all_to_all-delivered cold row.
   * ``pallas_sorted_search(hay, n_real, q)`` — vectorized branchless
     binary search of uint64 keys (carried as uint32 (hi, lo) pairs — JAX
     arrays are x64-disabled by default) over a sorted haystack: the
@@ -360,6 +364,73 @@ def pallas_scatter_rows(table: jax.Array, slots: jax.Array, rows: jax.Array,
         interpret=interpret or not _on_tpu(),
         compiler_params=_compiler_params(has_side_effects=True),
     )(slots, rows, table)
+
+
+def _hot_select_kernel(idx_ref, hot_ref, cold_ref, out_ref, scratch, sems,
+                       *, tile, hcap):
+    """One grid step DMAs ``tile`` hot-block rows into VMEM (slot hcap is
+    the appended sink row — always a valid copy source) and emits the
+    hot/cold select: slot < hcap reads the replicated hot block, the sink
+    keeps the all_to_all-delivered cold row."""
+    g = pl.program_id(0)
+    for i in range(tile):
+        pltpu.make_async_copy(
+            hot_ref.at[pl.ds(idx_ref[g * tile + i], 1), :],
+            scratch.at[pl.ds(i, 1), :],
+            sems.at[i],
+        ).start()
+    for i in range(tile):
+        pltpu.make_async_copy(
+            hot_ref.at[pl.ds(idx_ref[g * tile + i], 1), :],
+            scratch.at[pl.ds(i, 1), :],
+            sems.at[i],
+        ).wait()
+    ids = jnp.stack([idx_ref[g * tile + i] for i in range(tile)])
+    out_ref[:] = jnp.where((ids < hcap)[:, None], scratch[:], cold_ref[:])
+
+
+@counted_jit(stage="pallas.hot_cold_select", static_argnames=("interpret",))
+def pallas_hot_cold_select(hot_ext: jax.Array, hot_occ: jax.Array,
+                           cold_rows: jax.Array,
+                           interpret: bool = False) -> jax.Array:
+    """Fused hot/cold gather routing for the realized hybrid placement
+    (parallel/trainer.hybrid_pull): hot occurrences gather from the
+    REPLICATED local hot block, everything else keeps its cold row.
+
+    hot_ext: [H+1, W] (HBM) — the hot block plus one appended sink row;
+    hot_occ: int32 [K] in [0, H], H = cold/padding sink; cold_rows: [K, W].
+    Identical to ``jnp.where((hot_occ < H)[:, None],
+    jnp.take(hot_ext, hot_occ, axis=0), cold_rows)``."""
+    k = hot_occ.shape[0]
+    w = hot_ext.shape[1]
+    if k == 0:
+        return cold_rows
+    tile = _tile_for(k)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # hot_occ known before tile bodies run
+        grid=(k // tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # hot block stays in HBM
+            pl.BlockSpec(
+                (tile, w), lambda g, idx: (g, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile, w), lambda g, idx: (g, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tile, w), hot_ext.dtype),
+            pltpu.SemaphoreType.DMA((tile,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _hot_select_kernel, tile=tile, hcap=hot_ext.shape[0] - 1
+        ),
+        out_shape=jax.ShapeDtypeStruct((k, w), hot_ext.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret or not _on_tpu(),
+    )(hot_occ, hot_ext, cold_rows)
 
 
 def _sorted_search_kernel(nreal_ref, hay_ref, q_ref, out_ref, *, cbits,

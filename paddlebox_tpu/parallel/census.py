@@ -237,6 +237,20 @@ class FleetCacheMirror:
             upd = d.plan_update(sk, plan)
             d.commit_update(plan, upd)
 
+    def evict(self, keys: np.ndarray) -> None:
+        """Mirror the realized hot promotion: keys promoted into the
+        replicated device block leave the REAL per-shard caches (the
+        owner read them out via ``take_rows``), so their twins must drop
+        them too — same keys on every rank, so the twins stay lockstep."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        if not keys.shape[0]:
+            return
+        owner = keys % np.uint64(self.n_shards)
+        for o, d in enumerate(self._dirs):
+            sk = keys[owner == np.uint64(o)]
+            if sk.shape[0]:
+                d.evict_keys(sk)
+
 
 # --------------------------------------------------------------------------- #
 # the exchange
@@ -259,7 +273,14 @@ class CensusExchange:
         mirror: Optional[FleetCacheMirror] = None,
         codec: str = "varint",
         channel: str = "census",
+        realize: bool = False,
     ):
+        """``realize=True`` when the owning table MATERIALIZES the plan's
+        hot set on device (realized hybrid placement): hot keys then never
+        reach the real per-shard caches — they are promoted out at plan
+        realization and served from the replicated block — so the mirror
+        twins must replay the same split (evict promoted keys, see only
+        the cold census) or residency prediction drifts from reality."""
         if codec not in ("varint", "raw"):
             raise ValueError(f"codec must be varint|raw, got {codec!r}")
         self.transport = transport
@@ -267,6 +288,7 @@ class CensusExchange:
         self.mirror = mirror
         self.codec = codec
         self.channel = channel
+        self.realize = bool(realize)
         self._known: np.ndarray = _EMPTY_U64.copy()
         self.last_wire_bytes = 0  # this rank's encoded payload size
         self.last_raw_bytes = 0  # what the legacy wire would have shipped
@@ -407,13 +429,23 @@ class CensusExchange:
         """Evolve the shared dictionary from the agreed global census —
         pure function of ``pk``, so every rank stays in lockstep."""
         parts = []
+        hot = _EMPTY_U64
         if self.planner is not None:
             self.planner.observe(pk)
             plan = self.planner.update_plan()
             if plan.n_hot:
                 parts.append(plan.hot_keys)
+                hot = plan.hot_keys
         if self.mirror is not None:
-            self.mirror.step(pk)
+            if self.realize and hot.shape[0]:
+                # realized placement: hot keys live in the replicated
+                # device block, not the per-shard caches — evict their
+                # twins and feed the directories the COLD census only,
+                # exactly what the real caches will observe
+                self.mirror.evict(hot)
+                self.mirror.step(np.setdiff1d(pk, hot, assume_unique=True))
+            else:
+                self.mirror.step(pk)
             res = self.mirror.resident_keys()
             if res.shape[0]:
                 parts.append(res)

@@ -48,6 +48,25 @@ US = n * C:
                                 row exactly once.
     serve_uniq [D, US]   int32  deduped rows served by shard D (dead padded).
     key_mask   [D, K]    f32    1.0 for real occurrences.
+
+Realized hybrid placement (PR 20, ``SparseTableConfig.placement_realize``):
+beside the sharded cold layout above, the placement plan's hot set lives as
+a REPLICATED ``[H, W+1]`` block resident on every device ACROSS passes (H =
+``placement_hot_capacity``, padded — jit specializes on H once, never on
+the live plan).  A hot occurrence routes to ``hot_occ`` (its slot in the
+sorted resident hot set; H = sink) instead of the a2a bucket, so hot
+lookups are a purely local gather with ZERO host-plane row bytes and zero
+all_to_all slots inside a pass; its cold ``occ_flat`` entry points at the
+dropped ``n*C`` sink.  Hot gradients reduce with a deterministic
+device-order fold (parallel/trainer.py hybrid_hot_update) and the adagrad
+apply runs replica-identically, so the replicas never diverge.  Hot⇄cold
+promotions/demotions happen only at pass boundaries inside begin_pass
+(keycodec-framed like reshard migration, broadcast on the census channel
+multi-host, hysteresis-bounded churn); flush() writes the resident hot
+rows back to the host store, so every persistence/reshard barrier sees
+truth.  The cold census (``_pass_keys``) EXCLUDES resident hot keys — the
+HbmCache directories, the staging thread and the FleetCacheMirror all see
+only the cold tail.
 """
 
 from __future__ import annotations
@@ -153,6 +172,15 @@ class ShardedBatchPlan:
     # requester resolves its keys' slot lrs host-side and they ride the
     # want-matrix allgather, so slot identity survives the serve merge
     serve_lr: Optional[np.ndarray] = None
+    # int32 [D, K] hot routing (realized hybrid placement only): each
+    # occurrence's slot in the replicated hot block, H for cold/padding
+    # occurrences (the appended-zero sink).  Hot occurrences carry the
+    # n*C sink in occ_flat and are excluded from the want matrices.
+    hot_occ: Optional[np.ndarray] = None
+    # f32 [D, H] per-hot-slot learning rates (0.0 where this device has no
+    # occurrence — the step pmax-folds them over the device axis so every
+    # replica applies the identical lr), present only with the LR map
+    hot_lr: Optional[np.ndarray] = None
 
 
 class ShardedSparseTable(SparseTable):
@@ -210,6 +238,26 @@ class ShardedSparseTable(SparseTable):
                 "placement must be hybrid|hash|loopback, got "
                 f"{self._placement_mode!r}"
             )
+        # realized hybrid placement (module docstring): the plan's hot set
+        # materialized as a replicated [H, W+1] device block.  OFF under
+        # "hash" (no planner) and under the config/env kill switches —
+        # then the table runs the PR-15 wire-only lifecycle unchanged.
+        self._hot_realize = bool(
+            conf.placement_realize
+            and _flags.placement_realize
+            and self._placement_mode in ("hybrid", "loopback")
+            and conf.placement_hot_capacity > 0
+        )
+        # device-RESIDENT hot set (sorted unique; its position is the hot
+        # block slot) + the replicated block itself: [n, H, W] values and
+        # [n, H] g2sum, one identical copy per device, persistent ACROSS
+        # passes (None until the first non-empty plan realizes)
+        self._hot_keys = np.empty(0, np.uint64)
+        self.hot_values = None
+        self.hot_g2sum = None
+        # resident hot rows updated by a pass and not yet written back
+        self._hot_dirty = False
+        self._hot_swap_fn = None  # jitted survivor remap (static [H] shapes)
         self._census = None
         self._census_channel = None
         # frequency evidence carried across a reshard cutover (seeds the
@@ -340,6 +388,7 @@ class ShardedSparseTable(SparseTable):
                 transport = LoopbackTransport()
             self._census = CensusExchange(
                 transport, planner=planner, mirror=mirror, codec=codec,
+                realize=self._hot_realize,
             )
         return self._census
 
@@ -355,7 +404,10 @@ class ShardedSparseTable(SparseTable):
             if flags.hostplane_codec == "legacy":
                 return np.unique(host_allgather_varlen(pk))
             return self._census_exchange_obj().exchange(pk)
-        if self._placement_mode == "loopback":
+        if self._placement_mode == "loopback" or self._hot_realize:
+            # realization needs the planner even single-process "hybrid"
+            # (the hot set it materializes IS the planner's); loopback
+            # additionally exercises the wire round-trip
             return self._census_exchange_obj().exchange(pk)
         return pk
 
@@ -365,6 +417,264 @@ class ShardedSparseTable(SparseTable):
         if self._census is None or self._census.planner is None:
             return None
         return self._census.planner.plan()
+
+    # -- realized hybrid placement (replicated-hot block) ------------------ #
+    @property
+    def hot_block_capacity(self) -> int:
+        """Padded capacity H of the replicated hot block (0 = realization
+        off).  STATIC for the table's lifetime: the trainer specializes
+        its step on this, never on the live plan — the zero-retrace-
+        under-plan-churn pin."""
+        return self.conf.placement_hot_capacity if self._hot_realize else 0
+
+    def hot_resident_keys(self) -> np.ndarray:
+        """The device-resident hot set (sorted; slot i of the hot block
+        holds key i) — bench/test introspection."""
+        return self._hot_keys
+
+    def _drop_hot_residency(self) -> None:
+        """Forget the replicated hot block WITHOUT writing it back —
+        callers that mutate the store underneath (load_state_dict /
+        apply_delta / shrink / reshard cutover) flush() first, and flush
+        writes the resident hot rows to the store."""
+        self._hot_keys = np.empty(0, np.uint64)
+        self.hot_values = None
+        self.hot_g2sum = None
+        self._hot_dirty = False
+
+    def _invalidate_caches(self) -> None:
+        """Store mutated underneath: the hot block is as stale as the
+        HBM-cache rows — drop residency along with the cache state (the
+        next begin_pass re-realizes from the rewritten store)."""
+        super()._invalidate_caches()
+        self._drop_hot_residency()
+
+    def flush(self) -> None:
+        """Hot rows first: the resident hot block is truth for its keys
+        (they are absent from both the cold working set and the HBM
+        caches), so every barrier that makes the store authoritative —
+        checkpoint, shrink, delta, reshard — must land them before the
+        base-class cache drain + merge wait."""
+        self._flush_hot()
+        super().flush()
+
+    def _flush_hot(self) -> None:
+        if (
+            self._in_pass
+            or not self._hot_dirty
+            or self.hot_values is None
+            or not self._hot_keys.shape[0]
+        ):
+            return
+        m = self._hot_keys.shape[0]
+        lv = np.asarray(local_view(self.hot_values)[0])  # [H, W]
+        lg = np.asarray(local_view(self.hot_g2sum)[0])  # [H]
+        keys = self._hot_keys
+        rows = np.concatenate([lv[:m], lg[:m, None]], axis=1)
+        if is_multiprocess():
+            # single owner writes back: every replica holds identical rows,
+            # but only the process owning a key's shard persists it
+            own = self._proc_of(
+                (keys % np.uint64(self.n_shards)).astype(np.int64),
+                self.n_shards,
+            ) == jax.process_index()
+            keys, rows = keys[own], rows[own]
+        if keys.shape[0]:
+            self._write_back(keys, np.ascontiguousarray(rows))
+        self._hot_dirty = False
+
+    def _sync_hot_block(self) -> None:
+        """Reconcile the device-resident hot block with the just-updated
+        placement plan (begin_pass, after the census exchange).  Steady
+        state (no plan change) touches nothing — boundary host traffic
+        from the hot tier is O(churn), and churn is hysteresis-bounded."""
+        from paddlebox_tpu import telemetry
+        from paddlebox_tpu.sparse.placement import hot_churn
+
+        plan = self.placement_plan()
+        target = (
+            plan.hot_keys if plan is not None else np.empty(0, np.uint64)
+        )
+        if target.shape[0] > self.conf.placement_hot_capacity:
+            raise RuntimeError(
+                f"plan hot set ({target.shape[0]}) exceeds the realized "
+                f"block capacity ({self.conf.placement_hot_capacity})"
+            )
+        promote, demote = hot_churn(self._hot_keys, target)
+        if (
+            promote.shape[0]
+            or demote.shape[0]
+            or (target.shape[0] and self.hot_values is None)
+        ):
+            self._migrate_hot(target, promote, demote)
+        telemetry.gauge(
+            "placement.hot_resident_rows",
+            "rows resident in the replicated device hot block",
+        ).set(float(self._hot_keys.shape[0]))
+
+    def _migrate_hot(self, target, promote, demote) -> None:
+        """Commit one hot-set mutation: demoted rows leave the device
+        block for the host store (single owner writes back), promoted
+        rows are fetched read-through the HBM caches / store and
+        broadcast so every device assembles the identical new block, and
+        surviving rows remap device-side (a static-[H]-shape jitted
+        gather — zero host bytes and zero retraces for survivors)."""
+        from paddlebox_tpu import telemetry
+
+        w = self.conf.row_width
+        H = self.conf.placement_hot_capacity
+        n = self.n_shards
+        host_bytes = telemetry.counter(
+            "placement.hot_row_host_bytes",
+            "hot-tier row bytes crossing the host plane (promotions + "
+            "demotions at pass boundaries; structurally zero inside a "
+            "pass)",
+        )
+        old = self._hot_keys
+        if demote.shape[0] and self.hot_values is not None:
+            slots = np.searchsorted(old, demote)
+            lv = np.asarray(local_view(self.hot_values)[0])
+            lg = np.asarray(local_view(self.hot_g2sum)[0])
+            rows = np.concatenate([lv[slots], lg[slots, None]], axis=1)
+            dk = demote
+            if is_multiprocess():
+                own = self._proc_of(
+                    (demote % np.uint64(n)).astype(np.int64), n
+                ) == jax.process_index()
+                dk, rows = demote[own], rows[own]
+            if dk.shape[0]:
+                self._write_back(dk, np.ascontiguousarray(rows))
+                host_bytes.inc(rows.nbytes)
+        promo_rows = self._fetch_hot_rows(promote)
+        if promo_rows.shape[0]:
+            host_bytes.inc(promo_rows.nbytes)
+        # assemble the new block: promoted rows at their slot in the
+        # sorted target, survivors gathered from their old slot on device,
+        # padding slots ([live, H)) explicitly zero
+        promo_v = np.zeros((H, w), np.float32)
+        promo_g = np.zeros(H, np.float32)
+        if promote.shape[0]:
+            ts = np.searchsorted(target, promote)
+            promo_v[ts] = promo_rows[:, :w]
+            promo_g[ts] = promo_rows[:, w]
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        if self.hot_values is None or not old.shape[0]:
+            lv = np.repeat(promo_v[None], self.n_local, axis=0)
+            lg = np.repeat(promo_g[None], self.n_local, axis=0)
+            self.hot_values = global_from_local(sharding, jnp.asarray(lv))
+            self.hot_g2sum = global_from_local(sharding, jnp.asarray(lg))
+        else:
+            src = np.zeros(H, np.int32)
+            surv = np.zeros(H, bool)
+            if target.shape[0]:
+                pos = np.searchsorted(old, target)
+                pos_c = np.minimum(pos, old.shape[0] - 1)
+                hit = old[pos_c] == target
+                src[: target.shape[0]] = pos_c.astype(np.int32)
+                surv[: target.shape[0]] = hit
+            self.hot_values, self.hot_g2sum = self._hot_swap_jit()(
+                self.hot_values,
+                self.hot_g2sum,
+                promo_v,
+                promo_g,
+                jnp.asarray(src),
+                jnp.asarray(surv),
+            )
+        self._hot_keys = np.asarray(target, np.uint64).copy()
+
+    def _hot_swap_jit(self):
+        if self._hot_swap_fn is None:
+            from paddlebox_tpu.telemetry.compiles import counted_jit
+
+            def _swap(hv, hg, pv, pg, src, surv):
+                # [n, H, W]/[n, H] replicated-per-device blocks; take along
+                # the unsharded slot axis keeps the P(DATA_AXIS) layout —
+                # no collective, no host round trip for survivors
+                sv = jnp.take(hv, src, axis=1)
+                sg = jnp.take(hg, src, axis=1)
+                nv = jnp.where(surv[None, :, None], sv, pv[None])
+                ng = jnp.where(surv[None, :], sg, pg[None])
+                return nv, ng
+
+            self._hot_swap_fn = counted_jit(
+                _swap, stage="spmd.hot_swap", donate_argnums=(0, 1)
+            )
+        return self._hot_swap_fn
+
+    def _fetch_owned_hot_rows(self, keys: np.ndarray) -> np.ndarray:
+        """Promotion read-through for keys owned by this process's shards:
+        HBM-cache hits gather device->host AND leave the directory (the
+        hot block becomes their truth), misses resolve from the
+        store/overlay, unseen keys init key-deterministically."""
+        w = self.conf.row_width
+        out = np.zeros((keys.shape[0], w + 1), np.float32)
+        if not keys.shape[0]:
+            return out
+        caches = self._caches()
+        owner = keys % np.uint64(self.n_shards)
+        for i, o in enumerate(self._local_pos):
+            pos = np.nonzero(owner == np.uint64(int(o)))[0]
+            if not pos.shape[0]:
+                continue
+            sk = keys[pos]
+            if caches:
+                with self._cache_lock:
+                    hit, rows = caches[i].take_rows(
+                        sk, pad_to=self.conf.placement_hot_capacity
+                    )
+                if hit.any():
+                    out[pos[hit]] = rows
+                miss = ~hit
+                if miss.any():
+                    out[pos[miss]] = self._resolve_or_init(sk[miss])
+            else:
+                out[pos] = self._resolve_or_init(sk)
+        return out
+
+    def broadcast_hot_rows(self, payload: bytes) -> list:
+        """Host collective (multi-host begin_pass, lockstep): every rank
+        contributes its owned shards' promoted hot rows as one keycodec
+        frame on the census channel; every rank receives all frames and
+        assembles the identical replicated block."""
+        self._census_exchange_obj()
+        return self._census_channel.gather_bytes(payload)
+
+    def _fetch_hot_rows(self, promote: np.ndarray) -> np.ndarray:
+        """[P, W+1] rows for the sorted promoted keys, identical on every
+        rank.  Single-process: a direct owner fetch ("loopback" rides the
+        keycodec frame round trip, verified bit-exact — the same wire
+        discipline as reshard migration).  Multi-host: owners frame their
+        rows, the frames cross the census channel, every rank decodes
+        all of them."""
+        w = self.conf.row_width
+        if not promote.shape[0]:
+            return np.zeros((0, w + 1), np.float32)
+        n = self.n_shards
+        if not is_multiprocess():
+            rows = self._fetch_owned_hot_rows(promote)
+            if self._placement_mode == "loopback":
+                dk, drows = _decode_migration(
+                    _encode_migration(promote, rows)
+                )
+                if not (np.array_equal(dk, promote)
+                        and np.array_equal(drows, rows)):
+                    raise RuntimeError(
+                        "hot-promotion payload failed the loopback "
+                        "round-trip verify")
+                rows = drows
+            return rows
+        own = self._proc_of(
+            (promote % np.uint64(n)).astype(np.int64), n
+        ) == jax.process_index()
+        payload = _encode_migration(
+            promote[own], self._fetch_owned_hot_rows(promote[own])
+        )
+        out = np.zeros((promote.shape[0], w + 1), np.float32)
+        for buf in self.broadcast_hot_rows(payload):
+            k, v = _decode_migration(buf)
+            if k.shape[0]:
+                out[np.searchsorted(promote, k)] = v
+        return out
 
     def close(self) -> None:
         """Retire the census channel (its keys and peer-read pool) on top
@@ -466,6 +776,11 @@ class ShardedSparseTable(SparseTable):
             "census_channel": self._census_channel,
             "last_serve_n": self._last_serve_n,
             "carry_freq": self._carry_freq,
+            "hot_keys": self._hot_keys,
+            "hot_values": self.hot_values,
+            "hot_g2sum": self.hot_g2sum,
+            "hot_dirty": self._hot_dirty,
+            "hot_swap_fn": self._hot_swap_fn,
         }
 
     def _proc_of(self, shard: np.ndarray, n_shards: int) -> np.ndarray:
@@ -596,6 +911,13 @@ class ShardedSparseTable(SparseTable):
         self._shard_keys = None
         # serve-scratch sizing learned under the old split is stale
         self._last_serve_n = 0
+        # the hot block was flushed at the cut point (reshard's flush()
+        # writes resident hot rows) and the planner evidence is carried,
+        # so dropping residency loses nothing: the next begin_pass
+        # re-realizes the warm hot set from the store at the new split.
+        # The swap fn is shape-bound to the old device count.
+        self._drop_hot_residency()
+        self._hot_swap_fn = None
         # close the old census channel LAST: everything above is either
         # pre-mutation validation or infallible assignment, so an abort
         # can never be asked to restore an already-closed channel
@@ -615,6 +937,11 @@ class ShardedSparseTable(SparseTable):
         self._census_channel = old["census_channel"]
         self._last_serve_n = old["last_serve_n"]
         self._carry_freq = old["carry_freq"]
+        self._hot_keys = old["hot_keys"]
+        self.hot_values = old["hot_values"]
+        self.hot_g2sum = old["hot_g2sum"]
+        self._hot_dirty = old["hot_dirty"]
+        self._hot_swap_fn = old["hot_swap_fn"]
         self._cache_plans = None
 
     # -- pass lifecycle --------------------------------------------------- #
@@ -663,7 +990,18 @@ class ShardedSparseTable(SparseTable):
         # global census, no allgather needed off-thread
         pk = np.unique(np.asarray(pass_keys, dtype=np.uint64))
         cache_keys, stage_seq, entries = self._stage_snapshot()
-        owner, shard_keys, row_within = self._shard_split(pk)
+        # hot/cold split prediction: the stage resolves only the COLD tail
+        # under the CURRENT resident hot set (the plan cannot change
+        # mid-pass — only begin_pass's exchange updates it).  begin_pass
+        # validates the prediction and discards the stage when the plan
+        # churned (pass.stage_discards) — churn passes pay the sync
+        # resolve, steady-state passes get the full overlap.
+        shot = self._hot_keys if self._hot_realize else None
+        cold_pk = (
+            np.setdiff1d(pk, shot, assume_unique=True)
+            if shot is not None and shot.shape[0] else pk
+        )
+        owner, shard_keys, row_within = self._shard_split(cold_pk)
         w = self.conf.row_width
         cap = self._sharded_cap(shard_keys)
         lvals = np.zeros((self.n_local, cap, w + 1), dtype=np.float32)
@@ -676,12 +1014,14 @@ class ShardedSparseTable(SparseTable):
                 entries,
             )
             if not ok:  # fault-injected promotion fetch: stage => discard
-                return pk, owner, shard_keys, row_within, None, stage_seq
+                return pk, owner, shard_keys, row_within, None, shot, stage_seq
         telemetry.histogram(
             "pass.promote_seconds",
             "background next-pass census resolve + init + staging wall time",
         ).observe(time.perf_counter() - t0)
-        return pk, owner, shard_keys, row_within, lvals, stage_seq
+        # stage_seq stays LAST: the base _pop_stage reads payload[-1] as
+        # the overlay consistency point for patch-log filtering
+        return pk, owner, shard_keys, row_within, lvals, shot, stage_seq
 
     def _cached_sync_resolve(self, caches, shard_keys, lvals, pk) -> list:
         """Synchronous per-shard census resolve against the HBM cache:
@@ -734,14 +1074,26 @@ class ShardedSparseTable(SparseTable):
         # legacy codec keeps the raw device-collective union
         pk = self._exchange_census(pk)
         w = self.conf.row_width
+        cold_pk = pk
+        if self._hot_realize:
+            # reconcile the replicated hot block with the (possibly just
+            # updated) plan, THEN split: the cold working set excludes
+            # every resident hot key — caches, staging and the mirror all
+            # see only the cold tail (module docstring)
+            self._sync_hot_block()
+            if self._hot_keys.shape[0]:
+                cold_pk = np.setdiff1d(
+                    pk, self._hot_keys, assume_unique=True
+                )
         payload, patches = self._pop_stage()
         lvals = None
         if payload is not None:
-            spk, owner, shard_keys, row_within, svals, _ = payload
+            spk, owner, shard_keys, row_within, svals, shot, _ = payload
             if svals is None:  # fault-injected stage fetch: sync fallback
                 stats.add("pass.stage_discards")
             elif (
                 np.array_equal(spk, pk)
+                and (shot is None or np.array_equal(shot, self._hot_keys))
                 and svals.shape[1] == self._sharded_cap(shard_keys)
                 and svals.shape[0] == self.n_local
             ):
@@ -755,8 +1107,9 @@ class ShardedSparseTable(SparseTable):
             else:
                 stats.add("pass.stage_discards")
         caches = self._caches()
+        pass_hits = 0  # cache hits filled from device THIS pass
         if lvals is None:
-            owner, shard_keys, row_within = self._shard_split(pk)
+            owner, shard_keys, row_within = self._shard_split(cold_pk)
             cap = self._sharded_cap(shard_keys)
             # materialize only the local shards: rows come from this
             # process's host store (each process persists exactly its owned
@@ -766,7 +1119,9 @@ class ShardedSparseTable(SparseTable):
             # per shard — the hit positions are filled from device below.
             lvals = np.zeros((self.n_local, cap, w + 1), dtype=np.float32)
             if caches:
-                caches = self._cached_sync_resolve(caches, shard_keys, lvals, pk)
+                caches = self._cached_sync_resolve(
+                    caches, shard_keys, lvals, cold_pk
+                )
             else:
                 for i, o in enumerate(self._local_pos):
                     sk = shard_keys[o]
@@ -779,8 +1134,9 @@ class ShardedSparseTable(SparseTable):
             # computation over the GLOBAL arrays here would be a collective
             # whose program depends on per-rank cache state (deadlock)
             self._assemble_cached_multihost(
-                lvals, shard_keys, caches, pk, sharding
+                lvals, shard_keys, caches, cold_pk, sharding
             )
+            pass_hits = self.last_cache_hits
             caches = []  # hit fill already done per shard
         else:
             self.values = global_from_local(
@@ -808,11 +1164,23 @@ class ShardedSparseTable(SparseTable):
                 total_hits += plan.n_hits
             self._cache_plans = plans
             self.last_cache_hits = total_hits
-            self.last_cache_misses = pk.shape[0] - total_hits
+            self.last_cache_misses = cold_pk.shape[0] - total_hits
+            pass_hits = total_hits
             telemetry.gauge(
                 "cache.hit_rate",
                 "fraction of the pass census served from the HBM cache",
-            ).set(total_hits / max(pk.shape[0], 1))
+            ).set(total_hits / max(cold_pk.shape[0], 1))
+        # boundary host traffic: rows that actually crossed host->device
+        # (cache misses; everything, cache-off).  With realization on, the
+        # hot tier never lands here — bench pins the collapse to O(cold)
+        from paddlebox_tpu import telemetry as _tm
+
+        owned = sum(int(shard_keys[o].shape[0]) for o in self._local_pos)
+        _tm.counter(
+            "pass.host_row_bytes_in",
+            "embedding-row bytes promoted host->device at begin_pass "
+            "(cache misses + cold materialization)",
+        ).inc(max(owned - pass_hits, 0) * 4 * (w + 1))
         self._shard_keys = shard_keys
         self._census_index = None  # stale: points at the previous census
         self._shard_live = np.asarray(
@@ -820,13 +1188,23 @@ class ShardedSparseTable(SparseTable):
         )  # per-LOCAL-shard scratch base
         self._pass_owner = owner.astype(np.int32)
         self._pass_row = row_within
-        self._pass_keys = pk
+        self._pass_keys = cold_pk
         self._in_pass = True
-        self._delta_keys.append(
-            np.concatenate([shard_keys[o] for o in self._local_pos])
-            if is_multiprocess()
-            else pk
-        )
+        if is_multiprocess():
+            local_keys = [shard_keys[o] for o in self._local_pos]
+            if self._hot_keys.shape[0]:
+                # this process's delta also covers the hot rows its shards
+                # own (every replica trains them; one owner persists them)
+                own = self._proc_of(
+                    (self._hot_keys % np.uint64(self.n_shards)).astype(
+                        np.int64
+                    ),
+                    self.n_shards,
+                ) == jax.process_index()
+                local_keys.append(self._hot_keys[own])
+            self._delta_keys.append(np.concatenate(local_keys))
+        else:
+            self._delta_keys.append(pk)
         self._observe_gap()
 
     def _assemble_cached_multihost(self, lvals, shard_keys, caches, pk,
@@ -996,9 +1374,16 @@ class ShardedSparseTable(SparseTable):
         ks = [k for k in ks if k.shape[0]]
         vs = [v for v in vs if v.shape[0]]
         if ks:
+            from paddlebox_tpu import telemetry
+
             k = np.concatenate(ks)
             v = np.concatenate(vs)
             order = np.argsort(k, kind="stable")
+            telemetry.counter(
+                "pass.host_row_bytes_out",
+                "embedding-row bytes written back device->host at "
+                "end_pass (cold + evicted rows)",
+            ).inc(v.nbytes)
             self._write_back(k[order], v[order])
         else:
             self._write_back(
@@ -1031,6 +1416,11 @@ class ShardedSparseTable(SparseTable):
             self._sorted_write_back(ks, vs)
         self.values = None
         self.g2sum = None
+        # the hot block stays device-resident across passes — its rows
+        # never transit the host here (that is the whole point); they are
+        # now newer than the store until the next flush/demotion
+        if self._hot_keys.shape[0]:
+            self._hot_dirty = True
         self._shard_keys = None
         self._pass_keys = None
         self._pass_owner = None
@@ -1053,6 +1443,24 @@ class ShardedSparseTable(SparseTable):
             if m:
                 keys.append(sk)
                 rows.append(np.concatenate([vals[i, :m], g2[i, :m, None]], axis=1))
+        if self._hot_keys.shape[0] and self.hot_values is not None:
+            # resident hot rows (this process's owned subset): absent from
+            # both the cold working set and the store's recent write-backs,
+            # so a mid-run snapshot without them would lose the hot tier
+            m = self._hot_keys.shape[0]
+            lv = np.asarray(local_view(self.hot_values)[0])
+            lg = np.asarray(local_view(self.hot_g2sum)[0])
+            hk = self._hot_keys
+            hr = np.concatenate([lv[:m], lg[:m, None]], axis=1)
+            if is_multiprocess():
+                own = self._proc_of(
+                    (hk % np.uint64(self.n_shards)).astype(np.int64),
+                    self.n_shards,
+                ) == jax.process_index()
+                hk, hr = hk[own], hr[own]
+            if hk.shape[0]:
+                keys.append(hk)
+                rows.append(hr)
         if not keys:
             return {
                 "keys": np.empty(0, np.uint64),
@@ -1154,6 +1562,8 @@ class ShardedSparseTable(SparseTable):
         needed = 0
         n_missing = 0
         ix = self._native_index()
+        hot_res = self._hot_keys if self._hot_realize else None
+        H = self.hot_block_capacity
         for b in batches:
             if b.n_keys == 0:
                 per_dev.append(None)
@@ -1177,11 +1587,28 @@ class ShardedSparseTable(SparseTable):
             else:
                 uk, inv = np.unique(real, return_inverse=True)
                 rows, owner, miss = self._resolve_shard_rows(uk)
-            slot = _rank_within_group(owner, n)
+            if hot_res is not None and hot_res.shape[0]:
+                hp = np.searchsorted(hot_res, uk)
+                hp_c = np.minimum(hp, hot_res.shape[0] - 1)
+                ishot = hot_res[hp_c] == uk
+                # resident hot keys are excluded from the cold census by
+                # construction, so both resolution branches above counted
+                # them as missing — they are device-resident, not missing
+                miss -= int(ishot.sum())
+                # route hot occurrences into a VIRTUAL group n so they
+                # neither consume cold slots nor inflate the bucket need;
+                # cold ranks are unchanged (ranks are per-group)
+                owner_v = np.where(ishot, np.int64(n), owner)
+                slot = _rank_within_group(owner_v, n + 1)
+            else:
+                ishot = np.zeros(uk.shape[0], dtype=bool)
+                hp_c = None
+                slot = _rank_within_group(owner, n)
             n_missing += miss
-            per_dev.append((b.n_keys, inv, rows, owner, slot))
-            if slot.shape[0]:
-                needed = max(needed, int(slot.max()) + 1)
+            per_dev.append((b.n_keys, inv, rows, owner, slot, ishot, hp_c))
+            cold_slot = slot[~ishot] if hp_c is not None else slot
+            if cold_slot.shape[0]:
+                needed = max(needed, int(cold_slot.max()) + 1)
 
         # capacity consensus: every process must build the same [L, n, C]
         # shape for the want allgather below, so agree on the max need first
@@ -1205,14 +1632,29 @@ class ShardedSparseTable(SparseTable):
         )
         occ = np.full((L, K), n * C, dtype=np.int32)
         mask = np.zeros((L, K), dtype=np.float32)
+        # hybrid realization: every occurrence additionally carries a hot
+        # slot (H = padded sink for cold/pad) and each referenced hot slot
+        # its lr (0.0 where unreferenced on this device — the device-side
+        # pmax fold across replicas recovers the true lr; a slot no device
+        # references keeps lr 0.0 AND receives an exactly-zero gradient, so
+        # the unconditional adagrad apply is a bitwise no-op for it).
+        # Shapes depend only on the padded capacity H, never on the plan.
+        hot_occ = hot_lr = None
+        if self._hot_realize:
+            hot_occ = np.full((L, K), H, dtype=np.int32)
+            hot_lr = np.zeros((L, H), dtype=np.float32)
         n_overflow = 0  # structurally zero now; kept for API compatibility
         for d, resolved in enumerate(per_dev):
             if resolved is None:
                 continue
-            n_keys, inv, rows, owner, slot = resolved
-            want[d, owner, slot] = rows
-            occ[d, :n_keys] = (owner * C + slot).astype(np.int32)[inv]
+            n_keys, inv, rows, owner, slot, ishot, hp_c = resolved
+            cold = ~ishot
+            want[d, owner[cold], slot[cold]] = rows[cold]
+            occ[d, :n_keys] = np.where(
+                ishot, n * C, owner * C + slot
+            ).astype(np.int32)[inv]
             mask[d, :n_keys] = 1.0
+            klr = None
             if want_lr is not None:
                 # occurrence slot -> lr, merged per unique key (last wins —
                 # keys never span slots in practice, same assumption as the
@@ -1222,7 +1664,15 @@ class ShardedSparseTable(SparseTable):
                 ]
                 klr = np.full(rows.shape[0], default_lr, np.float32)
                 klr[inv] = occ_lr
-                want_lr[d, owner, slot] = klr
+                want_lr[d, owner[cold], slot[cold]] = klr[cold]
+            if hot_occ is not None and hp_c is not None:
+                hot_occ[d, :n_keys] = np.where(
+                    ishot, hp_c, H
+                ).astype(np.int32)[inv]
+                if ishot.any():
+                    if klr is None:
+                        klr = np.full(rows.shape[0], default_lr, np.float32)
+                    hot_lr[d, hp_c[ishot]] = klr[ishot]
         # every requester's matrix, in mesh order (processes own contiguous
         # runs — asserted in __init__); single-process: want itself.  With an
         # LR map the float lrs travel bit-packed beside the row ids so the
@@ -1292,7 +1742,7 @@ class ShardedSparseTable(SparseTable):
         self.overflow_key_count += n_overflow
         return ShardedBatchPlan(
             serve_rows, occ, serve_map, serve_uniq, mask, n_missing,
-            n_overflow, serve_lr,
+            n_overflow, serve_lr, hot_occ, hot_lr,
         )
 
     def _resolve_shard_rows(self, uk: np.ndarray):

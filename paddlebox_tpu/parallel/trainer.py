@@ -110,6 +110,12 @@ def _stack_group(
         )
     if plan.serve_lr is not None:
         extra["uniq_lr"] = plan.serve_lr
+    if plan.hot_occ is not None:
+        # realized hybrid placement: hot routing rides the feed like every
+        # other plan array — padded [D, K]/[D, H] shapes, so the jitted
+        # step never sees the live plan (zero-retrace under plan churn)
+        extra["hot_occ"] = plan.hot_occ
+        extra["hot_lr"] = plan.hot_lr
     return {
         **extra,
         "serve_rows": plan.serve_rows,
@@ -152,6 +158,102 @@ def sharded_pull(values: jax.Array, serve_rows: jax.Array, occ_flat: jax.Array,
             [rows[..., :cvm_offset], rows[..., cvm_offset:] * visible], axis=-1
         )
     return rows
+
+
+def hybrid_pull(
+    values: jax.Array,
+    hot_values: jax.Array,
+    serve_rows: jax.Array,
+    occ_flat: jax.Array,
+    hot_occ: jax.Array,
+    create_threshold: float,
+    cvm_offset: int,
+) -> jax.Array:
+    """Hybrid-placement pull (call inside shard_map): cold occurrences ride
+    the existing all_to_all path, hot occurrences gather from the
+    REPLICATED local hot block — zero host-plane and zero ICI row bytes for
+    the skewed-hot head (the Parallax/Parameter-Box replication payoff).
+
+    hot_values: [H, W] this device's copy of the replicated hot block.
+    hot_occ: [K] slot into the hot block, H = cold/padding sink (those
+    occurrences carry a real cold route in occ_flat; hot occurrences carry
+    the cold n*C sink, so the two selects partition exactly).
+    create_threshold is applied AFTER the select so hot and cold rows see
+    the identical visibility rule.
+    """
+    rows = sharded_pull(values, serve_rows, occ_flat, 0.0, cvm_offset)
+    H, W = hot_values.shape
+    hot_ext = jnp.concatenate(
+        [hot_values, jnp.zeros((1, W), hot_values.dtype)]
+    )
+    from paddlebox_tpu.config import flags
+
+    if flags.use_pallas_sparse:
+        from paddlebox_tpu.ops.pallas_sparse import pallas_hot_cold_select
+
+        rows = pallas_hot_cold_select(hot_ext, hot_occ, rows)
+    else:
+        hrows = jnp.take(hot_ext, hot_occ, axis=0)
+        rows = jnp.where((hot_occ < H)[:, None], hrows, rows)
+    if create_threshold > 0.0:
+        visible = (rows[..., 0:1] >= create_threshold).astype(rows.dtype)
+        rows = jnp.concatenate(
+            [rows[..., :cvm_offset], rows[..., cvm_offset:] * visible], axis=-1
+        )
+    return rows
+
+
+def hybrid_hot_update(
+    hot_values: jax.Array,
+    hot_g2sum: jax.Array,
+    row_grads: jax.Array,
+    hot_occ: jax.Array,
+    hot_lr: jax.Array,
+    key_mask: jax.Array,
+    key_clicks: jax.Array,
+    conf: SparseTableConfig,
+):
+    """Replica-identical hot-block update (call inside shard_map).
+
+    Level 1 mirrors the cold path's occurrence merge (segment_sum in
+    occurrence order); level 2 is the DETERMINISTIC-ORDER psum: an
+    all_gather followed by an unrolled device-ascending fold, the same
+    requester-major device order the cold path's serve_map segment-sum
+    folds in — so a key served hot reduces its cross-device contributions
+    in exactly the order it would have reduced them cold, and the
+    planned-vs-hash bit-exactness pin holds (ARCHITECTURE.md "Hybrid
+    placement", reduction-order argument).
+
+    The adagrad apply is UNCONDITIONAL over all H padded slots: an
+    untouched slot has an exactly-zero merged gradient, and sparse adagrad
+    of a zero gradient is an exactly-zero delta (zero clip, zero scaled
+    update), so padding and unreferenced residents stay bitwise unchanged
+    without any fill-mask data dependence.  hot_lr is 0.0 on devices
+    without an occurrence of the slot; the pmax fold recovers the one real
+    lr (max{lr, 0} = lr) identically on every replica.
+    """
+    H, W = hot_values.shape
+    co = conf.cvm_offset
+    merged = jax.ops.segment_sum(row_grads, hot_occ, num_segments=H + 1)[:H]
+    show = jax.ops.segment_sum(key_mask, hot_occ, num_segments=H + 1)[:H]
+    clk = jax.ops.segment_sum(key_clicks, hot_occ, num_segments=H + 1)[:H]
+    counters = jnp.stack([show, clk], axis=1)
+    if co > 2:
+        counters = jnp.concatenate(
+            [counters, jnp.zeros((H, co - 2), counters.dtype)], axis=1
+        )
+    contrib = jnp.concatenate([counters, merged[:, co:]], axis=1)  # [H, W]
+    gathered = jax.lax.all_gather(contrib, DATA_AXIS)  # [n, H, W]
+    acc = gathered[0]
+    for i in range(1, gathered.shape[0]):  # unrolled: fixed fold order
+        acc = acc + gathered[i]
+    lr = jax.lax.pmax(hot_lr, DATA_AXIS)
+    w_delta, g2_delta = sparse_adagrad_update(
+        hot_g2sum, acc[:, co:], lr, conf.initial_g2sum, conf.grad_clip,
+    )
+    hot_values = hot_values + jnp.concatenate([acc[:, :co], w_delta], axis=1)
+    hot_g2sum = hot_g2sum + g2_delta
+    return hot_values, hot_g2sum
 
 
 def sharded_push_and_update(
@@ -280,15 +382,22 @@ class MultiChipTrainer:
         self.params = stack(p0)
         self.opt_state = stack(o0)
         self._step_fn = None
+        self._step_hot_cap = -1  # hot capacity the step was built for
         self._sync_fn = None
         self._eval_fn = None
+        self._eval_hot_cap = -1
         self._copy_fn = None
         self.async_dense = None  # lazily created in "async" mode
         self.global_step = 0
         self.last_metric_state = None  # dict after a pass (Trainer parity)
 
     # -- jitted bodies ----------------------------------------------------- #
-    def _build_step(self):
+    def _build_step(self, hot_cap: int = 0):
+        """hot_cap: padded hot-block capacity H (table.hot_block_capacity).
+        0 compiles the pure hash-sharded step; > 0 compiles the hybrid step
+        (two extra donated [D, H(, W)] state arrays, hybrid pull/push).
+        STATIC for the table's lifetime — the step specializes on the
+        capacity, never on the live plan."""
         model = self.model
         tconf = self.table_conf
         optimizer = self.optimizer
@@ -310,7 +419,8 @@ class MultiChipTrainer:
             self.slot_mask, model.n_sparse_slots
         )
 
-        def body(params, opt_state, values, g2sum, mstate, batch):
+        def body(params, opt_state, values, g2sum, mstate, batch,
+                 hot_values=None, hot_g2sum=None):
             # local blocks all carry a leading device axis of size 1
             unstack = lambda t: jax.tree.map(lambda x: x[0], t)
             params, opt_state = unstack(params), unstack(opt_state)
@@ -318,10 +428,18 @@ class MultiChipTrainer:
             values, g2sum = values[0], g2sum[0]
             batch = unstack(batch)
 
-            rows = sharded_pull(
-                values, batch["serve_rows"], batch["occ_flat"],
-                tconf.create_threshold, tconf.cvm_offset,
-            )
+            if hot_cap:
+                hot_values, hot_g2sum = hot_values[0], hot_g2sum[0]
+                rows = hybrid_pull(
+                    values, hot_values, batch["serve_rows"],
+                    batch["occ_flat"], batch["hot_occ"],
+                    tconf.create_threshold, tconf.cvm_offset,
+                )
+            else:
+                rows = sharded_pull(
+                    values, batch["serve_rows"], batch["occ_flat"],
+                    tconf.create_threshold, tconf.cvm_offset,
+                )
             bsz = batch["labels"].shape[0]
             extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
             if uses_seq:
@@ -377,6 +495,13 @@ class MultiChipTrainer:
                 batch["serve_uniq"], key_mask, key_clicks, tconf,
                 uniq_lr=batch.get("uniq_lr"),
             )
+            if hot_cap:
+                # hot occurrences carried the cold sink above, so their
+                # grads/counters reach exactly one of the two updates
+                hot_values, hot_g2sum = hybrid_hot_update(
+                    hot_values, hot_g2sum, row_grads, batch["hot_occ"],
+                    batch["hot_lr"], key_mask, key_clicks, tconf,
+                )
             primary = preds[:, 0] if n_tasks > 1 else preds
             mstate = dict(mstate)
             mstate["auc"] = update_auc_state(
@@ -421,8 +546,12 @@ class MultiChipTrainer:
                 finite = jnp.array(True)
             restack = lambda t: jax.tree.map(lambda x: x[None], t)
             cnt = batch["ins_mask"].sum()
+            hot_out = (
+                (hot_values[None], hot_g2sum[None]) if hot_cap else ()
+            )
             out = (
                 restack(params), restack(opt_state), values[None], g2sum[None],
+            ) + hot_out + (
                 restack(mstate), loss[None], cnt[None], finite[None],
             )
             if async_dense:
@@ -436,16 +565,17 @@ class MultiChipTrainer:
             return out
 
         spec = P(DATA_AXIS)
-        n_out = 8 + int(async_dense) + int(dump_preds)
+        n_state = 8 if hot_cap else 6
+        n_out = n_state + 2 + int(async_dense) + int(dump_preds)
         mapped = shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(spec, spec, spec, spec, spec, spec),
+            in_specs=(spec,) * n_state,
             out_specs=(spec,) * n_out,
             axis_names={DATA_AXIS},
         )
-        return counted_jit(
-            mapped, stage="spmd.step", donate_argnums=(0, 1, 2, 3, 4))
+        donate = (0, 1, 2, 3, 4, 6, 7) if hot_cap else (0, 1, 2, 3, 4)
+        return counted_jit(mapped, stage="spmd.step", donate_argnums=donate)
 
     def _build_sync(self):
         """K-step param sync: average drifted replicas (reference: SyncParam
@@ -523,6 +653,21 @@ class MultiChipTrainer:
             finally:
                 self.async_dense = None
 
+    def _hot_state(self, table: ShardedSparseTable, hot_cap: int) -> tuple:
+        """(hot_values [D, H, W], hot_g2sum [D, H]) for the hybrid step —
+        the table's live block, or all-zeros before the first plan
+        realizes (nothing routes hot then: hot_occ is all-sink, and a
+        zero block receives exactly-zero updates)."""
+        if table.hot_values is None:
+            w = self.table_conf.row_width
+            table.hot_values = self._stack_local(
+                jnp.zeros((hot_cap, w), jnp.float32)
+            )
+            table.hot_g2sum = self._stack_local(
+                jnp.zeros((hot_cap,), jnp.float32)
+            )
+        return table.hot_values, table.hot_g2sum
+
     def init_auc(self) -> AucState:
         return self._stack_local(init_auc_state(self.conf.auc_buckets))
 
@@ -589,8 +734,10 @@ class MultiChipTrainer:
         staged via table.prepare_pass once this pass's groups are exhausted
         — the sharded half of pass-boundary pipelining (single-process
         only; multi-host prepare_pass no-ops, see sharded_table.py)."""
-        if self._step_fn is None:
-            self._step_fn = self._build_step()
+        hot_cap = int(getattr(table, "hot_block_capacity", 0))
+        if self._step_fn is None or self._step_hot_cap != hot_cap:
+            self._step_fn = self._build_step(hot_cap)
+            self._step_hot_cap = hot_cap
         if self._sync_fn is None and self.conf.sync_dense_mode == "kstep":
             self._sync_fn = self._build_sync()
         from paddlebox_tpu.parallel.multiprocess import is_multiprocess
@@ -633,6 +780,9 @@ class MultiChipTrainer:
         )
         pass_t0 = time.monotonic()
         values, g2sum = table.values, table.g2sum
+        hot_values = hot_g2sum = None
+        if hot_cap:
+            hot_values, hot_g2sum = self._hot_state(table, hot_cap)
         losses, counts, n_steps = [], [], 0
         uses_rank = getattr(self.model, "uses_rank_offset", False)
         uses_seq = getattr(self.model, "uses_seq_pos", False)
@@ -781,11 +931,22 @@ class MultiChipTrainer:
                 # chaos site: a hang here simulates a stalled device step
                 # on this process; the watchdog bounds it fleet-wide
                 faults.inject("train.step")
-                out = self._step_fn(
-                    self.params, self.opt_state, values, g2sum, mstate, feed
-                )
-                (self.params, self.opt_state, values, g2sum, mstate, loss,
-                 cnt, finite) = out[:8]
+                if hot_cap:
+                    out = self._step_fn(
+                        self.params, self.opt_state, values, g2sum, mstate,
+                        feed, hot_values, hot_g2sum,
+                    )
+                    (self.params, self.opt_state, values, g2sum, hot_values,
+                     hot_g2sum, mstate, loss, cnt, finite) = out[:10]
+                    n_fixed = 10
+                else:
+                    out = self._step_fn(
+                        self.params, self.opt_state, values, g2sum, mstate,
+                        feed,
+                    )
+                    (self.params, self.opt_state, values, g2sum, mstate, loss,
+                     cnt, finite) = out[:8]
+                    n_fixed = 8
                 if wd is not None:
                     wd.report("step")
                 if dumper is not None:
@@ -797,7 +958,7 @@ class MultiChipTrainer:
                     # push one step BEHIND: step t's grad is already computed
                     # when step t+1 dispatches, so reading it never stalls
                     # the device pipeline
-                    pending_grads.append(out[8])
+                    pending_grads.append(out[n_fixed])
                     if len(pending_grads) > 1:
                         self._push_async_grad(pending_grads.pop(0))
                     if (self.global_step + 1) % pull_every == 0:
@@ -848,6 +1009,8 @@ class MultiChipTrainer:
             if wd is not None:
                 wd.close()
             table.values, table.g2sum = values, g2sum
+            if hot_cap and hot_values is not None:
+                table.hot_values, table.hot_g2sum = hot_values, hot_g2sum
             if prefetcher is not None:
                 prefetcher.close()
             if dumper is not None:
@@ -975,21 +1138,28 @@ class MultiChipTrainer:
         return metrics
 
     # -- inference / evaluation -------------------------------------------- #
-    def _build_eval(self):
+    def _build_eval(self, hot_cap: int = 0):
         model = self.model
         tconf = self.table_conf
         uses_rank = getattr(model, "uses_rank_offset", False)
         uses_seq = getattr(model, "uses_seq_pos", False)
         n_tasks = self.n_tasks
 
-        def body(params, values, auc, batch):
+        def body(params, values, auc, batch, hot_values=None):
             unstack = lambda t: jax.tree.map(lambda x: x[0], t)
             params, auc, batch = unstack(params), unstack(auc), unstack(batch)
             values = values[0]
-            rows = sharded_pull(
-                values, batch["serve_rows"], batch["occ_flat"],
-                tconf.create_threshold, tconf.cvm_offset,
-            )
+            if hot_cap:
+                rows = hybrid_pull(
+                    values, hot_values[0], batch["serve_rows"],
+                    batch["occ_flat"], batch["hot_occ"],
+                    tconf.create_threshold, tconf.cvm_offset,
+                )
+            else:
+                rows = sharded_pull(
+                    values, batch["serve_rows"], batch["occ_flat"],
+                    tconf.create_threshold, tconf.cvm_offset,
+                )
             bsz = batch["labels"].shape[0]
             extra = {"rank_offset": batch["rank_offset"]} if uses_rank else {}
             if uses_seq:
@@ -1002,8 +1172,9 @@ class MultiChipTrainer:
             return jax.tree.map(lambda x: x[None], auc)
 
         spec = P(DATA_AXIS)
+        n_in = 5 if hot_cap else 4
         mapped = shard_map(
-            body, mesh=self.mesh, in_specs=(spec,) * 4, out_specs=spec,
+            body, mesh=self.mesh, in_specs=(spec,) * n_in, out_specs=spec,
             axis_names={DATA_AXIS},
         )
         return counted_jit(mapped, stage="spmd.eval", donate_argnums=(2,))
@@ -1012,8 +1183,11 @@ class MultiChipTrainer:
                  drop_last: bool = False) -> dict:
         """Forward-only multi-chip pass (infer_from_dataset analog): no
         table/param updates, per-device AUC merged at the end."""
-        if self._eval_fn is None:
-            self._eval_fn = self._build_eval()
+        hot_cap = int(getattr(table, "hot_block_capacity", 0))
+        if self._eval_fn is None or self._eval_hot_cap != hot_cap:
+            self._eval_fn = self._build_eval(hot_cap)
+            self._eval_hot_cap = hot_cap
+        hot_values = self._hot_state(table, hot_cap)[0] if hot_cap else None
         from paddlebox_tpu.parallel.multiprocess import (
             is_multiprocess,
             merge_device_axis,
@@ -1061,7 +1235,12 @@ class MultiChipTrainer:
             plan = table.plan_group(group)
             feed = _stack_group(group, plan, n_slots)
             feed = global_from_local(self._sharding, feed)
-            auc = self._eval_fn(self.params, table.values, auc, feed)
+            if hot_cap:
+                auc = self._eval_fn(
+                    self.params, table.values, auc, feed, hot_values
+                )
+            else:
+                auc = self._eval_fn(self.params, table.values, auc, feed)
         return compute_metrics(merge_device_axis(auc))
 
 

@@ -277,6 +277,36 @@ class HbmCache:
         self._rebuild_index()
         return int(slots.shape[0])
 
+    def take_rows(
+        self, keys: np.ndarray, pad_to: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Read-and-evict for hot promotion (realized hybrid placement):
+        ``keys`` leaving for the replicated device block must not stay
+        resident here too, or the next census would double-home them.
+        Returns ``(hit_mask bool [n], rows [hits, n_cols])`` — rows
+        aligned with the hit subset of ``keys`` in order; the evicted
+        slots are dropped clean (the caller now owns the freshest copy).
+        Misses are the caller's to resolve against the host store.
+        ``pad_to`` pads the device gather to a static length so repeated
+        promotions with varying hit counts reuse one compiled gather."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        mask = self.hit_mask_in(self._sorted_keys, keys)
+        if not mask.any():
+            return mask, np.empty((0, self.n_cols), dtype=np.float32)
+        pos = np.searchsorted(self._sorted_keys, keys[mask])
+        slots = self._sorted_slots[pos]
+        k = int(slots.shape[0])
+        if pad_to is not None and pad_to >= k:
+            padded = np.zeros(pad_to, dtype=np.int64)
+            padded[:k] = slots
+            rows = np.asarray(self.gather_rows(padded))[:k]
+        else:
+            rows = np.asarray(self.gather_rows(slots))
+        self.used[slots] = False
+        self.dirty[slots] = False
+        self._rebuild_index()
+        return mask, rows
+
     # -- row movement ------------------------------------------------------ #
     def gather_rows(self, slots: np.ndarray) -> jax.Array:
         """Device gather of ``slots`` rows (Pallas cache-slot gather when

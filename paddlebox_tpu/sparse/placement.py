@@ -9,19 +9,22 @@ from the HbmCache LFU/aging directory and the host store's show counters)
 that classifies the top-k keys by aged frequency as *replicated-hot* and
 everything else as *hash-sharded cold*, emitted as a :class:`PlacementPlan`.
 
-How the plan is realized (v1, the wire plane — see ARCHITECTURE.md
-"Hybrid placement & host-plane compression"): the device data plane keeps
-the hash-sharded row placement byte-for-byte (which is what makes planned
-runs provably bit-exact against hash-only runs), and the hot set becomes
-the multi-host plane's SHARED DICTIONARY — every process derives the same
+How the plan is realized (see ARCHITECTURE.md "Hybrid placement &
+host-plane compression").  Wire plane (PR 15): the hot set is the
+multi-host plane's SHARED DICTIONARY — every process derives the same
 plan from the same global census stream, so hot keys ride the census
 exchange as one membership bit each instead of eight bytes, and only the
-cold tail travels as (varint sorted-delta) key payloads.  The gradient
-reduction of replicated-hot keys is exactly the existing serve_map dedup:
-every requester's occurrence of a hot key already folds into ONE
-per-owner segment before the optimizer touches the row
-(parallel/sharded_table.py plan_group), so replication changes which
-bytes move, never which floats add.
+cold tail travels as (varint sorted-delta) key payloads.  Device plane
+(PR 20, ``SparseTableConfig.placement_realize``): the hot set is
+MATERIALIZED as a replicated ``[H, W+1]`` block resident on every device
+(parallel/sharded_table.py), so a hot lookup is a purely local gather —
+zero host-plane row bytes and zero all-to-all slots inside a pass — and
+hot-key gradients reduce with a deterministic device-order fold before a
+replica-identical optimizer apply.  Only the cold tail keeps the
+hash-sharded stacked layout and the serve_map dedup path.  Hot⇄cold
+promotions/demotions happen exclusively at pass boundaries, bounded by
+the hysteresis below, and move rows with the keycodec-framed migration
+machinery (:func:`hot_churn` names the moves).
 
 Plan churn is hysteresis-bounded: a key must climb above ``enter_freq``
 to become hot, keeps its slot until it decays below ``exit_freq``, and
@@ -50,6 +53,27 @@ _EMPTY_U64 = np.empty(0, dtype=np.uint64)
 # observe(): bounds tracker memory to ~the recent working set without
 # affecting plan decisions (anything this cold is far below exit_freq)
 _PRUNE_FREQ = 0.05
+
+
+def hot_churn(resident: np.ndarray, target: np.ndarray) -> tuple:
+    """(promote, demote) between the device-RESIDENT hot set and the
+    plan's TARGET hot set, both sorted unique uint64.  promote = keys the
+    realizer must fetch into the replicated block; demote = keys it must
+    write back to the sharded cold tier.  Counts the total move volume on
+    ``placement.hot_churn_keys`` (the ``table.hot_churn`` run-health rule
+    watches this — a churn burst past the hysteresis baseline means the
+    planner is thrashing rows through the host plane)."""
+    resident = np.asarray(resident, dtype=np.uint64)
+    target = np.asarray(target, dtype=np.uint64)
+    promote = np.setdiff1d(target, resident, assume_unique=True)
+    demote = np.setdiff1d(resident, target, assume_unique=True)
+    moved = int(promote.shape[0] + demote.shape[0])
+    if moved:
+        telemetry.counter(
+            "placement.hot_churn_keys",
+            "hot-set keys promoted or demoted at pass boundaries",
+        ).inc(moved)
+    return promote, demote
 
 
 @dataclasses.dataclass(frozen=True)

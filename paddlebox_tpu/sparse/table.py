@@ -795,6 +795,15 @@ class SparseTable:
             # rows (+ write-back patches — evictions always write back),
             # current hits are overwritten from HBM here
             plan, v = self._cache_plan_and_fill(cache, pk, v)
+        # host-plane promotion volume (same counter both planes —
+        # parallel/sharded_table.py): every census row the device could
+        # not fill from its own HBM tier crossed host->device here
+        n_hits = plan.n_hits if plan is not None else 0
+        telemetry.counter(
+            "pass.host_row_bytes_in",
+            "embedding-row bytes promoted host->device at begin_pass "
+            "(cache misses + cold materialization)",
+        ).inc(max(n - n_hits, 0) * 4 * (w + 1))
         self._cache_plan = plan
         self.values = v[:, :w]
         self.g2sum = v[:, w]
@@ -835,6 +844,11 @@ class SparseTable:
         upd = self._cache_update_plan(cache, pk, plan)
         if upd is None:
             vals = np.asarray(full[:n])
+            telemetry.counter(
+                "pass.host_row_bytes_out",
+                "embedding-row bytes written back device->host at "
+                "end_pass (cold + evicted rows)",
+            ).inc(vals.nbytes)
             with self._cache_lock:
                 cache.evict_keys(pk[plan.hit_mask])
                 self._write_back(pk, vals)
@@ -855,6 +869,11 @@ class SparseTable:
             cache.set_rows(upd_slots, full[jnp.asarray(upd_pos)])
         wb_keys = np.concatenate([pk[upd.cold_pos], upd.victim_keys])
         order = np.argsort(wb_keys, kind="stable")
+        telemetry.counter(
+            "pass.host_row_bytes_out",
+            "embedding-row bytes written back device->host at "
+            "end_pass (cold + evicted rows)",
+        ).inc(cold_rows.nbytes + victim_rows.nbytes)
         with self._cache_lock:
             cache.commit_update(plan, upd)
             self._write_back(
@@ -883,10 +902,17 @@ class SparseTable:
         if cache is not None and plan is not None and n:
             self._end_pass_cached(cache, plan, pk, n)
         else:
+            from paddlebox_tpu import telemetry
+
             vals = np.concatenate(
                 [np.asarray(self.values), np.asarray(self.g2sum)[:, None]],
                 axis=1,
             )[:n]
+            telemetry.counter(
+                "pass.host_row_bytes_out",
+                "embedding-row bytes written back device->host at "
+                "end_pass (cold + evicted rows)",
+            ).inc(vals.nbytes)
             self._write_back(pk, vals)
         self.values = None
         self.g2sum = None

@@ -143,6 +143,13 @@ _RULE_SPECS = [
      "severity": "warn",
      "meaning": "placement-plan hot-set mutating faster than its "
                 "hysteresis baseline"},
+    {"name": "table.hot_churn", "family": "table",
+     "signal": "counter.placement.hot_churn_keys", "kind": "zscore",
+     "direction": "above", "threshold": 4.0, "min_delta": 16.0,
+     "min_rel": 0.5, "severity": "warn",
+     "meaning": "realized hot-block promotions+demotions per boundary "
+                "spiking — each churned key pays a host-plane row move, "
+                "so a thrashing hot set erodes the replicated-hot win"},
     # -- pipeline health --------------------------------------------------- #
     {"name": "pipeline.pass_gap", "family": "pipeline",
      "signal": "hist.pass.boundary_gap_seconds.mean", "kind": "zscore",

@@ -37,6 +37,12 @@ N_PASSES = 3
 
 
 def _tconf(cache_rows: int, **kw) -> SparseTableConfig:
+    # placement="hash": this suite pins the HBM-cache engine itself.  The
+    # default (realized hybrid placement) would promote the tiny toy
+    # census into the replicated hot block after a couple of passes,
+    # leaving the cache no cold tail to exercise — the hybrid lifecycle
+    # has its own suite (test_placement.py).
+    kw.setdefault("placement", "hash")
     return SparseTableConfig(
         embedding_dim=4, learning_rate=0.4, initial_range=0.05,
         store_buckets=16, plan_scratch_rows=64, hbm_cache_rows=cache_rows,

@@ -355,8 +355,8 @@ def test_truncated_message_is_loud():
 # --------------------------------------------------------------------------- #
 # bit-exact: planned placement vs hash-only, both trainer paths
 # --------------------------------------------------------------------------- #
-def _train_sharded(tmp_path, placement, n_passes=3):
-    mesh = make_mesh(min(8, len(jax.devices())))
+def _train_sharded(tmp_path, placement, n_passes=3, n_dev=None):
+    mesh = make_mesh(n_dev or min(8, len(jax.devices())))
     tconf = SparseTableConfig(
         embedding_dim=4, placement=placement, placement_update_interval=1,
         placement_hot_capacity=64, hbm_cache_rows=64,
@@ -425,6 +425,84 @@ def test_bitexact_single_chip_placement_inert(tmp_path, monkeypatch):
 
 
 # --------------------------------------------------------------------------- #
+# realized hybrid placement: deterministic reduction + host-plane pins
+# --------------------------------------------------------------------------- #
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+def test_hybrid_reduce_bitexact_across_reruns(tmp_path, n_dev):
+    """The hot-gradient reduction is an explicitly ordered fold (level-1
+    segment_sum over in-batch occurrences, level-2 all_gather + unrolled
+    device-ascending adds), so two identical runs on the realized hybrid
+    layout must produce byte-identical stores — keys, values AND the
+    g2sum column — and the same AUC, at every simulated device count."""
+    if len(jax.devices()) < n_dev:
+        pytest.skip(f"needs {n_dev} devices")
+    st_a, auc_a, plan_a = _train_sharded(tmp_path, "loopback", n_dev=n_dev)
+    st_b, auc_b, plan_b = _train_sharded(tmp_path, "loopback", n_dev=n_dev)
+    assert plan_a is not None and plan_a.n_hot > 0, (
+        "the plan never realized — the reduction under test never ran"
+    )
+    assert plan_b is not None and plan_b.n_hot == plan_a.n_hot
+    np.testing.assert_array_equal(st_a["keys"], st_b["keys"])
+    np.testing.assert_array_equal(st_a["values"], st_b["values"])
+    assert auc_a == auc_b
+
+
+def test_hybrid_zero_host_row_bytes_inside_pass(tmp_path):
+    """The structural pin of the realized layout: once a key is hot and
+    resident, its rows NEVER cross the host plane — zero row bytes of any
+    kind inside a pass, and boundary traffic exactly O(cold rows) with a
+    steady census (no churn -> zero hot migration bytes too)."""
+    from paddlebox_tpu.telemetry import registry
+
+    mesh = make_mesh(min(8, len(jax.devices())))
+    tconf = SparseTableConfig(
+        embedding_dim=4, placement="loopback",
+        placement_update_interval=1, placement_hot_capacity=32,
+        hbm_cache_rows=0,  # no cache: every host row move is counted
+    )
+    trconf = TrainerConfig(auc_buckets=1 << 10)
+    model = CtrDnn(S, tconf.row_width, dense_dim=DENSE, hidden=(8,))
+    trainer = MultiChipTrainer(model, tconf, mesh, trconf, seed=3)
+    table = ShardedSparseTable(tconf, mesh, seed=5, bucket_slack=8.0)
+    conf, ds = _make_data(tmp_path / "pin", seed=11)
+    keys = ds.unique_keys()
+    for _ in range(3):  # aged frequency clears enter_freq; block realizes
+        table.begin_pass(keys)
+        trainer.train_from_dataset(ds, table)
+        table.end_pass()
+    n_hot = table.hot_resident_keys().shape[0]
+    assert n_hot > 0, "hot block never realized"
+    n_cold = int(keys.shape[0]) - n_hot
+    row_b = 4 * (tconf.row_width + 1)
+
+    def ctr(snap, name):
+        return snap["counters"].get(name, 0)
+
+    s0 = registry.snapshot()
+    table.begin_pass(keys)
+    s1 = registry.snapshot()
+    trainer.train_from_dataset(ds, table)
+    s2 = registry.snapshot()
+    table.end_pass()
+    s3 = registry.snapshot()
+    ds.close()
+    table.close()
+    # inside the pass: zero host-plane row bytes, hot or cold
+    for c in ("pass.host_row_bytes_in", "pass.host_row_bytes_out",
+              "placement.hot_row_host_bytes"):
+        assert ctr(s2, c) == ctr(s1, c), f"{c} moved inside a pass"
+    # steady census: zero hot-tier migration bytes across the boundary
+    assert ctr(s3, "placement.hot_row_host_bytes") == ctr(
+        s0, "placement.hot_row_host_bytes")
+    # boundary traffic is exactly the cold tail: resident hot rows ride
+    # neither the begin_pass fill nor the end_pass write-back
+    assert ctr(s1, "pass.host_row_bytes_in") - ctr(
+        s0, "pass.host_row_bytes_in") == n_cold * row_b
+    assert ctr(s3, "pass.host_row_bytes_out") - ctr(
+        s2, "pass.host_row_bytes_out") == n_cold * row_b
+
+
+# --------------------------------------------------------------------------- #
 # zero-retrace under plan churn (the PR-14 pins must hold)
 # --------------------------------------------------------------------------- #
 def test_plan_churn_zero_retrace(tmp_path):
@@ -447,10 +525,18 @@ def test_plan_churn_zero_retrace(tmp_path):
     conf, ds = _make_data(tmp_path / "churn", seed=9)
     keys = ds.unique_keys()
 
-    for _ in range(2):  # warmup: compile + capacity-fit recompile
+    # warmup: compile + capacity-fit recompile, plus the pass where the
+    # planner's hot set first clears the hysteresis gate and the hybrid
+    # layout realizes on device (first promotion compiles its static-[H]
+    # migration machinery once, like the step itself)
+    for _ in range(3):
         table.begin_pass(keys)
         trainer.train_from_dataset(ds, table)
         table.end_pass()
+    assert table.hot_resident_keys().shape[0] > 0, (
+        "warmup never realized the hot block — the measured window "
+        "would not cover the hybrid path"
+    )
 
     before = compiles.compiles_by_stage()
     versions = []
@@ -486,6 +572,11 @@ def test_bench_hostplane_smoke():
         ins_per_pass=128, hidden=(8,), vocab_per_slot=300,
     )
     assert res["bitexact"]
+    assert res["hot_resident_rows"] > 0, "hybrid arm never realized"
+    assert (
+        res["hybrid_host_row_bytes_in_last_pass"]
+        < res["wire_host_row_bytes_in_last_pass"]
+    ), "realized hot rows still paying begin-pass host traffic"
     assert res["census_compression_x"] >= 4.0
     assert (
         res["planned_varint_bytes_per_pass"]

@@ -237,6 +237,11 @@ def test_zero_retrace_after_cutover(tmp_path, mesh2, mesh4):
     tr = _trainer(tconf, mesh4)
     _run_pass(tr, table, ds)  # warmup: first compile on the new split
     _run_pass(tr, table, ds)  # capacity-fit recompile settles
+    # default hybrid placement realizes the hot block once the planner's
+    # aged frequencies cross enter_freq — one more settle pass absorbs
+    # that boundary's one-time eager shape warm-ups (the steady-state
+    # hybrid pin itself lives in test_placement.py)
+    _run_pass(tr, table, ds)
     before = _counts()
     _run_pass(tr, table, ds)
     assert not _delta(before, _counts()), \

@@ -101,6 +101,7 @@ class CallGraph:
         self._by_node: dict = {}   # id(ast node) -> func id
         self._props_cache: dict = {}
         self._lt_cache: dict = {}
+        self._shallow_cache: dict = {}
         self._build()
 
     @classmethod
@@ -449,15 +450,26 @@ class CallGraph:
 
     def _shallow_walk(self, fn):
         """Nodes of fn's own body, not descending into nested defs or
-        classes (their calls belong to their own graph node)."""
+        classes (their calls belong to their own graph node).  Memoized
+        per function node — every pass that consults the graph re-scans
+        the same bodies, and the double scan in _scan_edges alone made
+        this the hottest loop in the --all wall-time budget."""
+        key = id(fn)
+        hit = self._shallow_cache.get(key)
+        if hit is not None and hit[0] is fn:
+            return hit[1]
+        out = []
         stack = list(ast.iter_child_nodes(fn))
         while stack:
             n = stack.pop()
-            yield n
+            out.append(n)
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
                               ast.ClassDef, ast.Lambda)):
                 continue
             stack.extend(ast.iter_child_nodes(n))
+        nodes = tuple(out)
+        self._shallow_cache[key] = (fn, nodes)
+        return nodes
 
     def _scan_edges(self, fi: FuncInfo) -> list:
         edges: list = []

@@ -26,6 +26,12 @@ hang family a review round has chased by hand:
   * ``gather_fleet_snapshot`` — the pass-boundary metric allgather over
     the coordination KV ("Every rank participates (lockstep, like the
     collectives)", parallel/trainer.py).
+  * ``ShardedSparseTable.broadcast_hot_rows`` — hot-promotion rows ride
+    the census channel as keycodec frames; every rank contributes and
+    receives in lockstep inside ``begin_pass`` (main thread, between the
+    census gather and the device step).  The device half of hot realize —
+    the hot-gradient ``all_gather``+fold and the ``pmax`` lr fold in
+    ``trainer.hybrid_hot_update`` — are plain ``lax.*`` entries below.
   * ``lax.psum``/``pmean``/``ppermute``/``all_gather``/``all_to_all`` —
     device collectives inside ``shard_map`` bodies; they participate in
     sequence/divergence analysis and in the mesh-axis binding check.
@@ -86,6 +92,12 @@ METHOD_COLLECTIVES = {
         op="flush", classes=frozenset({"ShardedSparseTable"}),
         require_class=True,
         why="multi-host write-back barrier between lockstep collectives",
+    ),
+    "broadcast_hot_rows": CollectiveSpec(
+        op="broadcast_hot_rows", classes=frozenset({"ShardedSparseTable"}),
+        why="hot-promotion row broadcast on the census channel: every "
+            "rank contributes its owned shards' frames and every rank "
+            "receives all of them (begin_pass lockstep, main thread)",
     ),
 }
 
